@@ -1,0 +1,30 @@
+"""r2d2_tpu — a TPU-native distributed recurrent-replay RL framework.
+
+Built from scratch in JAX/XLA (jit, lax.scan, jax.sharding/pjit, Pallas)
+with the full capabilities of the reference PyTorch R2D2 implementation
+(Kapturowski et al., ICLR 2019; reference repo surveyed in SURVEY.md):
+
+- recurrent dueling double-DQN (conv encoder + LSTM + dueling heads)
+- n-step returns with value rescaling, terminal encoded as gamma_n = 0
+- sequence-prioritized replay with stored recurrent states and burn-in
+- Ape-X epsilon-ladder actor fleet with batched, vmapped inference
+- data-parallel learner over a jax.sharding.Mesh with XLA collectives
+
+Layout (mirrors SURVEY.md section 1's layer map, re-architected TPU-first):
+
+    config.py        L0  frozen dataclass config + presets
+    envs/            L1  environment layer (pure-JAX envs, gated ALE)
+    models/          L2  flax networks: encoders, LSTM scan, R2D2 heads
+    replay/          L3  host data plane: sum tree, block store, accumulator
+    ops/             --  pure functional math shared by L2-L4
+    learner.py       L4  jitted/pjit double-Q update
+    actor.py         L4  vectorized actor service
+    train.py         L5  orchestration
+    evaluate.py      L6  offline evaluation
+    parallel/        --  mesh/sharding utilities
+    utils/           --  checkpointing, metrics, profiling
+"""
+
+__version__ = "0.1.0"
+
+from r2d2_tpu.config import R2D2Config  # noqa: F401

@@ -1,0 +1,224 @@
+"""Frozen dataclass configuration (L0).
+
+The reference keeps hyperparameters as mutable module globals imported at
+definition time (reference config.py:1-37, with values bound inside default
+args — SURVEY.md quirk notes). Here config is a frozen dataclass constructed
+once and passed explicitly, so values are visible to jit as static Python
+scalars and configs can be swapped per-experiment without import-order traps.
+
+All default values reproduce the reference exactly
+(/root/reference/config.py:1-37).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class R2D2Config:
+    # --- environment -----------------------------------------------------
+    env_name: str = "MsPacman"
+    # TPU-native layout is channels-last (NHWC): conv input tiles onto the
+    # MXU without a transpose. The reference uses channel-first (1, 84, 84)
+    # (reference config.py:2); env wrappers here emit (84, 84, 1).
+    obs_shape: Tuple[int, ...] = (84, 84, 1)
+    action_dim: int = 9  # MsPacman reduced action set; overridden per env
+    max_episode_steps: int = 27000  # reference config.py:17
+    noop_max: int = 30  # reference environment.py:9
+
+    # --- optimization ----------------------------------------------------
+    lr: float = 1e-4  # reference config.py:4
+    adam_eps: float = 1e-3  # reference config.py:5
+    grad_norm: float = 40.0  # reference config.py:6
+    batch_size: int = 64  # reference config.py:7
+
+    # --- RL --------------------------------------------------------------
+    gamma: float = 0.997  # reference config.py:11
+    value_rescale_eps: float = 1e-3  # reference worker.py:455
+
+    # --- prioritized replay ----------------------------------------------
+    prio_exponent: float = 0.9  # alpha, reference config.py:12
+    is_exponent: float = 0.6  # beta, reference config.py:13
+    # per-sequence priority = eta*max|td| + (1-eta)*mean|td|
+    # (reference worker.py:325; paper's eta = 0.9)
+    td_mix_eta: float = 0.9
+    buffer_capacity: int = 2_000_000  # transitions, reference config.py:16
+    block_length: int = 400  # reference config.py:19
+    learning_starts: int = 50_000  # reference config.py:8
+
+    # --- sequence shape --------------------------------------------------
+    burn_in_steps: int = 40  # reference config.py:27
+    learning_steps: int = 40  # reference config.py:28
+    forward_steps: int = 5  # n-step, reference config.py:29
+
+    # --- schedule / cadences (reference worker.py:440-452, config.py:9-15)
+    training_steps: int = 100_000
+    target_net_update_interval: int = 2000
+    save_interval: int = 500
+    # learner publishes weights to actors every N updates (worker.py:440)
+    publish_interval: int = 4
+    # actors refresh weights every N env steps. The reference hardcodes 400
+    # at worker.py:744 and never reads config.actor_update_interval
+    # (SURVEY.md quirk 4); here it is honored.
+    actor_update_interval: int = 400
+    log_interval: float = 10.0  # seconds, reference config.py:24
+
+    # --- actor fleet ------------------------------------------------------
+    num_actors: int = 8  # reference config.py:21
+    base_eps: float = 0.4  # reference config.py:22
+    eps_alpha: float = 7.0  # reference config.py:23
+    test_epsilon: float = 0.001  # reference config.py:37
+
+    # --- network ----------------------------------------------------------
+    hidden_dim: int = 512  # reference config.py:34
+    encoder: str = "nature"  # "nature" | "impala" | "mlp"
+    # width multiplier for the impala encoder's channel stack
+    impala_channels: Tuple[int, ...] = (16, 32, 32)
+
+    # --- numerics ---------------------------------------------------------
+    # Compute dtype for conv/LSTM matmuls. Loss/target math always runs in
+    # float32 (SURVEY.md section 7.3 item 4). bfloat16 feeds the MXU at
+    # double rate on TPU.
+    compute_dtype: str = "float32"  # "float32" | "bfloat16"
+    param_dtype: str = "float32"
+
+    # --- parallelism ------------------------------------------------------
+    # Data-parallel learner shards the batch over the "dp" mesh axis;
+    # "tp" shards wide layers (impala encoder / LSTM kernels) when > 1.
+    dp_size: int = 1
+    tp_size: int = 1
+    # chunk size for remat'd long-sequence scans (long-context configs);
+    # None disables gradient checkpointing of the unroll.
+    scan_chunk: Optional[int] = None
+
+    # --- infra ------------------------------------------------------------
+    seed: int = 0
+    checkpoint_dir: str = "checkpoints"
+    metrics_path: Optional[str] = None  # jsonl metrics file
+    use_native_replay: bool = True  # C++ replay core if built, else numpy
+
+    # --- derived ----------------------------------------------------------
+    @property
+    def seq_len(self) -> int:
+        """burn_in + learning + forward = 85 at defaults (config.py:30)."""
+        return self.burn_in_steps + self.learning_steps + self.forward_steps
+
+    @property
+    def seqs_per_block(self) -> int:
+        """Sequences per block: 400 // 40 = 10 (reference worker.py:79)."""
+        return self.block_length // self.learning_steps
+
+    @property
+    def num_blocks(self) -> int:
+        """Circular store size: capacity // block_length (worker.py:78)."""
+        return self.buffer_capacity // self.block_length
+
+    @property
+    def num_sequences(self) -> int:
+        """Priority-tree leaf count: capacity // learning (worker.py:76)."""
+        return self.buffer_capacity // self.learning_steps
+
+    @property
+    def block_slot_len(self) -> int:
+        """Max stored steps per block incl. leading burn-in context and the
+        trailing +1 seed entry (reference Block obs shape, worker.py:26-27
+        with the carry at worker.py:640-647)."""
+        return self.block_length + self.burn_in_steps + 1
+
+    def validate(self) -> "R2D2Config":
+        if self.block_length % self.learning_steps != 0:
+            raise ValueError("block_length must be a multiple of learning_steps")
+        if self.buffer_capacity % self.block_length != 0:
+            raise ValueError("buffer_capacity must be a multiple of block_length")
+        if self.forward_steps < 1:
+            raise ValueError("forward_steps must be >= 1")
+        if self.action_dim > 256:
+            # actions are stored uint8 in the replay plane (Block.action)
+            raise ValueError("action_dim > 256 would overflow uint8 replay storage")
+        if self.encoder not in ("nature", "impala", "mlp"):
+            raise ValueError(f"unknown encoder {self.encoder!r}")
+        return self
+
+    def replace(self, **kw) -> "R2D2Config":
+        return dataclasses.replace(self, **kw).validate()
+
+
+# --------------------------------------------------------------------------
+# Presets — the BASELINE.json configs as first-class presets.
+# --------------------------------------------------------------------------
+
+def default_atari(game: str = "MsPacman") -> R2D2Config:
+    """Reference defaults: single learner, 8 actors (BASELINE.json config 1)."""
+    return R2D2Config(env_name=game).validate()
+
+
+def atari_v4_8(game: str = "MsPacman") -> R2D2Config:
+    """256 actors + data-parallel learner on a v4-8 (BASELINE.json config 2)."""
+    return R2D2Config(
+        env_name=game,
+        num_actors=256,
+        dp_size=4,
+        batch_size=64,
+        compute_dtype="bfloat16",
+    ).validate()
+
+
+def procgen_impala(game: str = "coinrun") -> R2D2Config:
+    """IMPALA-ResNet encoder variant (BASELINE.json config 4)."""
+    return R2D2Config(
+        env_name=game,
+        obs_shape=(64, 64, 3),
+        encoder="impala",
+        compute_dtype="bfloat16",
+    ).validate()
+
+
+def long_context(game: str = "Craftax") -> R2D2Config:
+    """seq_len=512 stored-state burn-in stretch config (BASELINE.json
+    config 5). The LSTM recurrence is sequential in time, so long sequences
+    scale via remat-chunked lax.scan over time (SURVEY.md section 5.7), not
+    sequence-dimension sharding."""
+    return R2D2Config(
+        env_name=game,
+        burn_in_steps=64,
+        learning_steps=512,
+        forward_steps=5,
+        block_length=512,
+        buffer_capacity=2_048_000,  # 4000 blocks of 512
+        scan_chunk=64,
+        compute_dtype="bfloat16",
+    ).validate()
+
+
+def tiny_test() -> R2D2Config:
+    """Minimal shapes for fast unit/integration tests."""
+    return R2D2Config(
+        obs_shape=(12, 12, 1),
+        action_dim=4,
+        hidden_dim=32,
+        batch_size=8,
+        burn_in_steps=4,
+        learning_steps=4,
+        forward_steps=2,
+        block_length=16,
+        buffer_capacity=640,
+        learning_starts=64,
+        num_actors=2,
+        training_steps=50,
+        target_net_update_interval=10,
+        save_interval=25,
+        max_episode_steps=100,
+        encoder="mlp",
+    ).validate()
+
+
+PRESETS = {
+    "atari": default_atari,
+    "atari_v4_8": atari_v4_8,
+    "procgen_impala": procgen_impala,
+    "long_context": long_context,
+    "tiny_test": tiny_test,
+}

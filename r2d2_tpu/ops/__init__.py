@@ -1,0 +1,20 @@
+"""Pure functional math shared across the framework.
+
+Everything here is a small, heavily unit-tested function implementing one of
+the behavioral invariants in SURVEY.md section 2.6.
+"""
+
+from r2d2_tpu.ops.value_rescale import value_rescale, inverse_value_rescale
+from r2d2_tpu.ops.returns import n_step_returns, n_step_gammas
+from r2d2_tpu.ops.epsilon import epsilon_ladder
+from r2d2_tpu.ops.priority import mixed_td_priorities, mixed_td_priorities_np
+
+__all__ = [
+    "value_rescale",
+    "inverse_value_rescale",
+    "n_step_returns",
+    "n_step_gammas",
+    "epsilon_ladder",
+    "mixed_td_priorities",
+    "mixed_td_priorities_np",
+]

@@ -1,0 +1,25 @@
+"""Ape-X epsilon ladder.
+
+epsilon_i = base ** (1 + i / (N - 1) * alpha)  for actor i in [0, N)
+(invariant from reference train.py:15-26). For N=8, base=0.4, alpha=7 this
+yields [0.4, 0.16, 0.064, 0.0256, 0.01024, 0.0041, 0.00164, 0.00066]
+(SURVEY.md component 18, verified numerically).
+
+Returned as a vector so the actor service can hold one epsilon per
+vectorized environment — the TPU-native generalization of the reference's
+one-process-per-epsilon fleet.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def epsilon_ladder(
+    num_actors: int, base_eps: float = 0.4, alpha: float = 7.0
+) -> np.ndarray:
+    if num_actors == 1:
+        return np.asarray([base_eps], dtype=np.float32)
+    i = np.arange(num_actors, dtype=np.float64)
+    exponent = 1.0 + i / (num_actors - 1) * alpha
+    return (base_eps**exponent).astype(np.float32)

@@ -1,0 +1,40 @@
+"""Per-sequence mixed TD-error priorities.
+
+p_seq = eta * max_t |delta_t| + (1 - eta) * mean_t |delta_t|, eta = 0.9,
+over the sequence's valid learning steps (invariant from reference
+worker.py:317-328). The reference loops over ragged per-sequence spans in
+Python; here sequences are fixed-shape (B, L) with a validity mask, so the
+reduction is one vectorized masked max + masked mean — jit-friendly and
+computed on device right next to the TD errors, avoiding the reference's
+device->host round trip before priority math (worker.py:422-425).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def mixed_td_priorities(
+    abs_td: jnp.ndarray, mask: jnp.ndarray, eta: float = 0.9
+) -> jnp.ndarray:
+    """abs_td: (B, L) |delta|; mask: (B, L) 1.0 on valid learning steps.
+
+    Returns (B,) priorities. Rows with an empty mask produce 0.
+    """
+    masked = abs_td * mask
+    max_td = jnp.max(masked, axis=1)
+    count = jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+    mean_td = jnp.sum(masked, axis=1) / count
+    return eta * max_td + (1.0 - eta) * mean_td
+
+
+def mixed_td_priorities_np(
+    abs_td: np.ndarray, mask: np.ndarray, eta: float = 0.9
+) -> np.ndarray:
+    """numpy twin for host-side (actor initial-priority) use."""
+    masked = abs_td * mask
+    max_td = masked.max(axis=1)
+    count = np.maximum(mask.sum(axis=1), 1.0)
+    mean_td = masked.sum(axis=1) / count
+    return (eta * max_td + (1.0 - eta) * mean_td).astype(np.float32)

@@ -1,0 +1,51 @@
+"""n-step return and bootstrap-discount computation.
+
+Invariants (SURVEY.md section 2.6, reference worker.py:540-595):
+
+- R_t = sum_{k < n} gamma^k * r_{t+k}, with rewards past the episode end
+  treated as 0. The reference computes this as a 'valid'-mode convolution
+  of the reward sequence (padded with n-1 zeros) against the kernel
+  [gamma^{n-1}, ..., gamma, 1] (worker.py:580,593-595).
+- The bootstrap discount gamma_n(t) carries ALL terminal information:
+  gamma^n for steps with a full n-step window, gamma^{n - j} as the window
+  shrinks toward a truncation point, and 0 past a terminal — no done flags
+  exist anywhere in the data path (worker.py:543-554).
+
+These run on the host inside the sequence accumulator (numpy), so they are
+written against the numpy API; jax.numpy accepts the same code via the
+`xp` argument if ever needed on device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def n_step_returns(rewards: np.ndarray, gamma: float, n: int) -> np.ndarray:
+    """R_t for every t in [0, len(rewards)).
+
+    rewards: (T,) raw per-step rewards of one (partial) episode chunk.
+    Returns (T,) float32: sum_{k<n} gamma^k r_{t+k} with zero padding.
+    """
+    rewards = np.asarray(rewards, dtype=np.float64)
+    padded = np.concatenate([rewards, np.zeros(n - 1, dtype=np.float64)])
+    # kernel ordered so 'valid' convolution aligns gamma^k with r_{t+k}
+    kernel = np.array([gamma ** (n - 1 - i) for i in range(n)], dtype=np.float64)
+    return np.convolve(padded, kernel, "valid").astype(np.float32)
+
+
+def n_step_gammas(size: int, gamma: float, n: int, done: bool) -> np.ndarray:
+    """Bootstrap discount gamma_n(t) for a chunk of `size` steps.
+
+    If the chunk ends at a block boundary (done=False), the final
+    min(size, n) steps bootstrap from progressively closer future states:
+    gamma^n, ..., gamma^1. If it ends at a terminal (done=True), those
+    steps get gamma_n = 0 — the terminal encoding (worker.py:543-554).
+    """
+    max_fwd = min(size, n)
+    head = [gamma**n] * (size - max_fwd)
+    if done:
+        tail = [0.0] * max_fwd
+    else:
+        tail = [gamma**j for j in reversed(range(1, max_fwd + 1))]
+    return np.asarray(head + tail, dtype=np.float32)

@@ -1,0 +1,39 @@
+"""Value-function rescaling h and its closed-form inverse.
+
+R2D2 trains Q in a squashed space to cope with Atari's raw-score reward
+scale: targets are y = h(r_n + gamma_n * h^{-1}(Q_target)) (invariant from
+reference worker.py:410,454-461; Pohlen et al. 2018, eq. 4-5):
+
+    h(x)      = sign(x) * (sqrt(|x| + 1) - 1) + eps * x
+    h^{-1}(x) = sign(x) * (((sqrt(1 + 4 eps (|x| + 1 + eps)) - 1) / (2 eps))^2 - 1)
+
+Both are elementwise and jit/vmap/grad-safe.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def value_rescale(x: jnp.ndarray, eps: float = 1e-3) -> jnp.ndarray:
+    return jnp.sign(x) * (jnp.sqrt(jnp.abs(x) + 1.0) - 1.0) + eps * x
+
+
+def inverse_value_rescale(x: jnp.ndarray, eps: float = 1e-3) -> jnp.ndarray:
+    t = (jnp.sqrt(1.0 + 4.0 * eps * (jnp.abs(x) + 1.0 + eps)) - 1.0) / (2.0 * eps)
+    return jnp.sign(x) * (jnp.square(t) - 1.0)
+
+
+# numpy twins for host-side code (actor initial priorities). The reference
+# computes actor-side TDs on raw Q while the learner works in rescaled space
+# (SURVEY.md quirk 6); this framework keeps both on the rescaled scale, so
+# the host needs the same h / h^-1.
+
+def value_rescale_np(x: np.ndarray, eps: float = 1e-3) -> np.ndarray:
+    return np.sign(x) * (np.sqrt(np.abs(x) + 1.0) - 1.0) + eps * x
+
+
+def inverse_value_rescale_np(x: np.ndarray, eps: float = 1e-3) -> np.ndarray:
+    t = (np.sqrt(1.0 + 4.0 * eps * (np.abs(x) + 1.0 + eps)) - 1.0) / (2.0 * eps)
+    return np.sign(x) * (np.square(t) - 1.0)

@@ -1,0 +1,15 @@
+"""Host-side replay data plane (L3).
+
+The TPU split of responsibilities: everything that is control-flow-heavy and
+byte-addressed (priority tree, circular block store, window slicing) lives on
+the host in vectorized numpy (with an optional C++ core for the hot paths);
+everything dense lands on the device as fixed-shape batches via an async
+prefetch pipeline.
+"""
+
+from r2d2_tpu.replay.sum_tree import SumTree
+from r2d2_tpu.replay.block import Block
+from r2d2_tpu.replay.accumulator import SequenceAccumulator
+from r2d2_tpu.replay.replay_buffer import ReplayBuffer, SampledBatch
+
+__all__ = ["SumTree", "Block", "SequenceAccumulator", "ReplayBuffer", "SampledBatch"]

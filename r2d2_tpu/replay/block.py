@@ -1,0 +1,51 @@
+"""Block — the unit of replay storage.
+
+Mirrors the reference Block (reference worker.py:23-66) with two TPU-first
+changes:
+
+- `last_action` is stored as a scalar uint8 index, not a bool one-hot
+  (reference worker.py:31,498). One-hot expansion happens on device inside
+  the jitted step (jax.nn.one_hot) — an A-fold replay-RAM saving and less
+  host->device traffic.
+- Per-sequence step counters are int32, not uint8, so block/burn-in/learning
+  spans > 255 (the long-context preset) don't silently wrap (SURVEY.md
+  quirk 12).
+
+Observations keep the reference's uint8 storage; normalization to [0, 1]
+happens exactly once, on device (SURVEY.md quirk 15).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Block:
+    # (stored_steps, *obs_shape) uint8; stored_steps = burn_in_steps[0] +
+    # sum(learning_steps) + 1 (trailing seed entry for the next window)
+    obs: np.ndarray
+    # (stored_steps,) uint8 — action that *led to* the aligned obs
+    last_action: np.ndarray
+    # (stored_steps,) float32 — reward that came with the aligned obs
+    last_reward: np.ndarray
+    # (T,) uint8 — action taken at each learning step
+    action: np.ndarray
+    # (T,) float32 — n-step return R_t
+    n_step_reward: np.ndarray
+    # (T,) float32 — bootstrap discount gamma_n(t); 0 past a terminal
+    gamma: np.ndarray
+    # (num_sequences, 2, hidden_dim) float32 — LSTM (h, c) at the TRUE
+    # replay-window start of each sequence (fixes SURVEY.md quirk 1)
+    hidden: np.ndarray
+    num_sequences: int
+    # (num_sequences,) int32 each
+    burn_in_steps: np.ndarray
+    learning_steps: np.ndarray
+    forward_steps: np.ndarray
+
+    @property
+    def stored_steps(self) -> int:
+        return len(self.obs)

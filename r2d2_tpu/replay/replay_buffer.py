@@ -1,0 +1,229 @@
+"""Central sequence-prioritized replay with vectorized batch assembly.
+
+Capability parity with the reference ReplayBuffer (reference
+worker.py:69-310): circular store of fixed-size blocks, a sum tree over all
+sequence slots, stratified prioritized sampling with IS weights, and
+stale-priority rejection via pointer-window masking.
+
+TPU-first redesign: the reference assembles each batch with a 64-iteration
+Python loop of per-sequence tensor slices plus `pad_sequence`
+(worker.py:210-288). Here every block field lives in ONE preallocated numpy
+array, and a batch is assembled with a single fancy-index gather per field —
+(batch, seq_len) windows come out fixed-shape (jit-stable) in a handful of
+vectorized ops. This is what keeps a TPU learner fed from a host CPU.
+
+Thread safety: one lock around add/sample/update, as in the reference
+(worker.py:97), but the buffer is passive — service loops live in the
+trainer so the same object works single- and multi-threaded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+import numpy as np
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.replay.block import Block
+from r2d2_tpu.replay.sum_tree import SumTree
+
+
+@dataclasses.dataclass
+class SampledBatch:
+    """Fixed-shape training batch (host numpy, ready for device_put)."""
+
+    obs: np.ndarray            # (B, seq_len, *obs_shape) uint8
+    last_action: np.ndarray    # (B, seq_len) uint8 scalar actions
+    last_reward: np.ndarray    # (B, seq_len) float32
+    hidden: np.ndarray         # (B, 2, H) float32
+    action: np.ndarray         # (B, L) int32
+    n_step_reward: np.ndarray  # (B, L) float32
+    gamma: np.ndarray          # (B, L) float32
+    burn_in_steps: np.ndarray  # (B,) int32
+    learning_steps: np.ndarray # (B,) int32
+    forward_steps: np.ndarray  # (B,) int32
+    is_weights: np.ndarray     # (B,) float32
+    idxes: np.ndarray          # (B,) int64 — sequence slots, for priority updates
+    old_ptr: int               # block pointer at sample time (staleness check)
+    env_steps: int             # total env steps stored so far
+
+
+class ReplayBuffer:
+    def __init__(self, cfg: R2D2Config, native: Optional[object] = None):
+        self.cfg = cfg
+        S, L = cfg.seqs_per_block, cfg.learning_steps
+        nb, slot = cfg.num_blocks, cfg.block_slot_len
+
+        self.tree = SumTree(cfg.num_sequences, cfg.prio_exponent, cfg.is_exponent, native=native)
+        self._native = native
+
+        self.obs_store = np.zeros((nb, slot, *cfg.obs_shape), dtype=np.uint8)
+        self.last_action_store = np.zeros((nb, slot), dtype=np.uint8)
+        self.last_reward_store = np.zeros((nb, slot), dtype=np.float32)
+        self.action_store = np.zeros((nb, cfg.block_length), dtype=np.uint8)
+        self.n_step_reward_store = np.zeros((nb, cfg.block_length), dtype=np.float32)
+        self.gamma_store = np.zeros((nb, cfg.block_length), dtype=np.float32)
+        self.hidden_store = np.zeros((nb, S, 2, cfg.hidden_dim), dtype=np.float32)
+        self.burn_in_store = np.zeros((nb, S), dtype=np.int32)
+        self.learning_store = np.zeros((nb, S), dtype=np.int32)
+        self.forward_store = np.zeros((nb, S), dtype=np.int32)
+        self.num_seq_store = np.zeros(nb, dtype=np.int32)
+        self.learning_sum = np.zeros(nb, dtype=np.int64)
+        self.occupied = np.zeros(nb, dtype=bool)
+
+        self.block_ptr = 0
+        self.size = 0  # stored learning transitions
+        self.env_steps = 0
+        self.num_episodes = 0
+        self.episode_reward_sum = 0.0
+        self.lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return self.size
+
+    # ------------------------------------------------------------------ add
+
+    def add_block(
+        self, block: Block, priorities: np.ndarray, episode_reward: Optional[float]
+    ) -> None:
+        """Write one block into the circular store and refresh its leaves
+        (reference worker.py:178-208). `priorities` must already be padded
+        to seqs_per_block (zeros for absent sequences)."""
+        cfg = self.cfg
+        S = cfg.seqs_per_block
+        with self.lock:
+            ptr = self.block_ptr
+            idxes = np.arange(ptr * S, (ptr + 1) * S, dtype=np.int64)
+            self.tree.update(idxes, priorities)
+
+            if self.occupied[ptr]:
+                self.size -= int(self.learning_sum[ptr])
+
+            steps = block.stored_steps
+            self.obs_store[ptr, :steps] = block.obs
+            self.last_action_store[ptr, :steps] = block.last_action
+            self.last_reward_store[ptr, :steps] = block.last_reward
+            T = len(block.action)
+            self.action_store[ptr, :T] = block.action
+            self.n_step_reward_store[ptr, :T] = block.n_step_reward
+            self.gamma_store[ptr, :T] = block.gamma
+            ns = block.num_sequences
+            self.hidden_store[ptr, :ns] = block.hidden
+            self.burn_in_store[ptr, :S] = 0
+            self.learning_store[ptr, :S] = 0
+            self.forward_store[ptr, :S] = 0
+            self.burn_in_store[ptr, :ns] = block.burn_in_steps
+            self.learning_store[ptr, :ns] = block.learning_steps
+            self.forward_store[ptr, :ns] = block.forward_steps
+            self.num_seq_store[ptr] = ns
+            lsum = int(block.learning_steps.sum())
+            self.learning_sum[ptr] = lsum
+            self.occupied[ptr] = True
+
+            self.size += lsum
+            self.env_steps += lsum
+            self.block_ptr = (ptr + 1) % cfg.num_blocks
+
+            if episode_reward is not None:
+                self.episode_reward_sum += episode_reward
+                self.num_episodes += 1
+
+    # --------------------------------------------------------------- sample
+
+    def can_sample(self) -> bool:
+        return self.size >= self.cfg.learning_starts
+
+    def sample_batch(self, rng: np.random.Generator) -> SampledBatch:
+        """Draw a fixed-shape batch via stratified prioritized sampling.
+
+        All per-field gathers are single vectorized fancy-index reads over
+        the preallocated stores — the TPU-feeding rewrite of reference
+        worker.py:210-288.
+        """
+        cfg = self.cfg
+        S, L, n = cfg.seqs_per_block, cfg.learning_steps, cfg.forward_steps
+        bsz = cfg.batch_size
+        with self.lock:
+            idxes, is_weights = self.tree.sample(bsz, rng)
+            b = idxes // S
+            s = idxes % S
+            # A stratum boundary can land on a zero-priority leaf of a
+            # partially-filled block; clamp instead of crashing (the
+            # reference asserts here, worker.py:228, against a misspelled
+            # attribute — SURVEY.md quirk 2). Rewrite idxes to the clamped
+            # slot so the learner's priority update lands on the sequence
+            # that was actually trained on, not the empty slot.
+            s = np.minimum(s, np.maximum(self.num_seq_store[b] - 1, 0))
+            idxes = b * S + s
+
+            burn = self.burn_in_store[b, s]
+            learn = self.learning_store[b, s]
+            fwd = self.forward_store[b, s]
+            first_burn = self.burn_in_store[b, 0]
+            start = first_burn + s * L          # buffer coords of learning start
+            win_start = start - burn
+
+            t = np.arange(cfg.seq_len)
+            rows = win_start[:, None] + t[None, :]
+            np.clip(rows, 0, cfg.block_slot_len - 1, out=rows)
+            bcol = b[:, None]
+            obs = self.obs_store[bcol, rows]
+            last_action = self.last_action_store[bcol, rows]
+            last_reward = self.last_reward_store[bcol, rows]
+
+            tl = np.arange(L)
+            lrows = s[:, None] * L + tl[None, :]
+            np.clip(lrows, 0, cfg.block_length - 1, out=lrows)
+            action = self.action_store[bcol, lrows].astype(np.int32)
+            n_step_reward = self.n_step_reward_store[bcol, lrows]
+            gamma = self.gamma_store[bcol, lrows]
+
+            hidden = self.hidden_store[b, s]
+
+            batch = SampledBatch(
+                obs=obs,
+                last_action=last_action,
+                last_reward=last_reward,
+                hidden=hidden,
+                action=action,
+                n_step_reward=n_step_reward,
+                gamma=gamma,
+                burn_in_steps=burn.astype(np.int32),
+                learning_steps=learn.astype(np.int32),
+                forward_steps=fwd.astype(np.int32),
+                is_weights=is_weights,
+                idxes=idxes,
+                old_ptr=self.block_ptr,
+                env_steps=self.env_steps,
+            )
+        return batch
+
+    # ------------------------------------------------------------- priority
+
+    def update_priorities(
+        self, idxes: np.ndarray, td_errors: np.ndarray, old_ptr: int
+    ) -> None:
+        """Apply learner priorities, discarding any index whose block was
+        overwritten during the sample->train round trip (the pointer-window
+        invariant of reference worker.py:290-307)."""
+        S = self.cfg.seqs_per_block
+        with self.lock:
+            ptr = self.block_ptr
+            if ptr > old_ptr:
+                mask = (idxes < old_ptr * S) | (idxes >= ptr * S)
+            elif ptr < old_ptr:
+                mask = (idxes < old_ptr * S) & (idxes >= ptr * S)
+            else:
+                mask = np.ones_like(idxes, dtype=bool)
+            self.tree.update(idxes[mask], td_errors[mask])
+
+    # -------------------------------------------------------------- metrics
+
+    def pop_episode_stats(self):
+        with self.lock:
+            n, r = self.num_episodes, self.episode_reward_sum
+            self.num_episodes = 0
+            self.episode_reward_sum = 0.0
+        return n, r

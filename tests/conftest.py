@@ -1,0 +1,13 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports.
+
+This is the distributed-without-a-cluster strategy from SURVEY.md section 4:
+pjit/shard_map collectives run on 8 fake CPU devices, so multi-chip sharding
+is validated on any host.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

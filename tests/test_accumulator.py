@@ -1,0 +1,138 @@
+"""SequenceAccumulator tests: block packing math, stored-state alignment
+(the quirk-1 fix), cross-block burn-in carry, terminal encoding."""
+
+import numpy as np
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.ops.value_rescale import inverse_value_rescale_np, value_rescale_np
+from r2d2_tpu.replay.accumulator import SequenceAccumulator
+
+
+def small_cfg(**kw):
+    base = dict(
+        obs_shape=(3, 3, 1),
+        action_dim=3,
+        hidden_dim=4,
+        burn_in_steps=4,
+        learning_steps=4,
+        forward_steps=2,
+        block_length=12,
+        buffer_capacity=120,
+        gamma=0.9,
+    )
+    base.update(kw)
+    return R2D2Config(**base).validate()
+
+
+def run_steps(acc, n, start_step=0, hidden_tag=None):
+    """Step the accumulator with tagged data so positions are identifiable."""
+    for k in range(n):
+        t = start_step + k
+        obs = np.full((3, 3, 1), (t + 1) % 256, dtype=np.uint8)
+        q = np.array([t, t + 0.5, t - 0.5], dtype=np.float32)
+        hid = np.full((2, 4), float(t + 1), dtype=np.float32)  # state AFTER step t
+        acc.add(action=t % 3, reward=1.0, next_obs=obs, q_value=q, hidden=hid)
+
+
+def test_block_shapes_and_counters_full_block():
+    cfg = small_cfg()
+    acc = SequenceAccumulator(cfg)
+    acc.reset(np.zeros((3, 3, 1), dtype=np.uint8))
+    run_steps(acc, 12)
+    block, prios, ep_reward = acc.finish(last_qval=np.zeros(3, dtype=np.float32))
+
+    assert block.num_sequences == 3
+    np.testing.assert_array_equal(block.burn_in_steps, [0, 4, 4])
+    np.testing.assert_array_equal(block.learning_steps, [4, 4, 4])
+    np.testing.assert_array_equal(block.forward_steps, [2, 2, 1])
+    assert block.obs.shape == (13, 3, 3, 1)  # curr_burn_in(0) + size + 1
+    assert prios.shape == (cfg.seqs_per_block,)
+    assert ep_reward is None  # episode still running
+    # carry: last burn_in+1 entries retained
+    assert acc.curr_burn_in == 4
+    assert len(acc.obs_buf) == 5
+
+
+def test_stored_hidden_alignment_first_block():
+    """Quirk-1 regression: on the FIRST block of an episode, sequence i>0
+    must store the hidden at its true window start (i*L - burn_in), not at
+    i*L as the reference does (reference worker.py:574 vs worker.py:606)."""
+    cfg = small_cfg()
+    acc = SequenceAccumulator(cfg)
+    acc.reset(np.zeros((3, 3, 1), dtype=np.uint8))
+    run_steps(acc, 12)
+    block, _, _ = acc.finish(last_qval=np.zeros(3, dtype=np.float32))
+
+    # hidden_buf[j] was tagged with value j (zeros at j=0, j after step j-1)
+    # seq 0: burn_in 0, window starts at buffer pos 0 -> hidden tag 0
+    # seq 1: learning starts at pos 4, burn_in 4 -> window pos 0 -> tag 0
+    #        (the reference would wrongly store pos 4)
+    # seq 2: learning starts at pos 8, burn_in 4 -> window pos 4 -> tag 4
+    np.testing.assert_allclose(block.hidden[0], 0.0)
+    np.testing.assert_allclose(block.hidden[1], 0.0)
+    np.testing.assert_allclose(block.hidden[2], 4.0)
+
+
+def test_stored_hidden_alignment_steady_state():
+    """Second block (curr_burn_in == B): window start == i*L in buffer
+    coords, matching the reference's steady-state behavior."""
+    cfg = small_cfg()
+    acc = SequenceAccumulator(cfg)
+    acc.reset(np.zeros((3, 3, 1), dtype=np.uint8))
+    run_steps(acc, 12)
+    acc.finish(last_qval=np.zeros(3, dtype=np.float32))
+    run_steps(acc, 12, start_step=12)
+    block, _, _ = acc.finish(last_qval=np.zeros(3, dtype=np.float32))
+
+    np.testing.assert_array_equal(block.burn_in_steps, [4, 4, 4])
+    # buffer pos 0 now corresponds to hidden after step 7 (tag 8)
+    # seq i window start (buffer coords) = 4 + i*4 - 4 = i*4 -> tags 8, 12, 16
+    np.testing.assert_allclose(block.hidden[0], 8.0)
+    np.testing.assert_allclose(block.hidden[1], 12.0)
+    np.testing.assert_allclose(block.hidden[2], 16.0)
+
+
+def test_terminal_encoding_and_n_step():
+    cfg = small_cfg()
+    acc = SequenceAccumulator(cfg)
+    acc.reset(np.zeros((3, 3, 1), dtype=np.uint8))
+    rewards = [1.0, 2.0, 3.0, 4.0, 5.0]
+    for t, r in enumerate(rewards):
+        acc.add(t % 3, r, np.zeros((3, 3, 1), np.uint8), np.zeros(3, np.float32), np.zeros((2, 4), np.float32))
+    block, prios, ep_reward = acc.finish(last_qval=None)  # terminal
+
+    assert ep_reward == 15.0
+    g, n = 0.9, 2
+    want_R = [rewards[t] + g * (rewards[t + 1] if t + 1 < 5 else 0.0) for t in range(5)]
+    np.testing.assert_allclose(block.n_step_reward, want_R, rtol=1e-5)
+    # gamma_n: full-window steps get g^n; last min(size, n) steps get 0
+    np.testing.assert_allclose(block.gamma, [g**2, g**2, g**2, 0.0, 0.0], rtol=1e-6)
+    np.testing.assert_array_equal(block.learning_steps, [4, 1])
+    np.testing.assert_array_equal(block.forward_steps, [2, 1])
+
+
+def test_initial_priorities_rescaled_space():
+    """Actor-side TDs must live on the learner's rescaled scale
+    (quirk-6 fix): td = |h(R + gamma_n h^-1(max q)) - q[a]|."""
+    cfg = small_cfg(learning_steps=4, block_length=4, burn_in_steps=2, forward_steps=2, buffer_capacity=40)
+    acc = SequenceAccumulator(cfg)
+    acc.reset(np.zeros((3, 3, 1), dtype=np.uint8))
+    rng = np.random.default_rng(0)
+    qs = rng.normal(size=(4, 3)).astype(np.float32)
+    acts, rews = [0, 1, 2, 0], [1.0, -1.0, 2.0, 0.5]
+    for t in range(4):
+        acc.add(acts[t], rews[t], np.zeros((3, 3, 1), np.uint8), qs[t], np.zeros((2, 4), np.float32))
+    last_q = rng.normal(size=3).astype(np.float32)
+    block, prios, _ = acc.finish(last_qval=last_q)
+
+    qall = np.vstack([qs, last_q[None]])
+    R = block.n_step_reward
+    gn = block.gamma
+    max_fwd = 2
+    max_q = np.max(qall[max_fwd:], axis=1)
+    max_q = np.pad(max_q, (0, max_fwd - 1), "edge")[:4]
+    taken = qall[np.arange(4), acts]
+    td = np.abs(value_rescale_np(R + gn * inverse_value_rescale_np(max_q)) - taken)
+    want = 0.9 * td.max() + 0.1 * td.mean()
+    np.testing.assert_allclose(prios[0], want, rtol=1e-5)
+    assert prios[1:].sum() == 0.0

@@ -1,0 +1,103 @@
+"""Unit tests for the pure math invariants (SURVEY.md section 2.6)."""
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.ops.epsilon import epsilon_ladder
+from r2d2_tpu.ops.priority import mixed_td_priorities, mixed_td_priorities_np
+from r2d2_tpu.ops.returns import n_step_gammas, n_step_returns
+from r2d2_tpu.ops.value_rescale import (
+    inverse_value_rescale,
+    inverse_value_rescale_np,
+    value_rescale,
+    value_rescale_np,
+)
+
+
+class TestValueRescale:
+    def test_round_trip(self):
+        x = np.linspace(-500.0, 500.0, 2001)
+        np.testing.assert_allclose(
+            np.asarray(inverse_value_rescale(value_rescale(x))), x, atol=1e-3, rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(value_rescale(inverse_value_rescale(x))), x, atol=1e-4, rtol=1e-4
+        )
+
+    def test_known_values(self):
+        # h(0) = 0, h(3) = sqrt(4)-1 + 3e-3 = 1.003, odd symmetry
+        assert float(value_rescale(np.float32(0.0))) == 0.0
+        np.testing.assert_allclose(float(value_rescale(np.float32(3.0))), 1.003, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(value_rescale(np.float32(-3.0))),
+            -np.asarray(value_rescale(np.float32(3.0))),
+            atol=1e-7,
+        )
+
+    def test_numpy_twins_match_jax(self):
+        x = np.linspace(-50.0, 50.0, 101).astype(np.float32)
+        np.testing.assert_allclose(value_rescale_np(x), np.asarray(value_rescale(x)), atol=1e-6)
+        np.testing.assert_allclose(
+            inverse_value_rescale_np(x), np.asarray(inverse_value_rescale(x)), rtol=1e-4, atol=1e-4
+        )
+
+
+class TestNStepReturns:
+    def test_brute_force(self):
+        rng = np.random.default_rng(0)
+        rewards = rng.normal(size=37)
+        gamma, n = 0.997, 5
+        got = n_step_returns(rewards, gamma, n)
+        padded = np.concatenate([rewards, np.zeros(n - 1)])
+        want = np.array(
+            [sum(gamma**k * padded[t + k] for k in range(n)) for t in range(len(rewards))]
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_docstring_example(self):
+        # the reference's own worked example (worker.py:582-592), gamma=0.9 n=3
+        got = n_step_returns(np.array([1.0, 2.0, 3.0, 4.0]), 0.9, 3)
+        np.testing.assert_allclose(got, [1 + 2 * 0.9 + 3 * 0.81, 2 + 3 * 0.9 + 4 * 0.81, 3 + 4 * 0.9, 4.0], rtol=1e-6)
+
+    def test_gammas_terminal(self):
+        g = n_step_gammas(7, 0.5, 3, done=True)
+        np.testing.assert_allclose(g, [0.125] * 4 + [0.0, 0.0, 0.0], rtol=1e-6)
+
+    def test_gammas_truncated(self):
+        g = n_step_gammas(7, 0.5, 3, done=False)
+        np.testing.assert_allclose(g, [0.125] * 4 + [0.125, 0.25, 0.5], rtol=1e-6)
+
+    def test_gammas_short_episode(self):
+        g = n_step_gammas(2, 0.5, 5, done=True)
+        np.testing.assert_allclose(g, [0.0, 0.0])
+
+
+class TestEpsilonLadder:
+    def test_reference_values(self):
+        # SURVEY.md component 18: verified ladder for N=8, base .4, alpha 7
+        eps = epsilon_ladder(8, 0.4, 7.0)
+        want = [0.4, 0.16, 0.064, 0.0256, 0.01024, 0.004096, 0.0016384, 0.00065536]
+        np.testing.assert_allclose(eps, want, rtol=1e-4)
+
+    def test_single_actor(self):
+        np.testing.assert_allclose(epsilon_ladder(1, 0.4, 7.0), [0.4])
+
+
+class TestMixedTDPriorities:
+    def test_vs_loop(self):
+        rng = np.random.default_rng(1)
+        td = np.abs(rng.normal(size=(6, 10))).astype(np.float32)
+        lengths = np.array([10, 3, 1, 7, 10, 5])
+        mask = (np.arange(10)[None, :] < lengths[:, None]).astype(np.float32)
+        got = mixed_td_priorities_np(td, mask, eta=0.9)
+        for i, ln in enumerate(lengths):
+            want = 0.9 * td[i, :ln].max() + 0.1 * td[i, :ln].mean()
+            np.testing.assert_allclose(got[i], want, rtol=1e-5)
+
+    def test_jax_matches_numpy(self):
+        rng = np.random.default_rng(2)
+        td = np.abs(rng.normal(size=(4, 8))).astype(np.float32)
+        mask = (np.arange(8)[None, :] < np.array([[8], [2], [5], [1]])).astype(np.float32).reshape(4, 8)
+        np.testing.assert_allclose(
+            np.asarray(mixed_td_priorities(td, mask)), mixed_td_priorities_np(td, mask), rtol=1e-5
+        )

@@ -1,0 +1,137 @@
+"""ReplayBuffer tests: vectorized window assembly, eviction accounting,
+stale-priority pointer masking (reference worker.py:290-307 invariant)."""
+
+import numpy as np
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.replay.accumulator import SequenceAccumulator
+from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+
+
+def small_cfg(**kw):
+    base = dict(
+        obs_shape=(3, 3, 1),
+        action_dim=3,
+        hidden_dim=4,
+        burn_in_steps=4,
+        learning_steps=4,
+        forward_steps=2,
+        block_length=12,
+        buffer_capacity=48,  # 4 blocks, 12 sequence slots
+        learning_starts=12,
+        batch_size=5,
+    )
+    base.update(kw)
+    return R2D2Config(**base).validate()
+
+
+def make_block(cfg, steps=12, start_step=0, terminal=False, seed=0):
+    acc = SequenceAccumulator(cfg)
+    acc.reset(np.full((3, 3, 1), 7, dtype=np.uint8))
+    rng = np.random.default_rng(seed)
+    for k in range(steps):
+        t = start_step + k
+        acc.add(
+            action=t % 3,
+            reward=float(rng.normal()),
+            next_obs=np.full((3, 3, 1), (t + 1) % 256, dtype=np.uint8),
+            q_value=rng.normal(size=3).astype(np.float32),
+            hidden=np.full((2, 4), float(t + 1), dtype=np.float32),
+        )
+    last_q = None if terminal else rng.normal(size=3).astype(np.float32)
+    return acc.finish(last_qval=last_q)
+
+
+def test_add_sample_roundtrip_window_content():
+    cfg = small_cfg()
+    buf = ReplayBuffer(cfg)
+    block, prios, ep = make_block(cfg)
+    buf.add_block(block, prios, ep)
+    assert len(buf) == 12
+    assert buf.can_sample()
+
+    rng = np.random.default_rng(0)
+    batch = buf.sample_batch(rng)
+    assert batch.obs.shape == (5, cfg.seq_len, 3, 3, 1)
+    assert batch.action.shape == (5, 4)
+    for i in range(cfg.batch_size):
+        s = batch.idxes[i] % cfg.seqs_per_block
+        s = min(s, block.num_sequences - 1)
+        burn = block.burn_in_steps[s]
+        learn = block.learning_steps[s]
+        fwd = block.forward_steps[s]
+        start = block.burn_in_steps[0] + 4 * s
+        valid = burn + learn + fwd
+        np.testing.assert_array_equal(
+            batch.obs[i, :valid], block.obs[start - burn : start + learn + fwd]
+        )
+        np.testing.assert_array_equal(batch.action[i, :learn], block.action[4 * s : 4 * s + learn])
+        np.testing.assert_allclose(batch.hidden[i], block.hidden[s])
+        assert batch.burn_in_steps[i] == burn
+        assert batch.learning_steps[i] == learn
+        assert batch.forward_steps[i] == fwd
+
+
+def test_eviction_size_accounting():
+    cfg = small_cfg()
+    buf = ReplayBuffer(cfg)
+    for k in range(6):  # capacity is 4 blocks -> 2 evictions
+        block, prios, ep = make_block(cfg, seed=k)
+        buf.add_block(block, prios, ep)
+    assert len(buf) == 4 * 12
+    assert buf.env_steps == 6 * 12
+    assert buf.block_ptr == 2
+
+
+def test_stale_priority_masking():
+    cfg = small_cfg()
+    buf = ReplayBuffer(cfg)
+    for k in range(4):
+        block, prios, ep = make_block(cfg, seed=k)
+        buf.add_block(block, prios, ep)
+
+    rng = np.random.default_rng(1)
+    batch = buf.sample_batch(rng)
+    old_ptr = batch.old_ptr  # == 0 after exactly one wrap
+
+    # overwrite blocks 0 and 1 -> sequence slots [0, 6) are now stale
+    for k in range(2):
+        block, prios, ep = make_block(cfg, seed=10 + k)
+        buf.add_block(block, prios, ep)
+
+    before = buf.tree.priorities_of(np.arange(12)).copy()
+    idxes = np.arange(12, dtype=np.int64)
+    buf.update_priorities(idxes, np.full(12, 123.0), old_ptr)
+    after = buf.tree.priorities_of(np.arange(12))
+
+    # stale slots (blocks 0-1 = leaves 0..5) must be untouched
+    np.testing.assert_allclose(after[:6], before[:6])
+    # live slots (blocks 2-3 = leaves 6..11) must be updated
+    np.testing.assert_allclose(after[6:], 123.0**cfg.prio_exponent)
+
+
+def test_sample_reproducible_with_seeded_rng():
+    cfg = small_cfg()
+    buf = ReplayBuffer(cfg)
+    block, prios, ep = make_block(cfg)
+    buf.add_block(block, prios, ep)
+    b1 = buf.sample_batch(np.random.default_rng(42))
+    b2 = buf.sample_batch(np.random.default_rng(42))
+    np.testing.assert_array_equal(b1.idxes, b2.idxes)
+    np.testing.assert_array_equal(b1.obs, b2.obs)
+
+
+def test_clamped_sample_rewrites_idxes():
+    """If a draw lands on an empty sequence slot of a partial block, the
+    returned idxes must point at the clamped (real) slot so priority updates
+    hit the trained sequence."""
+    cfg = small_cfg(learning_starts=1)
+    buf = ReplayBuffer(cfg)
+    block, prios, ep = make_block(cfg, steps=5, terminal=True)  # 2 real seqs of 4 slots
+    assert block.num_sequences == 2
+    buf.add_block(block, prios, ep)
+    # force the tree to hand back an empty slot by planting priority on it
+    buf.tree.update(np.array([3]), np.array([100.0]))
+    batch = buf.sample_batch(np.random.default_rng(0))
+    S = cfg.seqs_per_block
+    assert ((batch.idxes % S) <= 1).all(), batch.idxes

@@ -1,0 +1,87 @@
+"""Sum-tree unit tests: exactness vs brute force, stratified edge cases."""
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.replay.sum_tree import SumTree
+
+
+def test_update_totals_match_brute_force():
+    rng = np.random.default_rng(0)
+    tree = SumTree(100, prio_exponent=0.9, is_exponent=0.6)
+    leaves = np.zeros(100)
+    for _ in range(20):
+        idxes = rng.choice(100, size=17, replace=False)
+        tds = rng.uniform(0.0, 5.0, size=17)
+        tree.update(idxes, tds)
+        leaves[idxes] = tds**0.9
+        np.testing.assert_allclose(tree.total, leaves.sum(), rtol=1e-9)
+        np.testing.assert_allclose(tree.priorities_of(np.arange(100)), leaves, rtol=1e-9)
+
+
+def test_sample_distribution():
+    rng = np.random.default_rng(1)
+    tree = SumTree(64, prio_exponent=1.0, is_exponent=0.5)
+    tds = rng.uniform(0.1, 2.0, size=64)
+    tree.update(np.arange(64), tds)
+    counts = np.zeros(64)
+    n_rounds, bsz = 2000, 32
+    for _ in range(n_rounds):
+        idxes, _ = tree.sample(bsz, rng)
+        np.add.at(counts, idxes, 1)
+    freq = counts / (n_rounds * bsz)
+    want = tds / tds.sum()
+    np.testing.assert_allclose(freq, want, atol=0.01)
+
+
+def test_is_weights_formula():
+    rng = np.random.default_rng(2)
+    tree = SumTree(16, prio_exponent=1.0, is_exponent=0.6)
+    tds = np.linspace(0.5, 4.0, 16)
+    tree.update(np.arange(16), tds)
+    idxes, w = tree.sample(8, rng)
+    p = tree.priorities_of(idxes)
+    np.testing.assert_allclose(w, (p / p.min()) ** -0.6, rtol=1e-5)
+
+
+def test_exact_sample_count_quirk10_regression():
+    """The reference's arange-based strata can emit num+1 samples for
+    adversarial float sums (SURVEY.md quirk 10); ours must always emit
+    exactly num samples and stay in range."""
+    rng = np.random.default_rng(3)
+    tree = SumTree(1000, prio_exponent=1.0, is_exponent=0.6)
+    # sums engineered to give a p_sum/num interval with accumulating error
+    tree.update(np.arange(1000), np.full(1000, 0.1 + 1e-9))
+    for _ in range(50):
+        idxes, w = tree.sample(64, rng)
+        assert idxes.shape == (64,)
+        assert (idxes >= 0).all() and (idxes < 1000).all()
+        assert np.isfinite(w).all()
+
+
+def test_empty_tree_raises():
+    tree = SumTree(8)
+    with pytest.raises(ValueError):
+        tree.sample(4, np.random.default_rng(0))
+
+
+def test_capacity_not_power_of_two():
+    tree = SumTree(50_000, prio_exponent=0.9, is_exponent=0.6)
+    # 17 layers / 131071 nodes at the reference's leaf count (SURVEY.md #11)
+    assert tree.num_layers == 17
+    assert tree.tree.shape == (131071,)
+
+
+def test_zero_priority_leaf_gives_finite_weights():
+    """Regression: a sampled zero-priority leaf must yield max-weight 1.0,
+    not NaN/inf (0/0 in the IS formula)."""
+    tree = SumTree(8, prio_exponent=1.0, is_exponent=0.6)
+    tree.update(np.arange(4), np.array([1.0, 2.0, 3.0, 4.0]))
+    # force the degenerate case directly: weights over a mix incl. a 0 leaf
+    nodes = np.array([0, 1, 4, 7]) + tree.leaf_offset
+    priorities = tree.tree[nodes]
+    assert priorities[-1] == 0.0
+    positive = priorities[priorities > 0.0]
+    min_p = positive.min()
+    w = np.power(np.maximum(priorities, min_p) / min_p, -tree.is_exponent)
+    assert np.isfinite(w).all() and w[-1] == 1.0
