@@ -1,0 +1,241 @@
+"""Actor service (L4): vectorized ε-greedy experience collection.
+
+Capability parity with the reference Actor (reference worker.py:655-762),
+re-architected: instead of one OS process per ε (reference train.py:41-46),
+ONE actor object steps E environments with a single jitted, batched policy
+call per env-step — the vmap'd acting path that removes the reference's
+per-env CPU forward bottleneck (SURVEY.md section 3.2). The Ape-X ε ladder
+becomes a per-env vector.
+
+Semantics preserved per env (reference worker.py:685-747):
+- ε-greedy on the dueling Q output; per-env LSTM carry held on device.
+- every transition goes to that env's SequenceAccumulator with its Q row
+  and post-step (h, c) pair.
+- block cut at block_length or at max_episode_steps truncation: finished
+  with a bootstrap Q for the next obs. The reference re-runs the model
+  inline for that Q (worker.py:729-732); here the cut is DEFERRED one step
+  so the bootstrap reuses the next iteration's batched policy call — same
+  value, no extra forward.
+- terminal: finish(None) (gamma_n = 0 path), fresh accumulator seeded with
+  the new episode's first obs, carry/last-action/last-reward zeroed
+  (worker.py:753-762).
+- weight refresh every `actor_update_interval` env steps from the published
+  snapshot (worker.py:744-751) — here an atomic reference swap, so a torn
+  read of a half-written state_dict (SURVEY.md section 5.2) cannot happen.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.models.r2d2 import R2D2Network
+from r2d2_tpu.replay.accumulator import SequenceAccumulator
+
+
+class ParamStore:
+    """Published parameter snapshot: learner swaps the reference, actors
+    read it — immutable objects make the race benign by construction."""
+
+    def __init__(self, params):
+        self._params = jax.tree.map(jnp.copy, params)
+        self.version = 0
+        self._lock = threading.Lock()
+
+    def publish(self, params) -> None:
+        # snapshot: the learner's own buffers may be donated into the next
+        # jitted step, so the published tree must be an independent copy
+        snap = jax.tree.map(jnp.copy, params)
+        with self._lock:
+            self._params = snap
+            self.version += 1
+
+    def latest(self):
+        with self._lock:
+            return self._params, self.version
+
+
+class HostEnvPool:
+    """Vec adapter over a list of host-protocol envs (atari/scripted).
+
+    step() returns (terminal-inclusive obs, rewards, dones, next_obs) where
+    next_obs differs from obs only on done rows (the fresh episode's first
+    frame) — the same contract as CatchVecEnv."""
+
+    def __init__(self, envs: Sequence):
+        self.envs = list(envs)
+        self.num_envs = len(self.envs)
+        self.action_dim = getattr(envs[0], "action_dim", None) or envs[0].action_space.n
+        self.obs_shape = envs[0].obs_shape
+
+    def reset_all(self) -> np.ndarray:
+        return np.stack([e.reset() for e in self.envs])
+
+    def step(self, actions: np.ndarray):
+        obs, rewards, dones, nxt = [], [], [], []
+        for e, a in zip(self.envs, actions):
+            o, r, d, _ = e.step(int(a))
+            obs.append(o)
+            rewards.append(r)
+            dones.append(d)
+            nxt.append(e.reset() if d else o)
+        return np.stack(obs), np.asarray(rewards), np.asarray(dones), np.stack(nxt)
+
+    def force_reset(self, i: int) -> np.ndarray:
+        """Mid-flight reset of one slot (max_episode_steps truncation)."""
+        return self.envs[i].reset()
+
+
+class VectorizedActor:
+    def __init__(
+        self,
+        cfg: R2D2Config,
+        net: R2D2Network,
+        param_store: ParamStore,
+        env,  # vec env protocol: num_envs, reset_all(), step(actions)
+        epsilons: np.ndarray,  # (E,) per-env ε (the ladder)
+        push_block: Callable,  # (block, priorities, episode_reward) -> None
+        seed: int = 0,
+    ):
+        E = env.num_envs
+        assert len(epsilons) == E
+        self.cfg = cfg
+        self.net = net
+        self.param_store = param_store
+        self.env = env
+        self.epsilons = np.asarray(epsilons, np.float32)
+        self.push_block = push_block
+        self.rng = np.random.default_rng(seed)
+        self.action_dim = cfg.action_dim
+
+        self._policy = jax.jit(
+            lambda params, obs, la, lr, carry: net.apply(
+                params, obs, la, lr, carry, method=net.act
+            )
+        )
+        self.params, self.param_version = param_store.latest()
+
+        self.accs: List[SequenceAccumulator] = [SequenceAccumulator(cfg) for _ in range(E)]
+        obs = np.array(env.reset_all())  # writable copy (vec envs may hand
+        for i in range(E):               # back read-only device buffers)
+            self.accs[i].reset(obs[i])
+        self.obs = obs
+        self.last_action = np.zeros(E, np.int32)
+        self.last_reward = np.zeros(E, np.float32)
+        self.carry = (
+            jnp.zeros((E, cfg.hidden_dim), jnp.float32),
+            jnp.zeros((E, cfg.hidden_dim), jnp.float32),
+        )
+        self.episode_steps = np.zeros(E, np.int64)
+        self.total_steps = 0
+        self._steps_since_refresh = 0
+        # envs whose accumulator awaits a bootstrap Q from the next policy call
+        self._pending_cut = np.zeros(E, bool)
+        self._pending_truncate = np.zeros(E, bool)
+
+    # ------------------------------------------------------------------ api
+
+    def run_steps(self, n: int) -> None:
+        for _ in range(n):
+            self.step()
+
+    def step(self) -> None:
+        cfg = self.cfg
+        E = self.env.num_envs
+
+        q, carry = self._policy(
+            self.params,
+            jnp.asarray(self.obs),
+            jnp.asarray(self.last_action),
+            jnp.asarray(self.last_reward),
+            self.carry,
+        )
+        q_np = np.asarray(q, np.float32)
+
+        # Deferred cuts: this call's Q is Q(s) for exactly the obs the cut
+        # needs to bootstrap from (block boundary, worker.py:726-732; or
+        # max_episode_steps truncation).
+        fresh = np.zeros(E, bool)  # slots starting a new episode this tick
+        for i in np.nonzero(self._pending_cut | self._pending_truncate)[0]:
+            block, prios, ep_reward = self.accs[i].finish(last_qval=q_np[i])
+            self.push_block(block, prios, ep_reward)
+            if self._pending_truncate[i]:
+                # new episode: fresh env state if the env supports mid-flight
+                # reset (host pools do; device envs with bounded episodes
+                # never truncate), zeroed carry/last-action/last-reward.
+                if hasattr(self.env, "force_reset"):
+                    self.obs[i] = self.env.force_reset(i)
+                self.last_action[i] = 0
+                self.last_reward[i] = 0.0
+                self.episode_steps[i] = 0
+                fresh[i] = True
+        self._pending_cut[:] = False
+        self._pending_truncate[:] = False
+
+        # ε-greedy over the ladder vector (reference worker.py:703-706).
+        # Fresh slots take a NOOP: their Q row was computed from the dead
+        # episode's obs, so this tick is absorbed as one extra no-op at
+        # episode start (same family as the noop-start wrapper) and not
+        # recorded; the accumulator is seeded with the post-step obs below.
+        greedy = q_np.argmax(axis=1)
+        explore = self.rng.random(E) < self.epsilons
+        random_a = self.rng.integers(0, self.action_dim, size=E)
+        actions = np.where(explore, random_a, greedy).astype(np.int32)
+        actions[fresh] = 0
+        term_obs, rewards, dones, next_obs = self.env.step(actions)
+
+        h, c = carry
+        hidden_np = np.stack([np.asarray(h), np.asarray(c)], axis=1)  # (E, 2, H)
+
+        keep = np.ones(E, np.float32)
+        for i in range(E):
+            if fresh[i]:
+                seed_obs = next_obs[i] if dones[i] else term_obs[i]
+                self.accs[i].reset(seed_obs)
+                self.obs[i] = seed_obs
+                keep[i] = 0.0
+                continue
+            self.accs[i].add(int(actions[i]), float(rewards[i]), term_obs[i], q_np[i], hidden_np[i])
+            self.episode_steps[i] += 1
+            if dones[i]:
+                block, prios, ep_reward = self.accs[i].finish(last_qval=None)
+                self.push_block(block, prios, ep_reward)
+                self.accs[i].reset(next_obs[i])
+                self.obs[i] = next_obs[i]
+                self.last_action[i] = 0
+                self.last_reward[i] = 0.0
+                self.episode_steps[i] = 0
+                keep[i] = 0.0
+            else:
+                self.obs[i] = term_obs[i]
+                self.last_action[i] = actions[i]
+                self.last_reward[i] = rewards[i]
+                if self.episode_steps[i] >= cfg.max_episode_steps:
+                    self._pending_truncate[i] = True
+                elif len(self.accs[i]) == cfg.block_length:
+                    self._pending_cut[i] = True
+
+        if not keep.all():
+            k = jnp.asarray(keep)[:, None]
+            self.carry = (h * k, c * k)
+        else:
+            self.carry = (h, c)
+
+        self.total_steps += E
+        self._steps_since_refresh += E
+        if self._steps_since_refresh >= cfg.actor_update_interval:
+            self._steps_since_refresh = 0
+            self._maybe_refresh_params()
+
+    # ---------------------------------------------------------------- utils
+
+    def _maybe_refresh_params(self) -> None:
+        params, version = self.param_store.latest()
+        if version != self.param_version:
+            self.params = params
+            self.param_version = version

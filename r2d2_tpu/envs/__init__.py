@@ -1,0 +1,29 @@
+"""Environment layer (L1).
+
+Three env families, one host-facing protocol (reset/step over numpy):
+
+- `atari`: gymnasium+ALE wrappers reproducing the reference's preprocessing
+  exactly (reference environment.py). Import-gated: ALE is optional.
+- `catch`: a pure-JAX, fully vectorizable control env rendered at the same
+  84x84x1 uint8 resolution as Atari, so the full Nature-CNN compute path is
+  exercised end-to-end on TPU with no emulator on the host.
+- `fake`: a deterministic scripted env giving exact expected values for
+  n-step/terminal math in tests (SURVEY.md section 4 'fake backends').
+"""
+
+from r2d2_tpu.envs.fake import ScriptedEnv
+from r2d2_tpu.envs.catch import CatchEnv, CatchVecEnv
+
+__all__ = ["ScriptedEnv", "CatchEnv", "CatchVecEnv", "make_env"]
+
+
+def make_env(cfg, seed: int = 0):
+    """Host-protocol env factory by cfg.env_name."""
+    name = cfg.env_name.lower()
+    if name == "catch":
+        return CatchVecEnv(num_envs=1, height=cfg.obs_shape[0], width=cfg.obs_shape[1], seed=seed)
+    if name == "scripted":
+        return ScriptedEnv(obs_shape=cfg.obs_shape, action_dim=cfg.action_dim)
+    from r2d2_tpu.envs.atari import create_atari_env  # gated import
+
+    return create_atari_env(cfg.env_name, noop_start=True, noop_max=cfg.noop_max, seed=seed)

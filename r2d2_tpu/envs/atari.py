@@ -1,0 +1,92 @@
+"""Atari (ALE) environment — reference-parity preprocessing, import-gated.
+
+Reproduces reference environment.py exactly:
+- `gym.make('ALE/{name}-v5', obs_type='grayscale', frameskip=4,
+  repeat_action_probability=0, full_action_space=False)`
+  (reference environment.py:78)
+- WarpFrame: cv2 INTER_AREA resize to 84x84 (environment.py:57-58) — but
+  channels-LAST (84, 84, 1) for the TPU conv layout.
+- NoopResetEnv: 1..noop_max random NOOPs on reset, asserting action 0 is
+  NOOP (environment.py:17,25); seeded RNG instead of the global stream
+  (SURVEY.md quirk 13).
+
+This module raises a clear error if ale_py/gymnasium are missing; nothing
+else in the framework imports it unconditionally.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import gymnasium as gym
+except ImportError as e:  # pragma: no cover
+    gym = None
+    _gym_err = e
+
+try:
+    import cv2
+except ImportError:  # pragma: no cover
+    cv2 = None
+
+
+class WarpFrame:
+    def __init__(self, env, width: int = 84, height: int = 84):
+        self.env = env
+        self._w, self._h = width, height
+        self.action_space = env.action_space
+        self.obs_shape = (height, width, 1)
+
+    def _warp(self, obs: np.ndarray) -> np.ndarray:
+        obs = cv2.resize(obs, (self._w, self._h), interpolation=cv2.INTER_AREA)
+        return obs[:, :, None].astype(np.uint8)
+
+    def reset(self):
+        obs, _info = self.env.reset()
+        return self._warp(obs)
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        return self._warp(obs), float(reward), bool(terminated or truncated), info
+
+
+class NoopReset:
+    def __init__(self, env, noop_max: int = 30, seed: int = 0):
+        self.env = env
+        self.noop_max = noop_max
+        self.action_space = env.action_space
+        self.obs_shape = env.obs_shape
+        self._rng = np.random.default_rng(seed)
+
+    def reset(self):
+        obs = self.env.reset()
+        for _ in range(int(self._rng.integers(1, self.noop_max + 1))):
+            obs, _r, done, _i = self.env.step(0)
+            if done:
+                obs = self.env.reset()
+        return obs
+
+    def step(self, action):
+        return self.env.step(action)
+
+
+def create_atari_env(env_name: str, noop_start: bool = True, noop_max: int = 30, seed: int = 0):
+    if gym is None:
+        raise ImportError(
+            "gymnasium is required for Atari envs; this image has none"
+        ) from _gym_err
+    if cv2 is None:
+        raise ImportError("cv2 is required for Atari frame warping")
+    env = gym.make(
+        f"ALE/{env_name}-v5",
+        obs_type="grayscale",
+        frameskip=4,
+        repeat_action_probability=0.0,
+        full_action_space=False,
+    )
+    meanings = env.unwrapped.get_action_meanings()
+    assert meanings[0] == "NOOP", meanings
+    env = WarpFrame(env)
+    if noop_start:
+        env = NoopReset(env, noop_max=noop_max, seed=seed)
+    return env

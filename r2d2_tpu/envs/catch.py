@@ -1,0 +1,120 @@
+"""Catch — a pure-JAX environment at Atari resolution.
+
+A ball falls from the top of an HxW grid; a paddle on the bottom row moves
+left/stay/right (action 0 is NOOP, matching the reference's NOOP-is-0
+assumption, reference environment.py:17). Catching pays +1, missing -1,
+episode ends when the ball reaches the paddle row.
+
+Why it exists: this image has no ALE, and the host has one CPU core — an
+emulator-based env can't feed a TPU. Catch renders 84x84x1 uint8 frames on
+DEVICE, so the full Nature-CNN + LSTM acting path runs at TPU speed and the
+whole actor loop is vmappable/jittable. The functional core
+(reset/step/render) is exposed for fully on-device rollout pipelines; the
+CatchVecEnv adapter speaks the host numpy protocol for the generic actor.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class CatchState(NamedTuple):
+    ball_x: jnp.ndarray   # int32
+    ball_y: jnp.ndarray   # int32
+    paddle_x: jnp.ndarray # int32
+    key: jnp.ndarray      # PRNG key
+
+
+class CatchEnv:
+    """Functional single-env core; every method is jit/vmap-safe."""
+
+    NUM_ACTIONS = 3  # 0 = NOOP, 1 = left, 2 = right
+
+    def __init__(self, height: int = 84, width: int = 84, paddle_width: int = 7, ball_size: int = 3):
+        self.h, self.w = height, width
+        self.pw = paddle_width
+        self.bs = ball_size
+
+    def reset(self, key: jax.Array) -> CatchState:
+        key, kx, kp = jax.random.split(key, 3)
+        ball_x = jax.random.randint(kx, (), 0, self.w)
+        paddle_x = jax.random.randint(kp, (), 0, self.w)
+        return CatchState(ball_x, jnp.zeros((), jnp.int32), paddle_x, key)
+
+    def render(self, s: CatchState) -> jnp.ndarray:
+        """(H, W, 1) uint8 frame: ball block + paddle strip at 255."""
+        ys = jnp.arange(self.h)[:, None]
+        xs = jnp.arange(self.w)[None, :]
+        ball = (jnp.abs(ys - s.ball_y) < self.bs) & (jnp.abs(xs - s.ball_x) < self.bs)
+        paddle = (ys >= self.h - 2) & (jnp.abs(xs - s.paddle_x) <= self.pw // 2)
+        frame = jnp.where(ball | paddle, 255, 0).astype(jnp.uint8)
+        return frame[:, :, None]
+
+    def step(self, s: CatchState, action: jnp.ndarray):
+        """Returns (state', reward, done). Terminal when the ball lands."""
+        dx = jnp.where(action == 1, -1, jnp.where(action == 2, 1, 0))
+        paddle_x = jnp.clip(s.paddle_x + dx * 2, 0, self.w - 1)
+        ball_y = s.ball_y + 1
+        done = ball_y >= self.h - 2
+        caught = jnp.abs(s.ball_x - paddle_x) <= self.pw // 2
+        reward = jnp.where(done, jnp.where(caught, 1.0, -1.0), 0.0)
+        return CatchState(s.ball_x, ball_y, paddle_x, s.key), reward, done
+
+
+class CatchVecEnv:
+    """Host-protocol adapter: E vectorized Catch envs stepped in one jitted
+    call, with device-side auto-reset. step() returns the terminal frame
+    (for replay parity with the reference) plus the fresh-episode frame to
+    seed the next accumulator window."""
+
+    def __init__(self, num_envs: int = 1, height: int = 84, width: int = 84, seed: int = 0):
+        self.env = CatchEnv(height, width)
+        self.num_envs = num_envs
+        self.action_dim = CatchEnv.NUM_ACTIONS
+        self.obs_shape = (height, width, 1)
+        self._seed = seed
+        self._reset_count = 0
+        self._vreset = jax.jit(jax.vmap(self.env.reset))
+        self._state = self._vreset(jax.random.split(jax.random.PRNGKey(seed), num_envs))
+
+        @jax.jit
+        def _vstep(state: CatchState, actions: jnp.ndarray):
+            def one(s, a):
+                s2, reward, done = self.env.step(s, a)
+                term_obs = self.env.render(s2)
+                key, sub = jax.random.split(s2.key)
+                fresh = self.env.reset(sub)
+                fresh = fresh._replace(key=key)
+                nxt = jax.tree.map(lambda f, o: jnp.where(done, f, o), fresh, s2)
+                return nxt, term_obs, reward, done, self.env.render(nxt)
+
+            return jax.vmap(one)(state, actions)
+
+        self._vstep = _vstep
+        self._vrender = jax.jit(jax.vmap(self.env.render))
+
+    def reset_all(self) -> np.ndarray:
+        """Start fresh episodes in every slot (same contract as
+        HostEnvPool.reset_all: mid-episode state is discarded)."""
+        self._reset_count += 1
+        keys = jax.random.split(
+            jax.random.PRNGKey(self._seed + self._reset_count * 1_000_003), self.num_envs
+        )
+        self._state = self._vreset(keys)
+        return np.asarray(self._vrender(self._state))
+
+    def step(self, actions: np.ndarray):
+        self._state, term_obs, reward, done, next_obs = self._vstep(
+            self._state, jnp.asarray(actions, jnp.int32)
+        )
+        return (
+            np.asarray(term_obs),
+            np.asarray(reward, np.float64),
+            np.asarray(done),
+            np.asarray(next_obs),
+        )

@@ -1,0 +1,106 @@
+"""Offline evaluation (L6) — the reference test.py equivalent.
+
+Walks the checkpoint series, runs N near-greedy episodes per checkpoint
+(epsilon = cfg.test_epsilon = 0.001, reference test.py:18,32, config.py:37),
+and emits the learning curve as jsonl (reward vs env frames = env_steps x 4
+and vs wall-clock hours, the reference's two plot axes, test.py:28-29).
+Episodes run as a vectorized batch instead of the reference's 5-process
+pool (test.py:18).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.config import PRESETS, R2D2Config
+from r2d2_tpu.learner import init_train_state
+from r2d2_tpu.utils.checkpoint import list_checkpoint_steps, restore_checkpoint
+
+
+def evaluate_params(
+    cfg: R2D2Config,
+    net,
+    params,
+    vec_env,
+    seed: int = 0,
+    max_steps: Optional[int] = None,
+) -> float:
+    """Mean episodic reward over one episode per env slot."""
+    E = vec_env.num_envs
+    rng = np.random.default_rng(seed)
+    policy = jax.jit(lambda p, o, la, lr, c: net.apply(p, o, la, lr, c, method=net.act))
+
+    obs = vec_env.reset_all()
+    last_action = np.zeros(E, np.int32)
+    last_reward = np.zeros(E, np.float32)
+    carry = (
+        jnp.zeros((E, cfg.hidden_dim), jnp.float32),
+        jnp.zeros((E, cfg.hidden_dim), jnp.float32),
+    )
+    ep_reward = np.zeros(E)
+    finished = np.zeros(E, bool)
+    steps = 0
+    max_steps = max_steps or cfg.max_episode_steps
+
+    while not finished.all() and steps < max_steps:
+        q, carry = policy(params, jnp.asarray(obs), jnp.asarray(last_action), jnp.asarray(last_reward), carry)
+        q_np = np.asarray(q)
+        greedy = q_np.argmax(1)
+        explore = rng.random(E) < cfg.test_epsilon
+        actions = np.where(explore, rng.integers(0, cfg.action_dim, E), greedy).astype(np.int32)
+        term_obs, rewards, dones, next_obs = vec_env.step(actions)
+        ep_reward += np.where(finished, 0.0, rewards)
+        finished |= dones
+        obs = term_obs
+        last_action, last_reward = actions, rewards.astype(np.float32)
+        steps += 1
+    return float(ep_reward.mean())
+
+
+def evaluate_series(cfg: R2D2Config, vec_env, out_path: Optional[str] = None, seed: int = 0):
+    """Reference test.py:14-58 equivalent over the orbax series."""
+    net, template = init_train_state(cfg, jax.random.PRNGKey(0))
+    rows = []
+    for step in list_checkpoint_steps(cfg.checkpoint_dir):
+        state, env_steps, wall_minutes = restore_checkpoint(cfg.checkpoint_dir, template, step)
+        reward = evaluate_params(cfg, net, state.params, vec_env, seed=seed)
+        row = {
+            "step": step,
+            "env_steps": env_steps,
+            "env_frames": env_steps * 4,  # frameskip semantics (test.py:28,36)
+            "hours": wall_minutes / 60.0,
+            "mean_reward": reward,
+        }
+        rows.append(row)
+        print(json.dumps(row))
+    if out_path:
+        with open(out_path, "w") as fh:
+            for row in rows:
+                fh.write(json.dumps(row) + "\n")
+    return rows
+
+
+def main(argv=None):
+    from r2d2_tpu.train import build_vec_env
+
+    p = argparse.ArgumentParser(description="r2d2_tpu checkpoint-series evaluator")
+    p.add_argument("--preset", default="atari", choices=sorted(PRESETS))
+    p.add_argument("--env", default=None)
+    p.add_argument("--out", default=None)
+    args = p.parse_args(argv)
+    cfg = PRESETS[args.preset]()
+    if args.env:
+        cfg = cfg.replace(env_name=args.env)
+    vec_env = build_vec_env(cfg, seed=123)
+    cfg = cfg.replace(action_dim=vec_env.action_dim)
+    evaluate_series(cfg, vec_env, out_path=args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""Model layer (L2): flax networks compiled by XLA for the TPU MXU.
+
+The reference's Network (reference model.py:35-188) exposes three forwards:
+single-step acting, full-sequence target Q, and burn-in+learning Q. Here one
+flax module exposes `act` (batched single step) and `unroll` (lax.scan over
+the padded fixed-length sequence) — and `unroll` returns BOTH gather views
+(learning-window Q and bootstrap-window Q) from a single LSTM pass, because
+they differ only in output indexing. That collapses the reference's
+3 conv + 3 LSTM evaluations per update to 2 + 2.
+"""
+
+from r2d2_tpu.models.encoders import ImpalaEncoder, MLPEncoder, NatureEncoder
+from r2d2_tpu.models.lstm import LSTM
+from r2d2_tpu.models.r2d2 import R2D2Network
+
+__all__ = ["NatureEncoder", "ImpalaEncoder", "MLPEncoder", "LSTM", "R2D2Network"]

@@ -1,0 +1,88 @@
+"""Observation encoders.
+
+All encoders take NHWC uint8-normalized float input (channels-last is the
+TPU-native conv layout — no NCHW transpose before the MXU) and emit a flat
+latent of `latent_dim` features.
+
+- NatureEncoder: the Nature-DQN trunk used by the reference
+  (reference model.py:47-57): Conv 32x8x8/4 -> 64x4x4/2 -> 64x3x3/1 ->
+  Dense(512), ReLU, VALID padding. 84x84x1 -> 7x7x64 = 3136 -> 512.
+- ImpalaEncoder: the IMPALA-ResNet stack (Espeholt et al. 2018) for the
+  Procgen preset (BASELINE.json config 4).
+- MLPEncoder: tiny trunk for unit tests.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class NatureEncoder(nn.Module):
+    latent_dim: int = 512
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (8, 8), strides=(4, 4), padding="VALID", dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(64, (4, 4), strides=(2, 2), padding="VALID", dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(64, (3, 3), strides=(1, 1), padding="VALID", dtype=self.dtype)(x))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.latent_dim, dtype=self.dtype)(x))
+        return x
+
+
+class ResidualBlock(nn.Module):
+    channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        y = nn.relu(x)
+        y = nn.Conv(self.channels, (3, 3), padding="SAME", dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.channels, (3, 3), padding="SAME", dtype=self.dtype)(y)
+        return x + y
+
+
+class ImpalaEncoder(nn.Module):
+    latent_dim: int = 512
+    channels: Sequence[int] = (16, 32, 32)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        for ch in self.channels:
+            x = nn.Conv(ch, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            x = ResidualBlock(ch, dtype=self.dtype)(x)
+            x = ResidualBlock(ch, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.latent_dim, dtype=self.dtype)(x))
+        return x
+
+
+class MLPEncoder(nn.Module):
+    latent_dim: int = 32
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.dtype).reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.latent_dim, dtype=self.dtype)(x))
+        return x
+
+
+def make_encoder(name: str, latent_dim: int, dtype, impala_channels=(16, 32, 32)):
+    if name == "nature":
+        return NatureEncoder(latent_dim=latent_dim, dtype=dtype)
+    if name == "impala":
+        return ImpalaEncoder(latent_dim=latent_dim, channels=tuple(impala_channels), dtype=dtype)
+    if name == "mlp":
+        return MLPEncoder(latent_dim=latent_dim, dtype=dtype)
+    raise ValueError(f"unknown encoder {name!r}")

@@ -1,0 +1,112 @@
+"""Hand-rolled LSTM under lax.scan.
+
+XLA has no cuDNN-style packed-sequence LSTM (the reference leans on
+`pack_padded_sequence`, reference model.py:133); instead sequences are
+fixed-shape and padded, the recurrence runs the full length, and output
+gathers with clamped indices reproduce the variable-length semantics
+(see models/r2d2.py).
+
+TPU-first structure: the input projection x @ Wi for ALL timesteps is one
+big (B*T, D) x (D, 4H) matmul — large, batched, MXU-friendly — so the
+sequential scan body is only the (B, H) x (H, 4H) recurrent matmul plus
+elementwise gates. For long-context configs the scan is chunked and each
+chunk rematerialized (jax.checkpoint), trading FLOPs for HBM
+(SURVEY.md section 5.7: an RNN recurrence parallelizes over batch, never
+over time).
+
+Gate order follows i, f, g, o. Weights use the same uniform(-1/sqrt(H),
+1/sqrt(H)) scale family as the reference's recurrent core so Q-value
+magnitudes start in a comparable regime.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Carry = Tuple[jnp.ndarray, jnp.ndarray]  # (h, c), each (B, H)
+
+
+def _uniform_init(scale):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -scale, scale)
+
+    return init
+
+
+class LSTM(nn.Module):
+    hidden_dim: int
+    in_dim: int
+    dtype: jnp.dtype = jnp.float32
+    # remat chunk length for long unrolls; None = single un-remat'd scan
+    scan_chunk: Optional[int] = None
+
+    def setup(self):
+        H = self.hidden_dim
+        scale = 1.0 / np.sqrt(H)
+        self.wi = self.param("wi", _uniform_init(scale), (self.in_dim, 4 * H))
+        self.wh = self.param("wh", _uniform_init(scale), (H, 4 * H))
+        self.b = self.param("b", _uniform_init(scale), (4 * H,))
+
+    def _params(self):
+        return self.wi, self.wh, self.b
+
+    def _gates(self, proj: jnp.ndarray, h: jnp.ndarray, wh: jnp.ndarray, c: jnp.ndarray):
+        H = self.hidden_dim
+        z = proj + h @ wh
+        i = jax.nn.sigmoid(z[..., :H])
+        f = jax.nn.sigmoid(z[..., H : 2 * H])
+        g = jnp.tanh(z[..., 2 * H : 3 * H])
+        o = jax.nn.sigmoid(z[..., 3 * H :])
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+
+    def __call__(self, xs: jnp.ndarray, carry: Carry) -> Tuple[jnp.ndarray, Carry]:
+        """Unroll over (B, T, D) inputs from carry; returns (B, T, H) + carry."""
+        B, T, D = xs.shape
+        wi, wh, b = self._params()
+        xs = xs.astype(self.dtype)
+        wi, wh, b = wi.astype(self.dtype), wh.astype(self.dtype), b.astype(self.dtype)
+        h, c = carry
+        h, c = h.astype(self.dtype), c.astype(self.dtype)
+
+        # one MXU-sized matmul for every timestep's input projection
+        proj = (xs.reshape(B * T, D) @ wi + b).reshape(B, T, 4 * self.hidden_dim)
+        proj_t = jnp.swapaxes(proj, 0, 1)  # (T, B, 4H) time-major for scan
+
+        def step(carry, p):
+            h, c = carry
+            h, c = self._gates(p, h, wh, c)
+            return (h, c), h
+
+        if self.scan_chunk is None or T <= self.scan_chunk:
+            (h, c), outs = jax.lax.scan(step, (h, c), proj_t)
+        else:
+            chunk = self.scan_chunk
+            if T % chunk != 0:
+                raise ValueError(f"seq len {T} not divisible by scan_chunk {chunk}")
+
+            @jax.checkpoint
+            def run_chunk(carry, p_chunk):
+                return jax.lax.scan(step, carry, p_chunk)
+
+            p_chunks = proj_t.reshape(T // chunk, chunk, B, 4 * self.hidden_dim)
+            (h, c), outs = jax.lax.scan(run_chunk, (h, c), p_chunks)
+            outs = outs.reshape(T, B, self.hidden_dim)
+
+        return jnp.swapaxes(outs, 0, 1), (h, c)
+
+    def step(self, x: jnp.ndarray, carry: Carry) -> Tuple[jnp.ndarray, Carry]:
+        """Single acting step on (B, D) input (reference model.py:83)."""
+        wi, wh, b = self._params()
+        x = x.astype(self.dtype)
+        wi, wh, b = wi.astype(self.dtype), wh.astype(self.dtype), b.astype(self.dtype)
+        h, c = carry
+        proj = x @ wi + b
+        h_new, c_new = self._gates(proj, h.astype(self.dtype), wh, c.astype(self.dtype))
+        return h_new, (h_new, c_new)

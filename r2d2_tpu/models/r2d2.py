@@ -1,0 +1,174 @@
+"""R2D2Network — recurrent dueling double-DQN trunk (L2).
+
+Capability parity with the reference Network (reference model.py:35-188):
+
+- conv/mlp encoder -> LSTM over concat(latent, one-hot last action, last
+  reward) -> dueling heads, Q = V + A - mean(A) (model.py:59,80,94).
+- `act`: batched single-step acting forward (model.py:73-97, vectorized
+  over envs instead of the reference's one-env unbatched call).
+- `unroll`: the fixed-shape replacement for BOTH `calculate_q_`
+  (model.py:99-158) and `calculate_q` (model.py:161-188). One lax.scan LSTM
+  pass over the padded burn_in+learning+forward window, then two clamped
+  index gathers:
+
+    learning view   idx(t) = burn_in + t                     (model.py:182)
+    bootstrap view  idx(t) = min(burn_in + F_max + t,
+                               burn_in + learning + forward - 1)
+
+  The min() reproduces `calculate_q_`'s edge-repeat padding exactly: the
+  reference slices [burn_in+F_max : seq_end) and repeats the last output
+  min(F_max - forward, learning) times (model.py:141-150); clamping the
+  gather index at seq_end-1 is the same function, with no ragged Python
+  loop. A (B, L) validity mask replaces `pack_padded_sequence`.
+
+Both Q views come from ONE LSTM pass per network, so a learner update costs
+2 conv + 2 LSTM evaluations (online, target) instead of the reference's
+3 + 3 (worker.py:404-415).
+
+Obs enter as uint8 and are normalized exactly once, here (SURVEY.md
+quirk 15). Head math runs in float32 regardless of compute dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.models.encoders import make_encoder
+from r2d2_tpu.models.lstm import LSTM, Carry
+
+
+class R2D2Network(nn.Module):
+    action_dim: int
+    hidden_dim: int = 512
+    learning_steps: int = 40
+    forward_steps: int = 5
+    encoder: str = "nature"
+    compute_dtype: str = "float32"
+    impala_channels: Tuple[int, ...] = (16, 32, 32)
+    scan_chunk: int | None = None
+
+    @classmethod
+    def from_config(cls, cfg: R2D2Config) -> "R2D2Network":
+        return cls(
+            action_dim=cfg.action_dim,
+            hidden_dim=cfg.hidden_dim,
+            learning_steps=cfg.learning_steps,
+            forward_steps=cfg.forward_steps,
+            encoder=cfg.encoder,
+            compute_dtype=cfg.compute_dtype,
+            impala_channels=tuple(cfg.impala_channels),
+            scan_chunk=cfg.scan_chunk,
+        )
+
+    def setup(self):
+        dtype = jnp.dtype(self.compute_dtype)
+        self.enc = make_encoder(self.encoder, self.hidden_dim, dtype, self.impala_channels)
+        # LSTM input = concat(latent, one-hot action, reward) (model.py:59)
+        core_in = self.hidden_dim + self.action_dim + 1
+        self.core = LSTM(self.hidden_dim, in_dim=core_in, dtype=dtype, scan_chunk=self.scan_chunk)
+        self.adv_hidden = nn.Dense(self.hidden_dim)
+        self.adv_out = nn.Dense(self.action_dim)
+        self.val_hidden = nn.Dense(self.hidden_dim)
+        self.val_out = nn.Dense(1)
+
+    # ----------------------------------------------------------------- util
+
+    def _core_input(self, obs, last_action, last_reward):
+        """(N, *obs) uint8, (N,) int, (N,) float -> (N, latent+A+1)."""
+        dtype = jnp.dtype(self.compute_dtype)
+        x = obs.astype(dtype) / 255.0
+        latent = self.enc(x)
+        onehot = jax.nn.one_hot(last_action, self.action_dim, dtype=dtype)
+        reward = last_reward.astype(dtype)[:, None]
+        return jnp.concatenate([latent, onehot, reward], axis=-1)
+
+    def _dueling(self, h: jnp.ndarray) -> jnp.ndarray:
+        """Dueling Q in float32: Q = V + A - mean_a A (model.py:94)."""
+        h = h.astype(jnp.float32)
+        adv = self.adv_out(nn.relu(self.adv_hidden(h)))
+        val = self.val_out(nn.relu(self.val_hidden(h)))
+        return val + adv - adv.mean(axis=-1, keepdims=True)
+
+    # ------------------------------------------------------------------ act
+
+    def act(
+        self,
+        obs: jnp.ndarray,          # (B, *obs_shape) uint8
+        last_action: jnp.ndarray,  # (B,) int32
+        last_reward: jnp.ndarray,  # (B,) float32
+        carry: Carry,              # ((B, H), (B, H))
+    ) -> Tuple[jnp.ndarray, Carry]:
+        x = self._core_input(obs, last_action, last_reward)
+        h, carry = self.core.step(x, carry)
+        return self._dueling(h), carry
+
+    # --------------------------------------------------------------- unroll
+
+    def unroll(
+        self,
+        obs: jnp.ndarray,           # (B, T, *obs_shape) uint8
+        last_action: jnp.ndarray,   # (B, T) int32
+        last_reward: jnp.ndarray,   # (B, T) float32
+        hidden: jnp.ndarray,        # (B, 2, H) stored (h, c)
+        burn_in: jnp.ndarray,       # (B,) int32
+        learning: jnp.ndarray,      # (B,) int32
+        forward: jnp.ndarray,       # (B,) int32
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Returns (q_learn (B,L,A), q_boot (B,L,A), mask (B,L) f32)."""
+        B, T = obs.shape[:2]
+        L, F = self.learning_steps, self.forward_steps
+
+        x = self._core_input(
+            obs.reshape(B * T, *obs.shape[2:]),
+            last_action.reshape(B * T),
+            last_reward.reshape(B * T),
+        ).reshape(B, T, -1)
+
+        carry = (hidden[:, 0], hidden[:, 1])
+        outs, _ = self.core(x, carry)  # (B, T, H)
+
+        t = jnp.arange(L, dtype=jnp.int32)
+        learn_idx = jnp.clip(burn_in[:, None] + t[None, :], 0, T - 1)
+        seq_end = burn_in + learning + forward  # (B,)
+        boot_idx = jnp.minimum(burn_in[:, None] + F + t[None, :], seq_end[:, None] - 1)
+        boot_idx = jnp.clip(boot_idx, 0, T - 1)
+
+        learn_h = jnp.take_along_axis(outs, learn_idx[:, :, None], axis=1)
+        boot_h = jnp.take_along_axis(outs, boot_idx[:, :, None], axis=1)
+
+        q_learn = self._dueling(learn_h)
+        q_boot = self._dueling(boot_h)
+        mask = (t[None, :] < learning[:, None]).astype(jnp.float32)
+        return q_learn, q_boot, mask
+
+    def __call__(self, obs, last_action, last_reward, hidden, burn_in, learning, forward):
+        return self.unroll(obs, last_action, last_reward, hidden, burn_in, learning, forward)
+
+
+def initial_carry(batch: int, hidden_dim: int) -> Carry:
+    """Zero (h, c) — the episode-start state (reference worker.py:502)."""
+    return (
+        jnp.zeros((batch, hidden_dim), jnp.float32),
+        jnp.zeros((batch, hidden_dim), jnp.float32),
+    )
+
+
+def init_params(rng: jax.Array, cfg: R2D2Config):
+    """Initialize parameters with dummy fixed-shape unroll inputs."""
+    net = R2D2Network.from_config(cfg)
+    B, T = 2, cfg.seq_len
+    obs = jnp.zeros((B, T, *cfg.obs_shape), jnp.uint8)
+    la = jnp.zeros((B, T), jnp.int32)
+    lr = jnp.zeros((B, T), jnp.float32)
+    hid = jnp.zeros((B, 2, cfg.hidden_dim), jnp.float32)
+    ones = jnp.ones((B,), jnp.int32)
+    params = net.init(
+        rng, obs, la, lr, hid, ones * cfg.burn_in_steps, ones * cfg.learning_steps, ones * cfg.forward_steps
+    )
+    return net, params

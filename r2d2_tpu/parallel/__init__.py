@@ -1,0 +1,18 @@
+"""Parallelism layer: device meshes, shardings, collectives.
+
+The reference's only parallelism is OS processes + queues on one host
+(SURVEY.md section 2.3). Here distribution is expressed the TPU way: a
+`jax.sharding.Mesh` with named axes, sharding annotations on the jitted
+learner step, and XLA-inserted collectives (psum all-reduce for gradients)
+riding ICI — no NCCL/MPI analogue is needed because the compiler owns the
+communication schedule.
+"""
+
+from r2d2_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    replicated_sharding,
+    shard_batch,
+)
+
+__all__ = ["make_mesh", "batch_sharding", "replicated_sharding", "shard_batch"]

@@ -1,0 +1,49 @@
+"""Mesh construction and sharding rules.
+
+Axes:
+  dp — data parallel: the learner batch splits across this axis; gradient
+       all-reduce (psum) is inserted by XLA because params are replicated.
+  tp — tensor parallel: reserved for sharding wide kernels (impala encoder,
+       LSTM 4H projections) at model scales where it pays; at R2D2's model
+       size params stay replicated, but the axis exists so a tp>1 config is
+       expressible without restructuring (SURVEY.md section 2.3 TP row).
+
+Batches shard their leading (batch) dimension over dp; everything else is
+replicated. With params replicated and batch sharded, jit emits a psum over
+dp for the gradients — data parallelism without hand-written collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    dp: Optional[int] = None, tp: int = 1, devices: Optional[Sequence] = None
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if dp is None:
+        dp = len(devices) // tp
+    if dp * tp != len(devices):
+        raise ValueError(f"dp*tp = {dp * tp} != {len(devices)} devices")
+    dev_array = np.asarray(devices).reshape(dp, tp)
+    return Mesh(dev_array, axis_names=("dp", "tp"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading axis over dp, rest replicated."""
+    return NamedSharding(mesh, P("dp"))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch_pytree):
+    """device_put every leaf with its batch dim sharded over dp."""
+    sh = batch_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch_pytree)

@@ -48,8 +48,9 @@ class SequenceAccumulator:
 
     def reset(self, init_obs: np.ndarray) -> None:
         """Seed the episode: NOOP last-action, zero reward, zero hidden
-        (reference worker.py:488-509)."""
-        self.obs_buf: List[np.ndarray] = [np.asarray(init_obs)]
+        (reference worker.py:488-509). Observations are COPIED: callers may
+        hand in views of buffers they mutate in place later."""
+        self.obs_buf: List[np.ndarray] = [np.array(init_obs)]
         self.last_action_buf: List[int] = [0]
         self.last_reward_buf: List[float] = [0.0]
         self.hidden_buf: List[np.ndarray] = [
@@ -77,7 +78,7 @@ class SequenceAccumulator:
         self.action_buf.append(int(action))
         self.reward_buf.append(float(reward))
         self.hidden_buf.append(np.asarray(hidden, dtype=np.float32))
-        self.obs_buf.append(np.asarray(next_obs))
+        self.obs_buf.append(np.array(next_obs))  # copy: see reset()
         self.last_action_buf.append(int(action))
         self.last_reward_buf.append(float(reward))
         self.qval_buf.append(np.asarray(q_value, dtype=np.float32))

@@ -1,0 +1,238 @@
+"""Training orchestrator (L5) and CLI (L6).
+
+Reference topology (reference train.py:29-62): 8 actor processes + a replay
+process (3 service threads) + the learner in the main process, wired by
+pickling mp.Queues. On TPU the device does the heavy lifting in two jitted
+functions (act, train_step), so the host side collapses to threads sharing
+the replay object directly — no pickling, no process forks (and it must:
+this class of host has few cores; SURVEY.md section 5.8 maps the reference's
+3 queues onto (a) direct add_block calls, (b) an in-memory prefetch queue of
+device-resident batches, (c) a direct update_priorities call).
+
+Two modes:
+- inline: strict actor/learner alternation in one thread — the minimum
+  end-to-end slice of SURVEY.md section 7.2, used by integration tests.
+- threaded: actor thread + sampler/prefetch thread + learner loop, with the
+  reference's backpressure depth (batch queue 8: train.py:35).
+
+Cadences preserved (SURVEY.md section 2.6): publish weights every 4
+updates, actor pull every 400 env steps, target sync every 2000 (inside the
+jitted step), checkpoint every 500, stop at training_steps, sampling gated
+on learning_starts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from r2d2_tpu.actor import HostEnvPool, ParamStore, VectorizedActor
+from r2d2_tpu.config import PRESETS, R2D2Config, tiny_test
+from r2d2_tpu.envs import make_env
+from r2d2_tpu.envs.catch import CatchVecEnv
+from r2d2_tpu.learner import DeviceBatch, init_train_state, make_train_step
+from r2d2_tpu.ops.epsilon import epsilon_ladder
+from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+from r2d2_tpu.utils.checkpoint import latest_checkpoint_step, restore_checkpoint, save_checkpoint
+from r2d2_tpu.utils.metrics import MetricsLogger
+
+
+def build_vec_env(cfg: R2D2Config, seed: int = 0):
+    """One vectorized env spanning cfg.num_actors slots."""
+    name = cfg.env_name.lower()
+    if name == "catch":
+        return CatchVecEnv(
+            num_envs=cfg.num_actors, height=cfg.obs_shape[0], width=cfg.obs_shape[1], seed=seed
+        )
+    return HostEnvPool([make_env(cfg, seed=seed + i) for i in range(cfg.num_actors)])
+
+
+class Trainer:
+    def __init__(
+        self,
+        cfg: R2D2Config,
+        vec_env=None,
+        resume: bool = False,
+        metrics: Optional[MetricsLogger] = None,
+    ):
+        self.cfg = cfg
+        self.vec_env = vec_env if vec_env is not None else build_vec_env(cfg, seed=cfg.seed)
+        if self.vec_env.action_dim != cfg.action_dim:
+            cfg = cfg.replace(action_dim=self.vec_env.action_dim)
+            self.cfg = cfg
+
+        self.net, self.state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
+        self.env_steps_offset = 0
+        self.wall_minutes_offset = 0.0
+        if resume and latest_checkpoint_step(cfg.checkpoint_dir) is not None:
+            self.state, self.env_steps_offset, self.wall_minutes_offset = restore_checkpoint(
+                cfg.checkpoint_dir, self.state
+            )
+
+        self.replay = ReplayBuffer(cfg)
+        self.param_store = ParamStore(self.state.params)
+        self.actor = VectorizedActor(
+            cfg,
+            self.net,
+            self.param_store,
+            self.vec_env,
+            epsilon_ladder(cfg.num_actors, cfg.base_eps, cfg.eps_alpha),
+            self.replay.add_block,
+            seed=cfg.seed + 1,
+        )
+        self.train_step = make_train_step(cfg, self.net)
+        self.sample_rng = np.random.default_rng(cfg.seed + 2)
+        self.metrics = metrics or MetricsLogger(cfg.metrics_path, cfg.log_interval)
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- plumbing
+
+    def _one_update(self, dev_batch: DeviceBatch, idxes, old_ptr):
+        self.state, m, priorities = self.train_step(self.state, dev_batch)
+        self.replay.update_priorities(idxes, np.asarray(priorities), old_ptr)
+        step = int(self.state.step)
+        if step % self.cfg.publish_interval == 0:
+            self.param_store.publish(self.state.params)
+        if step % self.cfg.save_interval == 0:
+            save_checkpoint(
+                self.cfg.checkpoint_dir,
+                self.state,
+                self.replay.env_steps + self.env_steps_offset,
+                self.wall_minutes_offset + (time.time() - self._start_time) / 60.0,
+            )
+        return m, step
+
+    def _log(self, m, step):
+        n_ep, r_sum = self.replay.pop_episode_stats()
+        self.metrics.log(
+            {
+                "step": step,
+                "env_steps": self.replay.env_steps + self.env_steps_offset,
+                "replay_size": len(self.replay),
+                "loss": float(m["loss"]),
+                "q_mean": float(m["q_mean"]),
+                "episodes": n_ep,
+                "mean_return": (r_sum / n_ep) if n_ep else None,
+            }
+        )
+
+    # ---------------------------------------------------------------- modes
+
+    def warmup(self, max_steps: Optional[int] = None) -> None:
+        """Collect until sampling opens (reference worker.py:150)."""
+        steps = 0
+        while not self.replay.can_sample():
+            self.actor.step()
+            steps += self.vec_env.num_envs
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError("warmup exceeded max_steps without filling replay")
+
+    def run_inline(self, env_steps_per_update: Optional[int] = None) -> None:
+        """Strict alternation: k env steps, one update (SURVEY.md 7.2)."""
+        cfg = self.cfg
+        self._start_time = time.time()
+        k = env_steps_per_update or max(cfg.num_actors, 1)
+        self.warmup()
+        while int(self.state.step) < cfg.training_steps:
+            for _ in range(max(k // self.vec_env.num_envs, 1)):
+                self.actor.step()
+            batch = self.replay.sample_batch(self.sample_rng)
+            dev = DeviceBatch.from_sampled(batch)
+            m, step = self._one_update(dev, batch.idxes, batch.old_ptr)
+            self._log(m, step)
+
+    def run_threaded(self) -> None:
+        """Actor thread + prefetch thread + learner loop (reference
+        worker.py:110-175,364-371 collapsed into shared memory)."""
+        cfg = self.cfg
+        self._start_time = time.time()
+        self.warmup()
+
+        batch_q: "queue.Queue" = queue.Queue(maxsize=8)
+        self._thread_error: Optional[BaseException] = None
+
+        def _guard(fn):
+            def run():
+                try:
+                    fn()
+                except BaseException as e:  # surface worker failures
+                    self._thread_error = e
+                    self._stop.set()
+
+            return run
+
+        def actor_loop():
+            while not self._stop.is_set():
+                self.actor.step()
+
+        def sampler_loop():
+            while not self._stop.is_set():
+                b = self.replay.sample_batch(self.sample_rng)
+                dev = DeviceBatch.from_sampled(b)  # device_put off the hot loop
+                while not self._stop.is_set():
+                    try:
+                        batch_q.put((dev, b.idxes, b.old_ptr), timeout=0.5)
+                        break
+                    except queue.Full:
+                        pass
+
+        threads = [
+            threading.Thread(target=_guard(actor_loop), daemon=True),
+            threading.Thread(target=_guard(sampler_loop), daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        try:
+            while int(self.state.step) < cfg.training_steps:
+                try:
+                    dev, idxes, old_ptr = batch_q.get(timeout=2.0)
+                except queue.Empty:
+                    if self._thread_error is not None:
+                        raise RuntimeError("worker thread failed") from self._thread_error
+                    continue
+                m, step = self._one_update(dev, idxes, old_ptr)
+                self._log(m, step)
+            if self._thread_error is not None:
+                raise RuntimeError("worker thread failed") from self._thread_error
+        finally:
+            self._stop.set()
+            for t in threads:
+                t.join(timeout=5.0)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="r2d2_tpu trainer")
+    p.add_argument("--preset", default="atari", choices=sorted(PRESETS))
+    p.add_argument("--env", default=None, help="override env name (e.g. catch)")
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--mode", default="threaded", choices=["threaded", "inline"])
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--metrics", default=None)
+    args = p.parse_args(argv)
+
+    cfg = PRESETS[args.preset]()
+    overrides = {}
+    if args.env:
+        overrides["env_name"] = args.env
+    if args.steps:
+        overrides["training_steps"] = args.steps
+    if args.metrics:
+        overrides["metrics_path"] = args.metrics
+    if overrides:
+        cfg = cfg.replace(**overrides)
+
+    trainer = Trainer(cfg, resume=args.resume)
+    if args.mode == "inline":
+        trainer.run_inline()
+    else:
+        trainer.run_threaded()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+"""Infrastructure utilities: checkpointing, metrics, profiling."""
+
+from r2d2_tpu.utils.checkpoint import (
+    latest_checkpoint_step,
+    list_checkpoint_steps,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from r2d2_tpu.utils.metrics import MetricsLogger
+
+__all__ = [
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_checkpoint_step",
+    "list_checkpoint_steps",
+    "MetricsLogger",
+]

@@ -1,0 +1,40 @@
+"""Structured metrics (SURVEY.md section 5.5 rebuild).
+
+The reference logs via print() from the buffer process every 10 s
+(reference worker.py:124-146). Here every record is a structured dict
+written as one jsonl line (machine-readable learning curves) and mirrored
+to stdout at a throttled cadence.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Dict, Optional
+
+
+class MetricsLogger:
+    def __init__(self, path: Optional[str] = None, stdout_interval: float = 10.0):
+        self.path = path
+        self._fh = open(path, "a", buffering=1) if path else None
+        self.stdout_interval = stdout_interval
+        self._last_print = 0.0
+
+    def log(self, record: Dict[str, Any], force_print: bool = False) -> None:
+        record = {"ts": time.time(), **record}
+        if self._fh:
+            self._fh.write(json.dumps(record, default=float) + "\n")
+        now = time.time()
+        if force_print or now - self._last_print >= self.stdout_interval:
+            parts = " ".join(
+                f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in record.items()
+                if k != "ts"
+            )
+            print(parts, file=sys.stderr)
+            self._last_print = now
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()

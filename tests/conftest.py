@@ -1,8 +1,11 @@
-"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports.
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax is used.
 
 This is the distributed-without-a-cluster strategy from SURVEY.md section 4:
 pjit/shard_map collectives run on 8 fake CPU devices, so multi-chip sharding
 is validated on any host.
+
+Note: the axon TPU plugin in this image ignores the JAX_PLATFORMS env var,
+so the platform is also pinned via jax.config (which does take effect).
 """
 
 import os
@@ -11,3 +14,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

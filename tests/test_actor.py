@@ -1,0 +1,95 @@
+"""VectorizedActor tests: block production, terminal/truncation handling,
+carry resets, obs-aliasing regression, param refresh."""
+
+import jax
+import numpy as np
+
+from r2d2_tpu.actor import HostEnvPool, ParamStore, VectorizedActor
+from r2d2_tpu.config import tiny_test
+from r2d2_tpu.envs.fake import ScriptedEnv
+from r2d2_tpu.learner import init_train_state
+from r2d2_tpu.ops.epsilon import epsilon_ladder
+
+
+def build_actor(cfg, episode_len=9, push=None, num_envs=2):
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    store = ParamStore(state.params)
+    pool = HostEnvPool(
+        [ScriptedEnv(obs_shape=cfg.obs_shape, action_dim=cfg.action_dim, episode_len=episode_len)
+         for _ in range(num_envs)]
+    )
+    pushed = []
+    actor = VectorizedActor(
+        cfg, net, store, pool,
+        epsilon_ladder(num_envs, cfg.base_eps, cfg.eps_alpha),
+        push or (lambda b, p, r: pushed.append((b, p, r))),
+        seed=0,
+    )
+    return actor, pushed, store, state
+
+
+def test_terminal_blocks_produced():
+    cfg = tiny_test()
+    actor, pushed, _, _ = build_actor(cfg, episode_len=9)
+    actor.run_steps(9)
+    # both envs terminate at step 9 -> one terminal block each
+    assert len(pushed) == 2
+    for block, prios, ep_reward in pushed:
+        assert ep_reward is not None  # terminal episodes report reward
+        np.testing.assert_allclose(block.gamma[-1], 0.0)  # terminal encoding
+        assert block.action.shape[0] == 9
+
+
+def test_block_cut_bootstraps_next_step():
+    cfg = tiny_test()  # block_length 16
+    actor, pushed, _, _ = build_actor(cfg, episode_len=100)
+    actor.run_steps(16)
+    assert len(pushed) == 0  # cut is deferred to the next policy call
+    actor.run_steps(1)
+    assert len(pushed) == 2
+    for block, prios, ep_reward in pushed:
+        assert ep_reward is None  # episode still running
+        assert block.gamma[-1] > 0.0  # bootstrapped, not terminal
+
+
+def test_truncation_resets_carry_and_episode():
+    cfg = tiny_test().replace(max_episode_steps=6)
+    actor, pushed, _, _ = build_actor(cfg, episode_len=100)
+    actor.run_steps(6)
+    assert len(pushed) == 0
+    actor.run_steps(1)  # truncation tick: finish(q) + fresh episode, NOOP absorbed
+    assert len(pushed) == 2
+    for block, prios, ep_reward in pushed:
+        assert ep_reward is None
+        assert block.gamma[-1] > 0.0  # truncation bootstraps
+    # carry must be zeroed for the fresh episodes
+    h, c = actor.carry
+    np.testing.assert_allclose(np.asarray(h), 0.0)
+    np.testing.assert_allclose(np.asarray(c), 0.0)
+    assert (actor.episode_steps == 0).all()
+    assert (actor.last_action == 0).all() and (actor.last_reward == 0).all()
+    # the fresh accumulators were seeded (1 entry, no steps yet)
+    assert all(len(acc.obs_buf) == 1 and acc.size == 0 for acc in actor.accs)
+
+
+def test_obs_aliasing_regression():
+    """The accumulator must snapshot observations: the actor mutates its
+    obs buffer in place every step, and the episode-seed entry must keep
+    the FIRST frame (pixel value 0 for ScriptedEnv), not the latest."""
+    cfg = tiny_test()
+    actor, pushed, _, _ = build_actor(cfg, episode_len=9)
+    actor.run_steps(9)
+    block, _, _ = pushed[0]
+    # ScriptedEnv pixels encode the timestep: first stored obs must be t=0
+    assert (block.obs[0] == 0).all()
+    assert (block.obs[1] == 1).all()
+
+
+def test_param_refresh_uses_published_version():
+    cfg = tiny_test().replace(actor_update_interval=4)
+    actor, pushed, store, state = build_actor(cfg, episode_len=100)
+    assert actor.param_version == 0
+    new_params = jax.tree.map(lambda x: x + 1.0, state.params)
+    store.publish(new_params)
+    actor.run_steps(2)  # 2 steps x 2 envs = 4 >= interval -> refresh
+    assert actor.param_version == 1

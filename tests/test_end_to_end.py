@@ -1,0 +1,74 @@
+"""Integration: the minimum end-to-end slice (SURVEY.md section 7.2) on the
+pure-JAX Catch env — env -> block packing -> PER sample -> jitted double-Q
+update -> checkpoint -> resume -> eval. Exercises the stale-priority path
+implicitly via continuous collection during training."""
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import tiny_test
+from r2d2_tpu.envs.catch import CatchVecEnv
+from r2d2_tpu.evaluate import evaluate_params, evaluate_series
+from r2d2_tpu.train import Trainer
+from r2d2_tpu.utils.checkpoint import list_checkpoint_steps
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("e2e")
+    cfg = tiny_test().replace(
+        env_name="catch",
+        checkpoint_dir=str(tmp / "ckpt"),
+        metrics_path=str(tmp / "metrics.jsonl"),
+        training_steps=30,
+        save_interval=15,
+        learning_starts=48,
+    )
+    vec_env = CatchVecEnv(num_envs=cfg.num_actors, height=12, width=12, seed=0)
+    trainer = Trainer(cfg, vec_env=vec_env)
+    trainer.run_inline(env_steps_per_update=4)
+    return trainer
+
+
+def test_training_reaches_step_target(trained):
+    assert int(trained.state.step) == 30
+    assert trained.replay.env_steps > 48
+
+
+def test_metrics_written(trained):
+    lines = open(trained.cfg.metrics_path).read().strip().splitlines()
+    assert len(lines) == 30
+    import json
+
+    rec = json.loads(lines[-1])
+    assert np.isfinite(rec["loss"]) and rec["step"] == 30
+
+
+def test_checkpoint_series_and_resume(trained):
+    cfg = trained.cfg
+    assert list_checkpoint_steps(cfg.checkpoint_dir) == [15, 30]
+    resumed = Trainer(cfg, vec_env=trained.vec_env, resume=True)
+    assert int(resumed.state.step) == 30
+    # resumed state matches the live one exactly (full TrainState payload)
+    import jax
+
+    for a, b in zip(jax.tree.leaves(resumed.state.params), jax.tree.leaves(trained.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jax.tree.leaves(resumed.state.opt_state), jax.tree.leaves(trained.state.opt_state)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_evaluate_runs(trained):
+    vec = CatchVecEnv(num_envs=4, height=12, width=12, seed=7)
+    reward = evaluate_params(trained.cfg, trained.net, trained.state.params, vec, seed=1)
+    assert -1.0 <= reward <= 1.0
+
+
+def test_evaluate_series(trained):
+    vec = CatchVecEnv(num_envs=2, height=12, width=12, seed=9)
+    rows = evaluate_series(trained.cfg, vec)
+    assert [r["step"] for r in rows] == [15, 30]
+    assert all(np.isfinite(r["mean_reward"]) for r in rows)
+    assert all(r["env_frames"] == r["env_steps"] * 4 for r in rows)

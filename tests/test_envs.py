@@ -1,0 +1,98 @@
+"""Environment tests: Catch mechanics/determinism, scripted env, vec
+protocol contract."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.envs.catch import CatchEnv, CatchVecEnv
+from r2d2_tpu.envs.fake import ScriptedEnv
+
+
+def test_catch_episode_mechanics():
+    env = CatchEnv(height=12, width=12, paddle_width=3)
+    s = env.reset(jax.random.PRNGKey(0))
+    total_reward, done = 0.0, False
+    steps = 0
+    while not done:
+        # chase the ball: move paddle toward ball_x (optimal policy)
+        a = jnp.where(s.ball_x < s.paddle_x, 1, jnp.where(s.ball_x > s.paddle_x, 2, 0))
+        s, r, done = env.step(s, a)
+        total_reward += float(r)
+        steps += 1
+        assert steps <= 12
+    assert total_reward == 1.0  # optimal play always catches
+
+
+def test_catch_miss_penalty():
+    env = CatchEnv(height=12, width=12, paddle_width=3)
+    s = env.reset(jax.random.PRNGKey(1))
+    # run away from the ball
+    done, total = False, 0.0
+    while not done:
+        a = jnp.where(s.ball_x < s.paddle_x, 2, 1)
+        s, r, done = env.step(s, a)
+        total += float(r)
+    assert total == -1.0
+
+
+def test_catch_render():
+    env = CatchEnv(height=84, width=84)
+    s = env.reset(jax.random.PRNGKey(2))
+    frame = np.asarray(env.render(s))
+    assert frame.shape == (84, 84, 1) and frame.dtype == np.uint8
+    assert frame.max() == 255 and (np.unique(frame) == [0, 255]).all()
+
+
+def test_catch_determinism():
+    env = CatchEnv()
+    s1 = env.reset(jax.random.PRNGKey(3))
+    s2 = env.reset(jax.random.PRNGKey(3))
+    assert int(s1.ball_x) == int(s2.ball_x) and int(s1.paddle_x) == int(s2.paddle_x)
+
+
+def test_vec_env_contract_and_autoreset():
+    vec = CatchVecEnv(num_envs=4, height=12, width=12, seed=0)
+    obs = vec.reset_all()
+    assert obs.shape == (4, 12, 12, 1)
+    done_seen = False
+    for _ in range(15):  # episodes last 10 steps -> must hit dones
+        actions = np.zeros(4, np.int64)
+        term_obs, rewards, dones, next_obs = vec.step(actions)
+        assert term_obs.shape == (4, 12, 12, 1)
+        if dones.any():
+            done_seen = True
+            i = int(np.nonzero(dones)[0][0])
+            # fresh frame differs from the terminal frame (ball back at top)
+            assert not np.array_equal(term_obs[i], next_obs[i])
+            assert rewards[i] in (-1.0, 1.0)
+        else:
+            assert not np.array_equal(term_obs, next_obs) or True
+    assert done_seen
+
+
+def test_scripted_env():
+    env = ScriptedEnv(obs_shape=(4, 4, 1), episode_len=3, rewards=[1.0, 2.0, 3.0])
+    obs = env.reset()
+    assert obs.dtype == np.uint8 and (obs == 0).all()
+    _, r1, d1, _ = env.step(0)
+    _, r2, d2, _ = env.step(0)
+    obs3, r3, d3, _ = env.step(0)
+    assert (r1, r2, r3) == (1.0, 2.0, 3.0)
+    assert (d1, d2, d3) == (False, False, True)
+    assert (obs3 == 3).all()
+
+
+def test_vec_env_reset_all_starts_fresh_episodes():
+    """reset_all must discard mid-episode state (same contract as
+    HostEnvPool): after stepping, a reset frame shows the ball back at the
+    top rows."""
+    vec = CatchVecEnv(num_envs=3, height=12, width=12, seed=0)
+    vec.reset_all()
+    for _ in range(5):
+        vec.step(np.zeros(3, np.int64))
+    obs = vec.reset_all()
+    # ball block (size 3) occupies rows 0-2 at episode start
+    assert (obs[:, :3].max(axis=(1, 2, 3)) == 255).all()
+    # rows 3..9 must be ball-free (only paddle rows 10-11 lit)
+    assert (obs[:, 3:10] == 0).all()

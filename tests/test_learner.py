@@ -1,0 +1,149 @@
+"""Learner tests: target math vs an independent numpy recomputation, learning
+on a fixed batch, in-jit target sync, and single-vs-8-device dp equivalence
+(the SURVEY.md section 4 'distributed-without-a-cluster' strategy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import tiny_test
+from r2d2_tpu.learner import DeviceBatch, init_train_state, make_train_step
+from r2d2_tpu.ops.priority import mixed_td_priorities_np
+from r2d2_tpu.ops.value_rescale import inverse_value_rescale_np, value_rescale_np
+from r2d2_tpu.parallel.mesh import make_mesh, shard_batch
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_test()
+
+
+@pytest.fixture(scope="module")
+def setup(cfg):
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    return net, state
+
+
+def random_batch(cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    B, T, L = cfg.batch_size, cfg.seq_len, cfg.learning_steps
+    learn = np.full(B, L, np.int32)
+    learn[-1] = L - 1  # one ragged row
+    fwd = np.full(B, cfg.forward_steps, np.int32)
+    fwd[-1] = 1
+    return DeviceBatch(
+        obs=jnp.asarray(rng.integers(0, 255, size=(B, T, *cfg.obs_shape), dtype=np.uint8)),
+        last_action=jnp.asarray(rng.integers(0, cfg.action_dim, size=(B, T)), jnp.int32),
+        last_reward=jnp.asarray(rng.normal(size=(B, T)).astype(np.float32)),
+        hidden=jnp.asarray(rng.normal(size=(B, 2, cfg.hidden_dim)).astype(np.float32)),
+        action=jnp.asarray(rng.integers(0, cfg.action_dim, size=(B, L)), jnp.int32),
+        n_step_reward=jnp.asarray(rng.normal(size=(B, L)).astype(np.float32)),
+        gamma=jnp.asarray(np.full((B, L), cfg.gamma**cfg.forward_steps, np.float32)),
+        burn_in_steps=jnp.asarray(np.full(B, cfg.burn_in_steps, np.int32)),
+        learning_steps=jnp.asarray(learn),
+        forward_steps=jnp.asarray(fwd),
+        is_weights=jnp.asarray(rng.uniform(0.3, 1.0, size=B).astype(np.float32)),
+    )
+
+
+def test_step_runs_and_metrics_finite(cfg, setup):
+    net, state = setup
+    step = make_train_step(cfg, net, donate=False)
+    batch = random_batch(cfg)
+    new_state, metrics, priorities = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert priorities.shape == (cfg.batch_size,)
+    assert np.isfinite(np.asarray(priorities)).all()
+    assert int(new_state.step) == 1
+
+
+def test_target_math_matches_numpy(cfg, setup):
+    """Recompute y, loss, priorities in numpy from the net's own Q outputs
+    and compare to the jitted step's metrics (SURVEY.md section 2.6 target
+    invariant)."""
+    net, state = setup
+    batch = random_batch(cfg, seed=1)
+
+    q_learn, q_boot_online, mask = net.apply(
+        state.params, batch.obs, batch.last_action, batch.last_reward, batch.hidden,
+        batch.burn_in_steps, batch.learning_steps, batch.forward_steps,
+    )
+    _, q_boot_target, _ = net.apply(
+        state.target_params, batch.obs, batch.last_action, batch.last_reward, batch.hidden,
+        batch.burn_in_steps, batch.learning_steps, batch.forward_steps,
+    )
+    q_learn, q_boot_online, q_boot_target, mask = map(
+        np.asarray, (q_learn, q_boot_online, q_boot_target, mask)
+    )
+    a_star = q_boot_online.argmax(-1)
+    q_tgt = np.take_along_axis(q_boot_target, a_star[..., None], -1)[..., 0]
+    y = value_rescale_np(
+        np.asarray(batch.n_step_reward) + np.asarray(batch.gamma) * inverse_value_rescale_np(q_tgt)
+    )
+    q_taken = np.take_along_axis(q_learn, np.asarray(batch.action)[..., None], -1)[..., 0]
+    td = y - q_taken
+    w = np.asarray(batch.is_weights)[:, None]
+    want_loss = (w * td**2 * mask).sum() / mask.sum()
+    want_prios = mixed_td_priorities_np(np.abs(td) * mask, mask, cfg.td_mix_eta)
+
+    step = make_train_step(cfg, net, donate=False)
+    _, metrics, priorities = step(state, batch)
+    np.testing.assert_allclose(float(metrics["loss"]), want_loss, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(priorities), want_prios, rtol=1e-3, atol=1e-5)
+
+
+def test_loss_decreases_on_fixed_batch(cfg):
+    fast_cfg = cfg.replace(lr=5e-3)
+    net, state = init_train_state(fast_cfg, jax.random.PRNGKey(1))
+    step = make_train_step(fast_cfg, net, donate=False)
+    batch = random_batch(fast_cfg, seed=2)
+    losses = []
+    for _ in range(30):
+        state, metrics, _ = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_target_sync_inside_jit(cfg):
+    net, state = init_train_state(cfg, jax.random.PRNGKey(2))
+    step = make_train_step(cfg, net, donate=False)
+    batch = random_batch(cfg, seed=3)
+    interval = cfg.target_net_update_interval
+    for i in range(interval):
+        state, _, _ = step(state, batch)
+        online = jax.tree.leaves(state.params)[0]
+        target = jax.tree.leaves(state.target_params)[0]
+        if i + 1 < interval:
+            assert not np.allclose(np.asarray(online), np.asarray(target))
+    # at step == interval the target must have snapped to the online params
+    chex_equal = jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        state.params, state.target_params,
+    )
+    del chex_equal
+
+
+def test_dp8_equivalence(cfg):
+    """Sharding the batch over an 8-device dp mesh must produce the same
+    update as single-device (XLA psum == serial sum)."""
+    assert len(jax.devices()) == 8, "conftest must force 8 cpu devices"
+    net, state = init_train_state(cfg, jax.random.PRNGKey(3))
+    step = make_train_step(cfg, net, donate=False)
+    batch = random_batch(cfg, seed=4)
+
+    single_state, single_metrics, single_prios = step(state, batch)
+
+    mesh = make_mesh(dp=8, tp=1)
+    sharded = DeviceBatch(*shard_batch(mesh, tuple(batch)))
+    rep_state = jax.device_put(state, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+    multi_state, multi_metrics, multi_prios = step(rep_state, sharded)
+
+    np.testing.assert_allclose(
+        float(single_metrics["loss"]), float(multi_metrics["loss"]), rtol=1e-5
+    )
+    np.testing.assert_allclose(np.asarray(single_prios), np.asarray(multi_prios), rtol=1e-4, atol=1e-6)
+    a = jax.tree.leaves(single_state.params)
+    b = jax.tree.leaves(multi_state.params)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-4, atol=1e-6)

@@ -1,0 +1,172 @@
+"""Model tests.
+
+The central one is act/unroll parity: the reference's single-step forward
+and sequence forwards are an UNCHECKED consistency assumption (SURVEY.md
+section 4 'Model'); here it is pinned by test — stepping the network one
+frame at a time must reproduce exactly the Q values the scan-based unroll
+gathers, including the bootstrap view's edge-repeat clamp semantics.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import R2D2Config, tiny_test
+from r2d2_tpu.models.lstm import LSTM
+from r2d2_tpu.models.r2d2 import R2D2Network, init_params, initial_carry
+
+
+def make_net(cfg):
+    net, params = init_params(jax.random.PRNGKey(0), cfg)
+    return net, params
+
+
+def rollout_sequential(net, params, obs, la, lr, hidden0):
+    """Step `act` over every frame of (1, T, ...) inputs; return (T, A) Qs."""
+    T = obs.shape[1]
+    carry = (hidden0[:, 0], hidden0[:, 1])
+    qs = []
+    for t in range(T):
+        q, carry = net.apply(params, obs[:, t], la[:, t], lr[:, t], carry, method=net.act)
+        qs.append(np.asarray(q[0]))
+    return np.stack(qs)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_test()
+
+
+@pytest.fixture(scope="module")
+def net_params(cfg):
+    return make_net(cfg)
+
+
+def random_inputs(cfg, rng, B=1):
+    T = cfg.seq_len
+    obs = rng.integers(0, 255, size=(B, T, *cfg.obs_shape), dtype=np.uint8)
+    la = rng.integers(0, cfg.action_dim, size=(B, T)).astype(np.int32)
+    lr = rng.normal(size=(B, T)).astype(np.float32)
+    hid = rng.normal(size=(B, 2, cfg.hidden_dim)).astype(np.float32)
+    return jnp.asarray(obs), jnp.asarray(la), jnp.asarray(lr), jnp.asarray(hid)
+
+
+def test_act_unroll_parity_learning_view(cfg, net_params):
+    net, params = net_params
+    rng = np.random.default_rng(0)
+    obs, la, lr, hid = random_inputs(cfg, rng)
+    burn, learn, fwd = cfg.burn_in_steps, cfg.learning_steps, cfg.forward_steps
+
+    qs_seq = rollout_sequential(net, params, obs, la, lr, hid)
+    q_learn, q_boot, mask = net.apply(
+        params, obs, la, lr, hid,
+        jnp.array([burn], jnp.int32), jnp.array([learn], jnp.int32), jnp.array([fwd], jnp.int32),
+    )
+    for t in range(learn):
+        np.testing.assert_allclose(np.asarray(q_learn[0, t]), qs_seq[burn + t], atol=2e-3)
+    np.testing.assert_array_equal(np.asarray(mask[0]), np.ones(learn))
+
+
+def test_bootstrap_view_edge_repeat(cfg, net_params):
+    """forward < F_max: the bootstrap gather must clamp at the sequence's
+    last valid output — the reference's edge-repeat (model.py:141-150)."""
+    net, params = net_params
+    rng = np.random.default_rng(1)
+    obs, la, lr, hid = random_inputs(cfg, rng)
+    burn, learn = cfg.burn_in_steps, cfg.learning_steps
+    fwd = 1  # tail sequence: only 1 forward step available
+
+    qs_seq = rollout_sequential(net, params, obs, la, lr, hid)
+    _, q_boot, _ = net.apply(
+        params, obs, la, lr, hid,
+        jnp.array([burn], jnp.int32), jnp.array([learn], jnp.int32), jnp.array([fwd], jnp.int32),
+    )
+    seq_end = burn + learn + fwd
+    for t in range(learn):
+        want_idx = min(burn + cfg.forward_steps + t, seq_end - 1)
+        np.testing.assert_allclose(np.asarray(q_boot[0, t]), qs_seq[want_idx], atol=2e-3)
+
+
+def test_short_sequence_mask(cfg, net_params):
+    net, params = net_params
+    rng = np.random.default_rng(2)
+    obs, la, lr, hid = random_inputs(cfg, rng)
+    learn = 2  # ragged tail
+    _, _, mask = net.apply(
+        params, obs, la, lr, hid,
+        jnp.array([0], jnp.int32), jnp.array([learn], jnp.int32), jnp.array([1], jnp.int32),
+    )
+    np.testing.assert_array_equal(np.asarray(mask[0]), [1, 1, 0, 0])
+
+
+def test_batched_heterogeneous_windows(cfg, net_params):
+    """Rows with different burn-in/learning/forward in one batch must each
+    match their own sequential rollout (pack_padded_sequence replacement)."""
+    net, params = net_params
+    rng = np.random.default_rng(3)
+    obs, la, lr, hid = random_inputs(cfg, rng, B=3)
+    burn = jnp.array([0, 2, 4], jnp.int32)
+    learn = jnp.array([4, 4, 2], jnp.int32)
+    fwd = jnp.array([2, 2, 1], jnp.int32)
+
+    q_learn, q_boot, mask = net.apply(params, obs, la, lr, hid, burn, learn, fwd)
+    for i in range(3):
+        qs_seq = rollout_sequential(net, params, obs[i : i + 1], la[i : i + 1], lr[i : i + 1], hid[i : i + 1])
+        for t in range(int(learn[i])):
+            np.testing.assert_allclose(np.asarray(q_learn[i, t]), qs_seq[int(burn[i]) + t], atol=2e-3)
+            want = min(int(burn[i]) + cfg.forward_steps + t, int(burn[i] + learn[i] + fwd[i]) - 1)
+            np.testing.assert_allclose(np.asarray(q_boot[i, t]), qs_seq[want], atol=2e-3)
+        np.testing.assert_array_equal(np.asarray(mask[i]), (np.arange(cfg.learning_steps) < int(learn[i])))
+
+
+def test_lstm_scan_chunk_equivalence():
+    """Remat-chunked long scan must be numerically identical to the plain
+    scan (long-context preset machinery, SURVEY.md section 5.7)."""
+    H, B, T, D = 8, 2, 16, 5
+    xs = jnp.asarray(np.random.default_rng(0).normal(size=(B, T, D)).astype(np.float32))
+    carry = (jnp.zeros((B, H)), jnp.zeros((B, H)))
+    plain = LSTM(H, in_dim=D)
+    params = plain.init(jax.random.PRNGKey(0), xs, carry)
+    out1, (h1, c1) = plain.apply(params, xs, carry)
+    chunked = LSTM(H, in_dim=D, scan_chunk=4)
+    out2, (h2, c2) = chunked.apply(params, xs, carry)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), atol=1e-6)
+
+
+def test_nature_encoder_reference_shapes():
+    """84x84 trunk must flatten to 3136 features like the reference
+    (model.py:55: Linear(3136, 512))."""
+    from r2d2_tpu.models.encoders import NatureEncoder
+
+    enc = NatureEncoder(latent_dim=512)
+    x = jnp.zeros((2, 84, 84, 1))
+    params = enc.init(jax.random.PRNGKey(0), x)
+    # conv stack output before the dense: (2, 7, 7, 64) -> 3136
+    dense_kernel = params["params"]["Dense_0"]["kernel"]
+    assert dense_kernel.shape == (3136, 512)
+
+
+def test_impala_encoder_runs():
+    from r2d2_tpu.models.encoders import ImpalaEncoder
+
+    enc = ImpalaEncoder(latent_dim=256)
+    x = jnp.zeros((2, 64, 64, 3))
+    params = enc.init(jax.random.PRNGKey(0), x)
+    y = enc.apply(params, x)
+    assert y.shape == (2, 256)
+
+
+def test_bfloat16_compute_path():
+    cfg = tiny_test().replace(compute_dtype="bfloat16")
+    net, params = make_net(cfg)
+    rng = np.random.default_rng(4)
+    obs, la, lr, hid = random_inputs(cfg, rng)
+    ones = jnp.ones((1,), jnp.int32)
+    q_learn, q_boot, mask = net.apply(
+        params, obs, la, lr, hid, ones * cfg.burn_in_steps, ones * cfg.learning_steps, ones * cfg.forward_steps
+    )
+    # heads must still emit float32 (loss math stays f32)
+    assert q_learn.dtype == jnp.float32
+    assert np.isfinite(np.asarray(q_learn)).all()
