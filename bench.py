@@ -1,0 +1,199 @@
+"""Learner-throughput benchmark on the flagship configuration.
+
+Measures sustained learner env-frames/sec/chip with the TPU-native pipeline:
+device-resident replay data plane (replay/device_store.py), a fused jitted
+update that gathers sequence windows in-jit from HBM, kilobyte-sized sample
+coordinates as the only per-update host->device traffic, and asynchronous
+draining of the priority round trip. Host work per update: one sum-tree
+sample + one sum-tree update.
+
+Rationale: on this hardware the host<->device link (not the MXU) bounds a
+naive learner — shipping 38 MB batches from host replay measures the wire,
+not the framework. The reference's design has exactly that shape (replay in
+host RAM, batches over queues, reference worker.py:157,385-389).
+
+Metric semantics (BASELINE.md): one update consumes batch x learning_steps
+env transitions; frames = transitions x 4 (frameskip, reference
+test.py:28,36). Reference implied learner throughput: 5.7 updates/s x 64 x
+40 x 4 = 58,368 env-frames/s. North star: >= 100,000.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "env_frames/s", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+
+from r2d2_tpu.config import default_atari
+from r2d2_tpu.learner import init_train_state, make_fused_train_step
+from r2d2_tpu.replay.block import Block
+from r2d2_tpu.replay.device_store import DeviceReplayBuffer
+
+BASELINE_FRAMES_PER_SEC = 58368.0  # BASELINE.md implied learner throughput
+
+
+def synth_block(cfg, rng: np.random.Generator) -> Block:
+    """A steady-state mid-episode block (burn-in carried, full length),
+    built vectorized — replay-path realistic without stepping envs."""
+    B, L, n, S = cfg.burn_in_steps, cfg.learning_steps, cfg.forward_steps, cfg.seqs_per_block
+    size = cfg.block_length
+    stored = B + size + 1
+    forward = np.full(S, n, np.int32)
+    forward[-1] = 1  # last sequence of a block cut bootstraps at +1
+    return Block(
+        obs=rng.integers(0, 255, size=(stored, *cfg.obs_shape), dtype=np.uint8),
+        last_action=rng.integers(0, cfg.action_dim, size=stored).astype(np.uint8),
+        last_reward=rng.normal(size=stored).astype(np.float32),
+        action=rng.integers(0, cfg.action_dim, size=size).astype(np.uint8),
+        n_step_reward=rng.normal(size=size).astype(np.float32),
+        gamma=np.full(size, cfg.gamma**n, np.float32),
+        hidden=(rng.normal(size=(S, 2, cfg.hidden_dim)) * 0.1).astype(np.float32),
+        num_sequences=S,
+        burn_in_steps=np.full(S, B, np.int32),
+        learning_steps=np.full(S, L, np.int32),
+        forward_steps=forward,
+    )
+
+
+def main():
+    cfg = default_atari().replace(
+        compute_dtype="bfloat16",
+        buffer_capacity=100_000,  # 250 block slots ~= 0.77 GB HBM obs store
+    )
+    rng = np.random.default_rng(0)
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})", file=sys.stderr)
+
+    t0 = time.time()
+    replay = DeviceReplayBuffer(cfg)
+    n_blocks = cfg.learning_starts // cfg.block_length + 5
+    for _ in range(n_blocks):
+        block = synth_block(cfg, rng)
+        prios = rng.uniform(0.5, 2.0, size=cfg.seqs_per_block).astype(np.float32)
+        replay.add_block(block, prios, None)
+    jax.block_until_ready(replay.stores["obs"])
+    assert replay.can_sample()
+    print(
+        f"replay filled: {len(replay)} transitions ({n_blocks} block uploads) "
+        f"in {time.time()-t0:.1f}s",
+        file=sys.stderr,
+    )
+
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    fused_step = make_fused_train_step(cfg, net)
+    sample_rng = np.random.default_rng(1)
+
+    # prefetch thread: tree sampling + async upload of the (B,) coordinates
+    idx_q: "queue.Queue" = queue.Queue(maxsize=16)
+    prio_q: "queue.Queue" = queue.Queue(maxsize=64)
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            si = replay.sample_indices(sample_rng)
+            dev_idx = (
+                jax.device_put(si.b),
+                jax.device_put(si.s),
+                jax.device_put(si.is_weights),
+            )
+            while not stop.is_set():
+                try:
+                    idx_q.put((dev_idx, si.idxes, si.old_ptr), timeout=0.5)
+                    break
+                except queue.Full:
+                    pass
+
+    def drainer():
+        # The device->host round trip has fixed latency, so fetching each
+        # update's (B,) priorities individually caps throughput; instead
+        # stack up to CHUNK results on device and fetch them in ONE
+        # transfer, then apply to the host tree (with bounded lag).
+        import jax.numpy as jnp
+
+        CHUNK = 16
+        while not stop.is_set():
+            items = []
+            try:
+                items.append(prio_q.get(timeout=0.5))
+            except queue.Empty:
+                continue
+            while len(items) < CHUNK:
+                try:
+                    items.append(prio_q.get_nowait())
+                except queue.Empty:
+                    break
+            stacked = np.asarray(jnp.stack([p for p, _, _ in items]))
+            for row, (_, idxes, old_ptr) in zip(stacked, items):
+                replay.update_priorities(idxes, row, old_ptr)
+
+    threads = [
+        threading.Thread(target=sampler, daemon=True),
+        threading.Thread(target=drainer, daemon=True),
+    ]
+    for t in threads:
+        t.start()
+
+    def one_update():
+        nonlocal state
+        (b, s, w), idxes, old_ptr = idx_q.get()
+        # run_with_stores: dispatch under the buffer lock so a concurrent
+        # add_block's donated swap can't invalidate the arrays mid-dispatch
+        state, metrics, priorities = replay.run_with_stores(
+            lambda stores: fused_step(state, stores, b, s, w)
+        )
+        prio_q.put((priorities, idxes, old_ptr))
+        return metrics
+
+    # compile + warm
+    t0 = time.time()
+    m = one_update()
+    jax.block_until_ready(state.params)
+    print(f"compile+first step: {time.time()-t0:.1f}s loss={float(m['loss']):.4f}", file=sys.stderr)
+    for _ in range(10):
+        m = one_update()
+    jax.block_until_ready(state.params)
+
+    # timed run
+    target_seconds = 20.0
+    n_updates = 0
+    t0 = time.time()
+    while time.time() - t0 < target_seconds:
+        m = one_update()
+        n_updates += 1
+    jax.block_until_ready(state.params)
+    elapsed = time.time() - t0
+    final_loss = float(m["loss"])
+
+    updates_per_sec = n_updates / elapsed
+    frames_per_sec = updates_per_sec * cfg.batch_size * cfg.learning_steps * 4
+    print(
+        f"{n_updates} updates in {elapsed:.1f}s = {updates_per_sec:.2f} updates/s "
+        f"(final loss {final_loss:.4f})",
+        file=sys.stderr,
+    )
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+
+    print(
+        json.dumps(
+            {
+                "metric": "learner_env_frames_per_sec_per_chip",
+                "value": round(frames_per_sec, 1),
+                "unit": "env_frames/s",
+                "vs_baseline": round(frames_per_sec / BASELINE_FRAMES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

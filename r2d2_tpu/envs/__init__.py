@@ -12,16 +12,19 @@ Three env families, one host-facing protocol (reset/step over numpy):
 """
 
 from r2d2_tpu.envs.fake import ScriptedEnv
-from r2d2_tpu.envs.catch import CatchEnv, CatchVecEnv
+from r2d2_tpu.envs.catch import CatchEnv, CatchHostEnv, CatchVecEnv
 
-__all__ = ["ScriptedEnv", "CatchEnv", "CatchVecEnv", "make_env"]
+__all__ = ["ScriptedEnv", "CatchEnv", "CatchHostEnv", "CatchVecEnv", "make_env"]
 
 
 def make_env(cfg, seed: int = 0):
-    """Host-protocol env factory by cfg.env_name."""
+    """Host-protocol (reset()/step(int)) env factory by cfg.env_name.
+
+    For vectorized on-device Catch use envs.catch.CatchVecEnv directly
+    (train.build_vec_env does)."""
     name = cfg.env_name.lower()
     if name == "catch":
-        return CatchVecEnv(num_envs=1, height=cfg.obs_shape[0], width=cfg.obs_shape[1], seed=seed)
+        return CatchHostEnv(height=cfg.obs_shape[0], width=cfg.obs_shape[1], seed=seed)
     if name == "scripted":
         return ScriptedEnv(obs_shape=cfg.obs_shape, action_dim=cfg.action_dim)
     from r2d2_tpu.envs.atari import create_atari_env  # gated import

@@ -66,6 +66,31 @@ class CatchEnv:
         return CatchState(s.ball_x, ball_y, paddle_x, s.key), reward, done
 
 
+class CatchHostEnv:
+    """Single-env host protocol (reset()/step(int)) over the functional
+    core — what make_env returns so Catch composes with HostEnvPool like
+    any other host env."""
+
+    def __init__(self, height: int = 84, width: int = 84, seed: int = 0):
+        self.env = CatchEnv(height, width)
+        self.action_dim = CatchEnv.NUM_ACTIONS
+        self.obs_shape = (height, width, 1)
+        self._key = jax.random.PRNGKey(seed)
+        self._step = jax.jit(self.env.step)
+        self._render = jax.jit(self.env.render)
+        self._reset = jax.jit(self.env.reset)
+        self._state = None
+
+    def reset(self) -> np.ndarray:
+        self._key, sub = jax.random.split(self._key)
+        self._state = self._reset(sub)
+        return np.asarray(self._render(self._state))
+
+    def step(self, action: int):
+        self._state, reward, done = self._step(self._state, jnp.int32(action))
+        return np.asarray(self._render(self._state)), float(reward), bool(done), {}
+
+
 class CatchVecEnv:
     """Host-protocol adapter: E vectorized Catch envs stepped in one jitted
     call, with device-side auto-reset. step() returns the terminal frame

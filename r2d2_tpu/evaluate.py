@@ -23,6 +23,11 @@ from r2d2_tpu.learner import init_train_state
 from r2d2_tpu.utils.checkpoint import list_checkpoint_steps, restore_checkpoint
 
 
+def make_policy(net):
+    """One jitted acting forward, shared across checkpoints."""
+    return jax.jit(lambda p, o, la, lr, c: net.apply(p, o, la, lr, c, method=net.act))
+
+
 def evaluate_params(
     cfg: R2D2Config,
     net,
@@ -30,11 +35,16 @@ def evaluate_params(
     vec_env,
     seed: int = 0,
     max_steps: Optional[int] = None,
+    policy=None,
 ) -> float:
-    """Mean episodic reward over one episode per env slot."""
+    """Mean episodic reward over one episode per env slot.
+
+    Pass a prebuilt jitted `policy` when calling repeatedly (the series
+    evaluator does) so the acting forward compiles once, not per call."""
     E = vec_env.num_envs
     rng = np.random.default_rng(seed)
-    policy = jax.jit(lambda p, o, la, lr, c: net.apply(p, o, la, lr, c, method=net.act))
+    if policy is None:
+        policy = make_policy(net)
 
     obs = vec_env.reset_all()
     last_action = np.zeros(E, np.int32)
@@ -66,10 +76,11 @@ def evaluate_params(
 def evaluate_series(cfg: R2D2Config, vec_env, out_path: Optional[str] = None, seed: int = 0):
     """Reference test.py:14-58 equivalent over the orbax series."""
     net, template = init_train_state(cfg, jax.random.PRNGKey(0))
+    policy = make_policy(net)
     rows = []
     for step in list_checkpoint_steps(cfg.checkpoint_dir):
         state, env_steps, wall_minutes = restore_checkpoint(cfg.checkpoint_dir, template, step)
-        reward = evaluate_params(cfg, net, state.params, vec_env, seed=seed)
+        reward = evaluate_params(cfg, net, state.params, vec_env, seed=seed, policy=policy)
         row = {
             "step": step,
             "env_steps": env_steps,

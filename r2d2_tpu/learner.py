@@ -102,8 +102,9 @@ def init_train_state(cfg: R2D2Config, rng: jax.Array) -> Tuple[R2D2Network, Trai
     )
 
 
-def make_train_step(cfg: R2D2Config, net: R2D2Network, donate: bool = True):
-    """Build the jitted (state, batch) -> (state, metrics, priorities) step."""
+def _raw_train_step(cfg: R2D2Config, net: R2D2Network):
+    """The un-jitted (state, batch) -> (state, metrics, priorities) body,
+    shared by the host-batch and device-store (fused) entry points."""
     optimizer = make_optimizer(cfg)
     eps = cfg.value_rescale_eps
 
@@ -161,5 +162,57 @@ def make_train_step(cfg: R2D2Config, net: R2D2Network, donate: bool = True):
         )
         return new_state, metrics, priorities
 
-    donate_argnums = (0,) if donate else ()
-    return jax.jit(train_step, donate_argnums=donate_argnums)
+    return train_step
+
+
+def make_train_step(cfg: R2D2Config, net: R2D2Network, donate: bool = True):
+    """Jitted (state, batch) -> (state, metrics, priorities) over a
+    host-assembled DeviceBatch."""
+    raw = _raw_train_step(cfg, net)
+    return jax.jit(raw, donate_argnums=(0,) if donate else ())
+
+
+def make_fused_train_step(cfg: R2D2Config, net: R2D2Network, donate: bool = True):
+    """Train step over a DEVICE-RESIDENT replay store.
+
+    Signature: (state, stores, b, s, is_weights) -> (state, metrics,
+    priorities). The batch windows are gathered in-jit straight from HBM
+    (see replay/device_store.py), so only the (B,) sample coordinates cross
+    the host->device boundary per update — the whole point on hardware
+    where transfer, not compute, bounds the learner. Numerically identical
+    to make_train_step on the equivalent host-assembled batch (pinned by
+    test)."""
+    raw = _raw_train_step(cfg, net)
+    L, T = cfg.learning_steps, cfg.seq_len
+    slot, bl = cfg.block_slot_len, cfg.block_length
+
+    def gather_batch(stores, b, s, is_weights) -> DeviceBatch:
+        burn = stores["burn_in"][b, s]
+        learn = stores["learning"][b, s]
+        fwd = stores["forward"][b, s]
+        first_burn = stores["burn_in"][b, 0]
+        start = first_burn + s * L
+        win = start - burn
+        t = jnp.arange(T, dtype=jnp.int32)
+        rows = jnp.clip(win[:, None] + t[None, :], 0, slot - 1)
+        bcol = b[:, None]
+        lrow = jnp.clip(s[:, None] * L + jnp.arange(L, dtype=jnp.int32)[None, :], 0, bl - 1)
+        return DeviceBatch(
+            obs=stores["obs"][bcol, rows],
+            last_action=stores["last_action"][bcol, rows],
+            last_reward=stores["last_reward"][bcol, rows],
+            hidden=stores["hidden"][b, s],
+            action=stores["action"][bcol, lrow],
+            n_step_reward=stores["n_step_reward"][bcol, lrow],
+            gamma=stores["gamma"][bcol, lrow],
+            burn_in_steps=burn,
+            learning_steps=learn,
+            forward_steps=fwd,
+            is_weights=is_weights,
+        )
+
+    def fused(state: TrainState, stores, b, s, is_weights):
+        batch = gather_batch(stores, b, s, is_weights)
+        return raw(state, batch)
+
+    return jax.jit(fused, donate_argnums=(0,) if donate else ())

@@ -11,5 +11,14 @@ from r2d2_tpu.replay.sum_tree import SumTree
 from r2d2_tpu.replay.block import Block
 from r2d2_tpu.replay.accumulator import SequenceAccumulator
 from r2d2_tpu.replay.replay_buffer import ReplayBuffer, SampledBatch
+from r2d2_tpu.replay.device_store import DeviceReplayBuffer, SampleIdx
 
-__all__ = ["SumTree", "Block", "SequenceAccumulator", "ReplayBuffer", "SampledBatch"]
+__all__ = [
+    "SumTree",
+    "Block",
+    "SequenceAccumulator",
+    "ReplayBuffer",
+    "SampledBatch",
+    "DeviceReplayBuffer",
+    "SampleIdx",
+]

@@ -1,0 +1,102 @@
+"""Shared host-side replay control plane.
+
+Both replay buffers — host data plane (replay_buffer.ReplayBuffer) and HBM
+data plane (device_store.DeviceReplayBuffer) — run the SAME control logic:
+sum-tree priorities, circular block pointer, eviction/size accounting,
+clamped stratified sampling of sequence coordinates, and the stale-priority
+pointer-window rejection of reference worker.py:290-307. It lives here once
+so a fix to any of the subtle parts (wrap-around masking, zero-leaf clamp)
+cannot diverge between the two data planes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.replay.sum_tree import SumTree
+
+
+class ReplayControlPlane:
+    def __init__(self, cfg: R2D2Config, native: Optional[object] = None):
+        self.cfg = cfg
+        self.tree = SumTree(
+            cfg.num_sequences, cfg.prio_exponent, cfg.is_exponent, native=native
+        )
+        self.block_ptr = 0
+        self.size = 0
+        self.env_steps = 0
+        self.num_episodes = 0
+        self.episode_reward_sum = 0.0
+        self.learning_sum = np.zeros(cfg.num_blocks, np.int64)
+        self.occupied = np.zeros(cfg.num_blocks, bool)
+        self.num_seq_store = np.zeros(cfg.num_blocks, np.int32)
+        self.lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return self.size
+
+    def can_sample(self) -> bool:
+        return self.size >= self.cfg.learning_starts
+
+    # --- accounting (call with self.lock held) ----------------------------
+
+    def _account_add(
+        self, num_sequences: int, learning_total: int, priorities: np.ndarray,
+        episode_reward: Optional[float],
+    ) -> int:
+        """Update tree + counters for a block landing at block_ptr; returns
+        the slot index written. Caller holds the lock and writes the data
+        plane for the same slot."""
+        ptr = self.block_ptr
+        S = self.cfg.seqs_per_block
+        idxes = np.arange(ptr * S, (ptr + 1) * S, dtype=np.int64)
+        self.tree.update(idxes, priorities)
+        if self.occupied[ptr]:
+            self.size -= int(self.learning_sum[ptr])
+        self.learning_sum[ptr] = learning_total
+        self.occupied[ptr] = True
+        self.num_seq_store[ptr] = num_sequences
+        self.size += learning_total
+        self.env_steps += learning_total
+        self.block_ptr = (ptr + 1) % self.cfg.num_blocks
+        if episode_reward is not None:
+            self.episode_reward_sum += episode_reward
+            self.num_episodes += 1
+        return ptr
+
+    def _draw(self, rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Stratified draw of batch_size sequence coordinates (with the
+        zero-leaf clamp reflected into the returned global idxes). Caller
+        holds the lock. Returns (b, s, idxes, is_weights)."""
+        S = self.cfg.seqs_per_block
+        idxes, is_weights = self.tree.sample(self.cfg.batch_size, rng)
+        b = idxes // S
+        s = np.minimum(idxes % S, np.maximum(self.num_seq_store[b] - 1, 0))
+        return b, s, b * S + s, is_weights
+
+    # --- priorities -------------------------------------------------------
+
+    def update_priorities(self, idxes: np.ndarray, td_errors: np.ndarray, old_ptr: int) -> None:
+        """Apply learner priorities, discarding any index overwritten during
+        the sample->train round trip (worker.py:290-307 invariant)."""
+        S = self.cfg.seqs_per_block
+        with self.lock:
+            ptr = self.block_ptr
+            if ptr > old_ptr:
+                mask = (idxes < old_ptr * S) | (idxes >= ptr * S)
+            elif ptr < old_ptr:
+                mask = (idxes < old_ptr * S) & (idxes >= ptr * S)
+            else:
+                mask = np.ones_like(idxes, dtype=bool)
+            self.tree.update(idxes[mask], td_errors[mask])
+
+    def pop_episode_stats(self):
+        with self.lock:
+            n, r = self.num_episodes, self.episode_reward_sum
+            self.num_episodes = 0
+            self.episode_reward_sum = 0.0
+        return n, r

@@ -1,33 +1,33 @@
-"""Central sequence-prioritized replay with vectorized batch assembly.
+"""Central sequence-prioritized replay with vectorized batch assembly
+(host data plane).
 
 Capability parity with the reference ReplayBuffer (reference
 worker.py:69-310): circular store of fixed-size blocks, a sum tree over all
 sequence slots, stratified prioritized sampling with IS weights, and
-stale-priority rejection via pointer-window masking.
+stale-priority rejection via pointer-window masking (the control logic
+lives in replay/control_plane.py, shared with the HBM-resident variant).
 
 TPU-first redesign: the reference assembles each batch with a 64-iteration
 Python loop of per-sequence tensor slices plus `pad_sequence`
 (worker.py:210-288). Here every block field lives in ONE preallocated numpy
 array, and a batch is assembled with a single fancy-index gather per field —
 (batch, seq_len) windows come out fixed-shape (jit-stable) in a handful of
-vectorized ops. This is what keeps a TPU learner fed from a host CPU.
+vectorized ops.
 
-Thread safety: one lock around add/sample/update, as in the reference
-(worker.py:97), but the buffer is passive — service loops live in the
-trainer so the same object works single- and multi-threaded.
+When host->device bandwidth is the binding constraint, prefer
+replay/device_store.DeviceReplayBuffer, which keeps the data plane in HBM.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import Optional
 
 import numpy as np
 
 from r2d2_tpu.config import R2D2Config
 from r2d2_tpu.replay.block import Block
-from r2d2_tpu.replay.sum_tree import SumTree
+from r2d2_tpu.replay.control_plane import ReplayControlPlane
 
 
 @dataclasses.dataclass
@@ -50,14 +50,11 @@ class SampledBatch:
     env_steps: int             # total env steps stored so far
 
 
-class ReplayBuffer:
+class ReplayBuffer(ReplayControlPlane):
     def __init__(self, cfg: R2D2Config, native: Optional[object] = None):
-        self.cfg = cfg
-        S, L = cfg.seqs_per_block, cfg.learning_steps
+        super().__init__(cfg, native=native)
+        S = cfg.seqs_per_block
         nb, slot = cfg.num_blocks, cfg.block_slot_len
-
-        self.tree = SumTree(cfg.num_sequences, cfg.prio_exponent, cfg.is_exponent, native=native)
-        self._native = native
 
         self.obs_store = np.zeros((nb, slot, *cfg.obs_shape), dtype=np.uint8)
         self.last_action_store = np.zeros((nb, slot), dtype=np.uint8)
@@ -69,19 +66,6 @@ class ReplayBuffer:
         self.burn_in_store = np.zeros((nb, S), dtype=np.int32)
         self.learning_store = np.zeros((nb, S), dtype=np.int32)
         self.forward_store = np.zeros((nb, S), dtype=np.int32)
-        self.num_seq_store = np.zeros(nb, dtype=np.int32)
-        self.learning_sum = np.zeros(nb, dtype=np.int64)
-        self.occupied = np.zeros(nb, dtype=bool)
-
-        self.block_ptr = 0
-        self.size = 0  # stored learning transitions
-        self.env_steps = 0
-        self.num_episodes = 0
-        self.episode_reward_sum = 0.0
-        self.lock = threading.Lock()
-
-    def __len__(self) -> int:
-        return self.size
 
     # ------------------------------------------------------------------ add
 
@@ -91,16 +75,11 @@ class ReplayBuffer:
         """Write one block into the circular store and refresh its leaves
         (reference worker.py:178-208). `priorities` must already be padded
         to seqs_per_block (zeros for absent sequences)."""
-        cfg = self.cfg
-        S = cfg.seqs_per_block
+        S = self.cfg.seqs_per_block
         with self.lock:
-            ptr = self.block_ptr
-            idxes = np.arange(ptr * S, (ptr + 1) * S, dtype=np.int64)
-            self.tree.update(idxes, priorities)
-
-            if self.occupied[ptr]:
-                self.size -= int(self.learning_sum[ptr])
-
+            ptr = self._account_add(
+                block.num_sequences, int(block.learning_steps.sum()), priorities, episode_reward
+            )
             steps = block.stored_steps
             self.obs_store[ptr, :steps] = block.obs
             self.last_action_store[ptr, :steps] = block.last_action
@@ -117,23 +96,8 @@ class ReplayBuffer:
             self.burn_in_store[ptr, :ns] = block.burn_in_steps
             self.learning_store[ptr, :ns] = block.learning_steps
             self.forward_store[ptr, :ns] = block.forward_steps
-            self.num_seq_store[ptr] = ns
-            lsum = int(block.learning_steps.sum())
-            self.learning_sum[ptr] = lsum
-            self.occupied[ptr] = True
-
-            self.size += lsum
-            self.env_steps += lsum
-            self.block_ptr = (ptr + 1) % cfg.num_blocks
-
-            if episode_reward is not None:
-                self.episode_reward_sum += episode_reward
-                self.num_episodes += 1
 
     # --------------------------------------------------------------- sample
-
-    def can_sample(self) -> bool:
-        return self.size >= self.cfg.learning_starts
 
     def sample_batch(self, rng: np.random.Generator) -> SampledBatch:
         """Draw a fixed-shape batch via stratified prioritized sampling.
@@ -143,20 +107,9 @@ class ReplayBuffer:
         worker.py:210-288.
         """
         cfg = self.cfg
-        S, L, n = cfg.seqs_per_block, cfg.learning_steps, cfg.forward_steps
-        bsz = cfg.batch_size
+        L = cfg.learning_steps
         with self.lock:
-            idxes, is_weights = self.tree.sample(bsz, rng)
-            b = idxes // S
-            s = idxes % S
-            # A stratum boundary can land on a zero-priority leaf of a
-            # partially-filled block; clamp instead of crashing (the
-            # reference asserts here, worker.py:228, against a misspelled
-            # attribute — SURVEY.md quirk 2). Rewrite idxes to the clamped
-            # slot so the learner's priority update lands on the sequence
-            # that was actually trained on, not the empty slot.
-            s = np.minimum(s, np.maximum(self.num_seq_store[b] - 1, 0))
-            idxes = b * S + s
+            b, s, idxes, is_weights = self._draw(rng)
 
             burn = self.burn_in_store[b, s]
             learn = self.learning_store[b, s]
@@ -199,31 +152,3 @@ class ReplayBuffer:
                 env_steps=self.env_steps,
             )
         return batch
-
-    # ------------------------------------------------------------- priority
-
-    def update_priorities(
-        self, idxes: np.ndarray, td_errors: np.ndarray, old_ptr: int
-    ) -> None:
-        """Apply learner priorities, discarding any index whose block was
-        overwritten during the sample->train round trip (the pointer-window
-        invariant of reference worker.py:290-307)."""
-        S = self.cfg.seqs_per_block
-        with self.lock:
-            ptr = self.block_ptr
-            if ptr > old_ptr:
-                mask = (idxes < old_ptr * S) | (idxes >= ptr * S)
-            elif ptr < old_ptr:
-                mask = (idxes < old_ptr * S) & (idxes >= ptr * S)
-            else:
-                mask = np.ones_like(idxes, dtype=bool)
-            self.tree.update(idxes[mask], td_errors[mask])
-
-    # -------------------------------------------------------------- metrics
-
-    def pop_episode_stats(self):
-        with self.lock:
-            n, r = self.num_episodes, self.episode_reward_sum
-            self.num_episodes = 0
-            self.episode_reward_sum = 0.0
-        return n, r

@@ -96,3 +96,18 @@ def test_vec_env_reset_all_starts_fresh_episodes():
     assert (obs[:, :3].max(axis=(1, 2, 3)) == 255).all()
     # rows 3..9 must be ball-free (only paddle rows 10-11 lit)
     assert (obs[:, 3:10] == 0).all()
+
+
+def test_catch_host_env_protocol():
+    """make_env('catch') must return a host-protocol env composable with
+    HostEnvPool (regression: it used to hand back a vec env)."""
+    from r2d2_tpu.actor import HostEnvPool
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.envs import make_env
+
+    cfg = tiny_test().replace(env_name="catch")
+    pool = HostEnvPool([make_env(cfg, seed=i) for i in range(2)])
+    obs = pool.reset_all()
+    assert obs.shape == (2, 12, 12, 1)
+    o, r, d, nxt = pool.step(np.zeros(2, np.int64))
+    assert o.shape == (2, 12, 12, 1) and len(r) == 2
