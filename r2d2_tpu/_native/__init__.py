@@ -1,0 +1,146 @@
+"""ctypes loader for the native replay core (replay_core.cpp).
+
+Builds the shared library with g++ on first import if it is missing or
+older than the source (pybind11 is not in this image; plain C ABI +
+ctypes needs no build-time Python dependency at all). Thread/process safe
+via an atomic rename. `load_native()` returns a NativeReplayCore or None —
+every caller must tolerate None and fall back to the numpy path, so a
+missing toolchain degrades performance, never correctness.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "replay_core.cpp")
+_LIB = os.path.join(_DIR, "libreplay_core.so")
+
+_lock = threading.Lock()
+_core: Optional["NativeReplayCore"] = None
+_load_failed = False
+
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    """(Re)compile the .so if missing/stale. Returns True if usable."""
+    try:
+        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+            return True
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+        os.close(fd)
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-fopenmp",
+            _SRC, "-o", tmp,
+        ]
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            # retry without OpenMP (toolchains without libgomp)
+            cmd = [c for c in cmd if c != "-fopenmp"]
+            r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+            if r.returncode != 0:
+                os.unlink(tmp)
+                return False
+        os.replace(tmp, _LIB)  # atomic: concurrent builders race benignly
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+class NativeReplayCore:
+    """The interface replay/sum_tree.py's `native` hook expects, plus the
+    window gatherer used by replay/replay_buffer.py batch assembly."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.tree_update.argtypes = [_f64p, ctypes.c_int64, _i64p, _f64p,
+                                    ctypes.c_int64, ctypes.c_double]
+        lib.tree_update.restype = None
+        lib.tree_sample.argtypes = [_f64p, ctypes.c_int64, _f64p,
+                                    ctypes.c_int64, _i64p]
+        lib.tree_sample.restype = None
+        lib.gather_windows.argtypes = [_u8p, ctypes.c_int64, ctypes.c_int64,
+                                       _i64p, _i64p, ctypes.c_int64,
+                                       ctypes.c_int64, _u8p]
+        lib.gather_windows.restype = None
+        lib.is_weights.argtypes = [_f64p, ctypes.c_int64, _i64p,
+                                   ctypes.c_int64, ctypes.c_double, _f32p]
+        lib.is_weights.restype = ctypes.c_int64
+
+    # --- sum tree ---------------------------------------------------------
+
+    def tree_update(self, tree: np.ndarray, num_layers: int,
+                    idxes: np.ndarray, td_errors: np.ndarray,
+                    alpha: float) -> None:
+        idxes = np.ascontiguousarray(idxes, np.int64)
+        td = np.ascontiguousarray(td_errors, np.float64)
+        self._lib.tree_update(tree, num_layers, idxes, td, len(idxes), alpha)
+
+    def tree_sample(self, tree: np.ndarray, num_layers: int,
+                    prefixsums: np.ndarray) -> np.ndarray:
+        prefixsums = np.ascontiguousarray(prefixsums, np.float64)
+        out = np.empty(len(prefixsums), np.int64)
+        self._lib.tree_sample(tree, num_layers, prefixsums, len(prefixsums), out)
+        return out
+
+    def is_weights(self, tree: np.ndarray, num_layers: int,
+                   nodes: np.ndarray, beta: float) -> np.ndarray:
+        nodes = np.ascontiguousarray(nodes, np.int64)
+        out = np.empty(len(nodes), np.float32)
+        self._lib.is_weights(tree, num_layers, nodes, len(nodes), beta, out)
+        return out
+
+    # --- batch assembly ---------------------------------------------------
+
+    def gather_windows(self, store: np.ndarray, b: np.ndarray,
+                       win_start: np.ndarray, T: int) -> np.ndarray:
+        """store: (num_blocks, slot, *row_shape) C-contiguous; returns
+        (B, T, *row_shape) with row indices clamped to [0, slot-1]."""
+        assert store.flags["C_CONTIGUOUS"]
+        slot = store.shape[1]
+        row_shape = store.shape[2:]
+        row_bytes = int(np.prod(row_shape, dtype=np.int64)) * store.itemsize
+        b = np.ascontiguousarray(b, np.int64)
+        win_start = np.ascontiguousarray(win_start, np.int64)
+        B = len(b)
+        out = np.empty((B, T, *row_shape), store.dtype)
+        self._lib.gather_windows(
+            store.view(np.uint8).reshape(-1),
+            slot, row_bytes, b, win_start, B, T,
+            out.view(np.uint8).reshape(-1),
+        )
+        return out
+
+
+def load_native() -> Optional[NativeReplayCore]:
+    """Build (if needed) and load the core; None if the toolchain or load
+    fails. Result is cached process-wide."""
+    global _core, _load_failed
+    if _core is not None:
+        return _core
+    if _load_failed:
+        return None
+    with _lock:
+        if _core is not None or _load_failed:
+            return _core
+        if not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+            _core = NativeReplayCore(lib)
+        except OSError:
+            _load_failed = True
+            return None
+        return _core

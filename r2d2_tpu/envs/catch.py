@@ -66,6 +66,15 @@ class CatchEnv:
         return CatchState(s.ball_x, ball_y, paddle_x, s.key), reward, done
 
 
+@functools.lru_cache(maxsize=None)
+def _host_fns(height: int, width: int):
+    """Jitted reset/step/render shared by every CatchHostEnv of the same
+    geometry — a pool of N envs compiles each computation once, not N
+    times."""
+    env = CatchEnv(height, width)
+    return jax.jit(env.reset), jax.jit(env.step), jax.jit(env.render)
+
+
 class CatchHostEnv:
     """Single-env host protocol (reset()/step(int)) over the functional
     core — what make_env returns so Catch composes with HostEnvPool like
@@ -76,9 +85,7 @@ class CatchHostEnv:
         self.action_dim = CatchEnv.NUM_ACTIONS
         self.obs_shape = (height, width, 1)
         self._key = jax.random.PRNGKey(seed)
-        self._step = jax.jit(self.env.step)
-        self._render = jax.jit(self.env.render)
-        self._reset = jax.jit(self.env.reset)
+        self._reset, self._step, self._render = _host_fns(height, width)
         self._state = None
 
     def reset(self) -> np.ndarray:

@@ -23,6 +23,11 @@ from r2d2_tpu.replay.sum_tree import SumTree
 class ReplayControlPlane:
     def __init__(self, cfg: R2D2Config, native: Optional[object] = None):
         self.cfg = cfg
+        if native is None and cfg.use_native_replay:
+            from r2d2_tpu._native import load_native
+
+            native = load_native()  # None if the toolchain is unavailable
+        self.native = native
         self.tree = SumTree(
             cfg.num_sequences, cfg.prio_exponent, cfg.is_exponent, native=native
         )

@@ -118,20 +118,33 @@ class ReplayBuffer(ReplayControlPlane):
             start = first_burn + s * L          # buffer coords of learning start
             win_start = start - burn
 
-            t = np.arange(cfg.seq_len)
-            rows = win_start[:, None] + t[None, :]
-            np.clip(rows, 0, cfg.block_slot_len - 1, out=rows)
-            bcol = b[:, None]
-            obs = self.obs_store[bcol, rows]
-            last_action = self.last_action_store[bcol, rows]
-            last_reward = self.last_reward_store[bcol, rows]
+            if self.native is not None:
+                # C++ memcpy gather (clamped-window batch assembly,
+                # _native/replay_core.cpp) — one call per field.
+                g = self.native.gather_windows
+                T = cfg.seq_len
+                obs = g(self.obs_store, b, win_start, T)
+                last_action = g(self.last_action_store, b, win_start, T)
+                last_reward = g(self.last_reward_store, b, win_start, T)
+                lstart = s * L
+                action = g(self.action_store, b, lstart, L).astype(np.int32)
+                n_step_reward = g(self.n_step_reward_store, b, lstart, L)
+                gamma = g(self.gamma_store, b, lstart, L)
+            else:
+                t = np.arange(cfg.seq_len)
+                rows = win_start[:, None] + t[None, :]
+                np.clip(rows, 0, cfg.block_slot_len - 1, out=rows)
+                bcol = b[:, None]
+                obs = self.obs_store[bcol, rows]
+                last_action = self.last_action_store[bcol, rows]
+                last_reward = self.last_reward_store[bcol, rows]
 
-            tl = np.arange(L)
-            lrows = s[:, None] * L + tl[None, :]
-            np.clip(lrows, 0, cfg.block_length - 1, out=lrows)
-            action = self.action_store[bcol, lrows].astype(np.int32)
-            n_step_reward = self.n_step_reward_store[bcol, lrows]
-            gamma = self.gamma_store[bcol, lrows]
+                tl = np.arange(L)
+                lrows = s[:, None] * L + tl[None, :]
+                np.clip(lrows, 0, cfg.block_length - 1, out=lrows)
+                action = self.action_store[bcol, lrows].astype(np.int32)
+                n_step_reward = self.n_step_reward_store[bcol, lrows]
+                gamma = self.gamma_store[bcol, lrows]
 
             hidden = self.hidden_store[b, s]
 

@@ -79,13 +79,17 @@ class SumTree:
 
         if self._native is not None:
             nodes = self._native.tree_sample(self.tree, self.num_layers, prefixsums)
-        else:
-            nodes = np.zeros(num_samples, dtype=np.int64)
-            for _ in range(self.num_layers - 1):
-                left = self.tree[nodes * 2 + 1]
-                go_left = prefixsums < left
-                nodes = np.where(go_left, nodes * 2 + 1, nodes * 2 + 2)
-                prefixsums = np.where(go_left, prefixsums, prefixsums - left)
+            is_weights = self._native.is_weights(
+                self.tree, self.num_layers, nodes, self.is_exponent
+            )
+            return (nodes - self.leaf_offset).astype(np.int64), is_weights
+
+        nodes = np.zeros(num_samples, dtype=np.int64)
+        for _ in range(self.num_layers - 1):
+            left = self.tree[nodes * 2 + 1]
+            go_left = prefixsums < left
+            nodes = np.where(go_left, nodes * 2 + 1, nodes * 2 + 2)
+            prefixsums = np.where(go_left, prefixsums, prefixsums - left)
 
         priorities = self.tree[nodes]
         # Float roundoff in the descent can land a stratum on a zero-priority
