@@ -99,6 +99,10 @@ class R2D2Config:
     checkpoint_dir: str = "checkpoints"
     metrics_path: Optional[str] = None  # jsonl metrics file
     use_native_replay: bool = True  # C++ replay core if built, else numpy
+    # replay data plane: "host" (numpy store, batches shipped per update),
+    # "device" (HBM store + fused in-jit gather, single chip), "sharded"
+    # (HBM store sharded over the dp mesh axis + shard_map train step)
+    replay_plane: str = "host"
 
     # --- derived ----------------------------------------------------------
     @property
@@ -140,6 +144,16 @@ class R2D2Config:
             raise ValueError("action_dim > 256 would overflow uint8 replay storage")
         if self.encoder not in ("nature", "impala", "mlp"):
             raise ValueError(f"unknown encoder {self.encoder!r}")
+        if self.replay_plane not in ("host", "device", "sharded"):
+            raise ValueError(f"unknown replay_plane {self.replay_plane!r}")
+        if self.replay_plane == "sharded":
+            if self.dp_size * self.tp_size <= 1:
+                raise ValueError("replay_plane='sharded' needs a device mesh "
+                                 "(dp_size * tp_size > 1)")
+            if self.num_blocks % max(self.dp_size, 1) != 0:
+                raise ValueError("num_blocks must divide evenly over dp_size")
+            if self.batch_size % max(self.dp_size, 1) != 0:
+                raise ValueError("batch_size must divide evenly over dp_size")
         return self
 
     def replace(self, **kw) -> "R2D2Config":
@@ -163,6 +177,8 @@ def atari_v4_8(game: str = "MsPacman") -> R2D2Config:
         dp_size=4,
         batch_size=64,
         compute_dtype="bfloat16",
+        # full reference capacity fits in HBM once sharded 4-way
+        replay_plane="sharded",
     ).validate()
 
 

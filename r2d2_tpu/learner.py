@@ -102,13 +102,25 @@ def init_train_state(cfg: R2D2Config, rng: jax.Array) -> Tuple[R2D2Network, Trai
     )
 
 
-def _raw_train_step(cfg: R2D2Config, net: R2D2Network):
+def _raw_train_step(cfg: R2D2Config, net: R2D2Network, axis_name: Optional[str] = None):
     """The un-jitted (state, batch) -> (state, metrics, priorities) body,
-    shared by the host-batch and device-store (fused) entry points."""
+    shared by the host-batch and device-store (fused) entry points.
+
+    axis_name=None: pure single-program body — under plain jit with the
+    batch sharded over a mesh, XLA inserts the gradient all-reduce itself.
+    axis_name="dp": the body runs per-shard under shard_map and all-reduces
+    gradients/metrics with an explicit lax.psum over the named axis (exact
+    because the loss denominator is psum'd globally first; the collective
+    rides ICI on a real slice)."""
     optimizer = make_optimizer(cfg)
     eps = cfg.value_rescale_eps
 
-    def loss_fn(params, target_params, b: DeviceBatch):
+    def loss_fn(params, target_params, b: DeviceBatch, denom):
+        """denom is the GLOBAL valid-step count: under shard_map it has
+        already been psum'd over dp, so per-shard losses are global-loss
+        contributions and a grad psum reproduces the global-batch gradient
+        exactly (per-shard mask sums differ, so pmean of local ratios would
+        not)."""
         q_learn, q_boot_online, mask = net.apply(
             params, b.obs, b.last_action, b.last_reward, b.hidden,
             b.burn_in_steps, b.learning_steps, b.forward_steps,
@@ -128,7 +140,6 @@ def _raw_train_step(cfg: R2D2Config, net: R2D2Network):
         q_taken = jnp.take_along_axis(q_learn, b.action[..., None], axis=-1)[..., 0]
         td = y - q_taken
         w = b.is_weights[:, None]
-        denom = jnp.maximum(jnp.sum(mask), 1.0)
         loss = jnp.sum(w * jnp.square(td) * mask) / denom
 
         abs_td = jnp.abs(td) * mask
@@ -141,9 +152,18 @@ def _raw_train_step(cfg: R2D2Config, net: R2D2Network):
         return loss, (priorities, aux)
 
     def train_step(state: TrainState, b: DeviceBatch):
+        # valid learning steps: mask row i has exactly learning_steps[i] ones
+        denom = jnp.sum(b.learning_steps).astype(jnp.float32)
+        if axis_name is not None:
+            denom = jax.lax.psum(denom, axis_name)
+        denom = jnp.maximum(denom, 1.0)
         (loss, (priorities, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, state.target_params, b
+            state.params, state.target_params, b, denom
         )
+        if axis_name is not None:
+            grads = jax.lax.psum(grads, axis_name)
+            loss = jax.lax.psum(loss, axis_name)
+            aux = jax.tree.map(lambda x: jax.lax.psum(x, axis_name), aux)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         step = state.step + 1
@@ -172,17 +192,11 @@ def make_train_step(cfg: R2D2Config, net: R2D2Network, donate: bool = True):
     return jax.jit(raw, donate_argnums=(0,) if donate else ())
 
 
-def make_fused_train_step(cfg: R2D2Config, net: R2D2Network, donate: bool = True):
-    """Train step over a DEVICE-RESIDENT replay store.
-
-    Signature: (state, stores, b, s, is_weights) -> (state, metrics,
-    priorities). The batch windows are gathered in-jit straight from HBM
-    (see replay/device_store.py), so only the (B,) sample coordinates cross
-    the host->device boundary per update — the whole point on hardware
-    where transfer, not compute, bounds the learner. Numerically identical
-    to make_train_step on the equivalent host-assembled batch (pinned by
-    test)."""
-    raw = _raw_train_step(cfg, net)
+def make_store_gather(cfg: R2D2Config):
+    """(stores, b, s, is_weights) -> DeviceBatch: in-jit clamped-window
+    gather straight out of the HBM-resident stores. b is a block index
+    LOCAL to whatever store shard the caller passes (the whole store under
+    plain jit; one dp shard under shard_map)."""
     L, T = cfg.learning_steps, cfg.seq_len
     slot, bl = cfg.block_slot_len, cfg.block_length
 
@@ -211,8 +225,105 @@ def make_fused_train_step(cfg: R2D2Config, net: R2D2Network, donate: bool = True
             is_weights=is_weights,
         )
 
+    return gather_batch
+
+
+def make_fused_train_step(cfg: R2D2Config, net: R2D2Network, donate: bool = True):
+    """Train step over a DEVICE-RESIDENT replay store.
+
+    Signature: (state, stores, b, s, is_weights) -> (state, metrics,
+    priorities). The batch windows are gathered in-jit straight from HBM
+    (see replay/device_store.py), so only the (B,) sample coordinates cross
+    the host->device boundary per update — the whole point on hardware
+    where transfer, not compute, bounds the learner. Numerically identical
+    to make_train_step on the equivalent host-assembled batch (pinned by
+    test)."""
+    raw = _raw_train_step(cfg, net)
+    gather_batch = make_store_gather(cfg)
+
     def fused(state: TrainState, stores, b, s, is_weights):
         batch = gather_batch(stores, b, s, is_weights)
         return raw(state, batch)
 
     return jax.jit(fused, donate_argnums=(0,) if donate else ())
+
+
+def make_gather_step(cfg: R2D2Config):
+    """Jitted (stores, b, s, is_weights) -> DeviceBatch: materialize the
+    sampled windows into a fresh HBM batch AT SAMPLE TIME.
+
+    This is the pipelined-mode counterpart of the fused step: a queued
+    fused-step item holds only coordinates, so a store slot overwritten
+    while the item waits would be gathered as DIFFERENT data than was
+    sampled. Gathering under the store lock at sample time freezes the
+    batch; the queue then carries ~4 MB of HBM per item instead of a
+    staleness hazard."""
+    return jax.jit(make_store_gather(cfg))
+
+
+def make_batch_train_step(cfg: R2D2Config, net: R2D2Network, donate: bool = True):
+    """Jitted (state, DeviceBatch) -> (state, metrics, priorities) over a
+    pre-gathered device-resident batch (from make_gather_step). Donates the
+    batch too: it was materialized for exactly one update."""
+    raw = _raw_train_step(cfg, net)
+    return jax.jit(raw, donate_argnums=(0, 1) if donate else ())
+
+
+def make_sharded_gather_step(cfg: R2D2Config, mesh):
+    """shard_map gather over the dp-sharded stores: each device gathers its
+    (B/dp) sub-batch locally; the result is one global DeviceBatch with
+    every leaf's batch axis sharded over dp — ready for the plain-jit train
+    step (XLA inserts the gradient psum)."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    gather_batch = make_store_gather(cfg)
+
+    def body(stores, b, s, is_weights):
+        return gather_batch(stores, b[0], s[0], is_weights[0])
+
+    gathered = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P("dp"), P("dp"), P("dp"), P("dp")),
+        out_specs=DeviceBatch(*([P("dp")] * len(DeviceBatch._fields))),
+        check_vma=False,
+    )
+    return jax.jit(gathered)
+
+
+def make_sharded_fused_train_step(cfg: R2D2Config, net: R2D2Network, mesh, donate: bool = True):
+    """Fused train step over a dp-SHARDED device replay store
+    (replay/sharded_store.ShardedDeviceReplay).
+
+    shard_map over the mesh's dp axis: each device gathers its local
+    (B/dp)-sequence sub-batch from its OWN store shard — no cross-device
+    data-plane traffic — computes local gradients, and all-reduces them
+    with lax.psum over dp (ICI; exact thanks to the globally-psum'd loss
+    denominator). Params/opt state replicated in and out.
+
+    Signature: (state, stores, b, s, is_weights) -> (state, metrics,
+    priorities) where b/s/is_weights are (dp, B/dp) stacked per-shard
+    coordinates with b LOCAL to each shard, and priorities come back
+    (dp, B/dp)."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    raw = _raw_train_step(cfg, net, axis_name="dp")
+    gather_batch = make_store_gather(cfg)
+
+    def body(state: TrainState, stores, b, s, is_weights):
+        # local views: stores = this device's (nb/dp, ...) block shard;
+        # b/s/is_weights arrive (1, B/dp) from their stacked (dp, B/dp) form
+        batch = gather_batch(stores, b[0], s[0], is_weights[0])
+        new_state, metrics, priorities = raw(state, batch)
+        return new_state, metrics, priorities[None, :]
+
+    sharded = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P("dp"), P("dp"), P("dp"), P("dp")),
+        out_specs=(P(), P(), P("dp")),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())

@@ -12,6 +12,7 @@ from r2d2_tpu.replay.block import Block
 from r2d2_tpu.replay.accumulator import SequenceAccumulator
 from r2d2_tpu.replay.replay_buffer import ReplayBuffer, SampledBatch
 from r2d2_tpu.replay.device_store import DeviceReplayBuffer, SampleIdx
+from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay, ShardedSampleIdx
 
 __all__ = [
     "SumTree",
@@ -21,4 +22,6 @@ __all__ = [
     "SampledBatch",
     "DeviceReplayBuffer",
     "SampleIdx",
+    "ShardedDeviceReplay",
+    "ShardedSampleIdx",
 ]

@@ -88,19 +88,18 @@ class DeviceReplayBuffer(ReplayControlPlane):
 
     # ------------------------------------------------------------------ add
 
-    def add_block(
-        self, block: Block, priorities: np.ndarray, episode_reward: Optional[float]
-    ) -> None:
-        cfg = self.cfg
+    @staticmethod
+    def pad_block_fields(cfg: R2D2Config, block: Block) -> Dict[str, np.ndarray]:
+        """Pad every block field to its fixed store-slot shape on host
+        (cheap memset) — shared with the dp-sharded store."""
         S, slot, bl = cfg.seqs_per_block, cfg.block_slot_len, cfg.block_length
 
-        # pad every field to its fixed slot shape on host (cheap memset)
         def pad(a, length, dtype):
             out = np.zeros((length, *a.shape[1:]), dtype)
             out[: len(a)] = a
             return out
 
-        vals = {
+        return {
             "obs": pad(block.obs, slot, np.uint8),
             "last_action": pad(block.last_action.astype(np.int32), slot, np.int32),
             "last_reward": pad(block.last_reward, slot, np.float32),
@@ -112,6 +111,11 @@ class DeviceReplayBuffer(ReplayControlPlane):
             "learning": pad(block.learning_steps, S, np.int32),
             "forward": pad(block.forward_steps, S, np.int32),
         }
+
+    def add_block(
+        self, block: Block, priorities: np.ndarray, episode_reward: Optional[float]
+    ) -> None:
+        vals = self.pad_block_fields(self.cfg, block)
 
         with self.lock:
             ptr = self._account_add(

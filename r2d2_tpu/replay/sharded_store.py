@@ -1,0 +1,210 @@
+"""dp-sharded device-resident replay: HBM capacity scales with the mesh.
+
+The single-chip DeviceReplayBuffer (replay/device_store.py) caps replay at
+one chip's HBM (~2M transitions of 84x84 obs fills 16 GB). This variant
+shards every store's block axis over the mesh's dp axis, so a v4-8 holds
+dp x that — the reference's full 2e6-transition capacity
+(reference config.py:16) fits in HBM on a 4-way mesh with room to spare.
+
+Design (mirrors the scaling-book recipe: pick a mesh, annotate shardings,
+let collectives ride ICI):
+
+- CONTROL PLANE: one host-side ReplayControlPlane PER SHARD (sum tree over
+  that shard's sequence slots, its own circular pointer + staleness
+  window). Blocks round-robin across shards, so every shard stays
+  statistically identical to a 1/dp-sized uniform slice of the stream.
+- DATA PLANE: one global jnp array per field with the block axis sharded
+  NamedSharding(mesh, P("dp")). A block write is a donated
+  dynamic_update_index_in_dim at the owning shard's global slot — XLA
+  resolves it to a local update on the owning device.
+- SAMPLING: each shard draws batch_size/dp sequences from its own tree;
+  IS weights are renormalized across shards to the BATCH-global minimum
+  priority, so weights match what a single global tree would produce for
+  the same draws (min is over the sampled batch, replay/sum_tree.py).
+- TRAINING: learner.make_sharded_fused_train_step runs under shard_map —
+  each device gathers its sub-batch from its LOCAL shard (zero cross-device
+  data-plane traffic) and gradients pmean over dp.
+
+Priority round trip: update_priorities applies each shard's slice under
+that shard's own pointer-window staleness mask (reference worker.py:290-307
+invariant, per shard).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.replay.block import Block
+from r2d2_tpu.replay.control_plane import ReplayControlPlane
+from r2d2_tpu.replay.device_store import DeviceReplayBuffer
+
+
+@dataclasses.dataclass
+class ShardedSampleIdx:
+    """Per-shard stacked sample coordinates (host side)."""
+
+    b: np.ndarray           # (dp, B/dp) block slot LOCAL to each shard
+    s: np.ndarray           # (dp, B/dp) sequence-in-block
+    is_weights: np.ndarray  # (dp, B/dp) float32, batch-globally normalized
+    idxes: np.ndarray       # (dp, B/dp) sequence slots LOCAL to each shard
+    old_ptrs: List[int]     # per-shard block pointer at sample time
+    env_steps: int
+
+
+class ShardedDeviceReplay:
+    def __init__(self, cfg: R2D2Config, mesh: Mesh):
+        dp = mesh.shape["dp"]
+        if cfg.num_blocks % dp != 0:
+            raise ValueError(f"num_blocks {cfg.num_blocks} not divisible by dp {dp}")
+        if cfg.batch_size % dp != 0:
+            raise ValueError(f"batch_size {cfg.batch_size} not divisible by dp {dp}")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.dp = dp
+        self.blocks_per_shard = cfg.num_blocks // dp
+        # per-shard view: 1/dp of capacity and batch; the shard config is
+        # single-plane (its own control plane knows nothing of the mesh)
+        shard_cfg = cfg.replace(
+            buffer_capacity=cfg.buffer_capacity // dp,
+            learning_starts=max(cfg.learning_starts // dp, 1),
+            batch_size=cfg.batch_size // dp,
+            dp_size=1,
+            tp_size=1,
+            replay_plane="host",
+        )
+        self.shards = [ReplayControlPlane(shard_cfg) for _ in range(dp)]
+        self._rr = 0  # round-robin write cursor over shards
+
+        S = cfg.seqs_per_block
+        nb, slot, bl = cfg.num_blocks, cfg.block_slot_len, cfg.block_length
+        shd = NamedSharding(mesh, P("dp"))
+        self.stores: Dict[str, jnp.ndarray] = {
+            "obs": jnp.zeros((nb, slot, *cfg.obs_shape), jnp.uint8, device=shd),
+            "last_action": jnp.zeros((nb, slot), jnp.int32, device=shd),
+            "last_reward": jnp.zeros((nb, slot), jnp.float32, device=shd),
+            "action": jnp.zeros((nb, bl), jnp.int32, device=shd),
+            "n_step_reward": jnp.zeros((nb, bl), jnp.float32, device=shd),
+            "gamma": jnp.zeros((nb, bl), jnp.float32, device=shd),
+            "hidden": jnp.zeros((nb, S, 2, cfg.hidden_dim), jnp.float32, device=shd),
+            "burn_in": jnp.zeros((nb, S), jnp.int32, device=shd),
+            "learning": jnp.zeros((nb, S), jnp.int32, device=shd),
+            "forward": jnp.zeros((nb, S), jnp.int32, device=shd),
+        }
+
+        def _write(stores, ptr, vals):
+            return {
+                k: jax.lax.dynamic_update_index_in_dim(arr, vals[k], ptr, axis=0)
+                for k, arr in stores.items()
+            }
+
+        self._write = jax.jit(
+            _write,
+            donate_argnums=(0,),
+            out_shardings={k: shd for k in self.stores},
+        )
+        self.lock = threading.Lock()
+
+    # ---------------------------------------------------------------- state
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self.shards)
+
+    @property
+    def env_steps(self) -> int:
+        return sum(s.env_steps for s in self.shards)
+
+    def can_sample(self) -> bool:
+        return (
+            len(self) >= self.cfg.learning_starts
+            and all(s.tree.total > 0 for s in self.shards)
+        )
+
+    def pop_episode_stats(self):
+        n = r = 0
+        for sh in self.shards:
+            ni, ri = sh.pop_episode_stats()
+            n += ni
+            r += ri
+        return n, r
+
+    # ------------------------------------------------------------------ add
+
+    def add_block(
+        self, block: Block, priorities: np.ndarray, episode_reward: Optional[float]
+    ) -> None:
+        cfg = self.cfg
+        vals = DeviceReplayBuffer.pad_block_fields(cfg, block)
+        with self.lock:
+            shard_id = self._rr
+            self._rr = (self._rr + 1) % self.dp
+            shard = self.shards[shard_id]
+            with shard.lock:
+                local_ptr = shard._account_add(
+                    block.num_sequences,
+                    int(block.learning_steps.sum()),
+                    priorities,
+                    episode_reward,
+                )
+            global_ptr = shard_id * self.blocks_per_shard + local_ptr
+            self.stores = self._write(self.stores, global_ptr, vals)
+
+    # --------------------------------------------------------------- sample
+
+    def sample_indices(self, rng: np.random.Generator) -> ShardedSampleIdx:
+        """Each shard draws B/dp sequences; IS weights renormalized to the
+        batch-global minimum priority so the sharded draw matches the
+        single-tree semantics."""
+        bs, ss, idxs, prios = [], [], [], []
+        old_ptrs = []
+        for shard in self.shards:
+            with shard.lock:
+                b, s, idxes, _w = shard._draw(rng)
+                old_ptrs.append(shard.block_ptr)
+                # read priorities under the SAME lock as the draw — an
+                # interleaved add_block would rewrite these leaves and the
+                # weights would no longer describe the drawn sample
+                p = shard.tree.priorities_of(idxes)
+            bs.append(b)
+            ss.append(s)
+            idxs.append(idxes)
+            prios.append(p)
+        p = np.stack(prios)  # (dp, B/dp) raw tree priorities
+        positive = p[p > 0.0]
+        min_p = positive.min() if positive.size else 1.0
+        w = np.power(np.maximum(p, min_p) / min_p, -self.cfg.is_exponent)
+        return ShardedSampleIdx(
+            b=np.stack(bs).astype(np.int32),
+            s=np.stack(ss).astype(np.int32),
+            is_weights=w.astype(np.float32),
+            idxes=np.stack(idxs),
+            old_ptrs=old_ptrs,
+            env_steps=self.env_steps,
+        )
+
+    # ------------------------------------------------------------ round trip
+
+    def update_priorities(
+        self, idxes: np.ndarray, td_errors: np.ndarray, old_ptrs: List[int]
+    ) -> None:
+        """idxes/td_errors: (dp, B/dp) as returned by sample/train."""
+        for shard, idx_row, td_row, old_ptr in zip(
+            self.shards, idxes, np.asarray(td_errors), old_ptrs
+        ):
+            shard.update_priorities(idx_row, td_row, old_ptr)
+
+    # ------------------------------------------------------------- dispatch
+
+    def run_with_stores(self, fn: Callable):
+        """Dispatch fn(stores) under the buffer lock (same contract as
+        DeviceReplayBuffer.run_with_stores: the donated write invalidates
+        prior store references)."""
+        with self.lock:
+            return fn(self.stores)

@@ -32,13 +32,27 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
+import jax.numpy as jnp
+
 from r2d2_tpu.actor import HostEnvPool, ParamStore, VectorizedActor
 from r2d2_tpu.config import PRESETS, R2D2Config, tiny_test
 from r2d2_tpu.envs import make_env
 from r2d2_tpu.envs.catch import CatchVecEnv
-from r2d2_tpu.learner import DeviceBatch, init_train_state, make_train_step
+from r2d2_tpu.learner import (
+    DeviceBatch,
+    init_train_state,
+    make_batch_train_step,
+    make_fused_train_step,
+    make_gather_step,
+    make_sharded_fused_train_step,
+    make_sharded_gather_step,
+    make_train_step,
+)
 from r2d2_tpu.ops.epsilon import epsilon_ladder
+from r2d2_tpu.parallel.mesh import make_mesh, replicated_sharding, shard_batch
+from r2d2_tpu.replay.device_store import DeviceReplayBuffer
 from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
 from r2d2_tpu.utils.checkpoint import latest_checkpoint_step, restore_checkpoint, save_checkpoint
 from r2d2_tpu.utils.metrics import MetricsLogger
 
@@ -51,6 +65,108 @@ def build_vec_env(cfg: R2D2Config, seed: int = 0):
             num_envs=cfg.num_actors, height=cfg.obs_shape[0], width=cfg.obs_shape[1], seed=seed
         )
     return HostEnvPool([make_env(cfg, seed=seed + i) for i in range(cfg.num_actors)])
+
+
+class _HostPlane:
+    """Host numpy replay; batches ship host->device each update. With a
+    mesh, batches shard over dp and XLA inserts the gradient psum. Batches
+    are copied out of the store at sample time, so queued items can never
+    go stale (pipelined == inline here)."""
+
+    def __init__(self, tr: "Trainer"):
+        self.tr = tr
+        self.replay = ReplayBuffer(tr.cfg)
+        self.step_fn = make_train_step(tr.cfg, tr.net)
+
+    def sample(self, pipelined: bool = False):
+        b = self.replay.sample_batch(self.tr.sample_rng)
+        dev = DeviceBatch.from_sampled(b)
+        if self.tr.mesh is not None:
+            dev = DeviceBatch(*shard_batch(self.tr.mesh, tuple(dev)))
+        return "batch", dev, b.idxes, b.old_ptr
+
+    def update(self, state, item):
+        _, dev, idxes, old_ptr = item
+        state, m, priorities = self.step_fn(state, dev)
+        self.replay.update_priorities(idxes, np.asarray(priorities), old_ptr)
+        return state, m
+
+
+class _DevicePlane:
+    """Single-chip HBM replay (replay/device_store.py).
+
+    Inline mode queues only sample COORDINATES and the fused step gathers
+    in-jit at update time (fastest: nothing but a kilobyte crosses the
+    wire, no intermediate batch). Pipelined mode materializes the batch in
+    HBM at sample time (make_gather_step) so an item sitting in the
+    prefetch queue cannot be invalidated by a concurrent block write."""
+
+    def __init__(self, tr: "Trainer"):
+        self.tr = tr
+        self.replay = DeviceReplayBuffer(tr.cfg)
+        self.step_fn = make_fused_train_step(tr.cfg, tr.net)
+        self.gather_fn = make_gather_step(tr.cfg)
+        self.batch_step_fn = make_batch_train_step(tr.cfg, tr.net)
+
+    def sample(self, pipelined: bool = False):
+        si = self.replay.sample_indices(self.tr.sample_rng)
+        coords = (jax.device_put(si.b), jax.device_put(si.s), jax.device_put(si.is_weights))
+        if pipelined:
+            batch = self.replay.run_with_stores(lambda stores: self.gather_fn(stores, *coords))
+            return "batch", batch, si.idxes, si.old_ptr
+        return "coords", coords, si.idxes, si.old_ptr
+
+    def update(self, state, item):
+        kind, payload, idxes, old_ptr = item
+        if kind == "batch":
+            state, m, priorities = self.batch_step_fn(state, payload)
+        else:
+            state, m, priorities = self.replay.run_with_stores(
+                lambda stores: self.step_fn(state, stores, *payload)
+            )
+        self.replay.update_priorities(idxes, np.asarray(priorities), old_ptr)
+        return state, m
+
+
+class _ShardedPlane:
+    """dp-sharded HBM replay + shard_map train step: local gathers per
+    shard, gradient psum over dp (replay/sharded_store.py). Same
+    inline/pipelined split as _DevicePlane; the pipelined gather runs under
+    shard_map so each device materializes its local sub-batch."""
+
+    def __init__(self, tr: "Trainer"):
+        if tr.mesh is None:
+            raise ValueError("replay_plane='sharded' needs dp_size*tp_size > 1")
+        self.tr = tr
+        self.replay = ShardedDeviceReplay(tr.cfg, tr.mesh)
+        self.step_fn = make_sharded_fused_train_step(tr.cfg, tr.net, tr.mesh)
+        self.gather_fn = make_sharded_gather_step(tr.cfg, tr.mesh)
+        self.batch_step_fn = make_batch_train_step(tr.cfg, tr.net)
+
+    def sample(self, pipelined: bool = False):
+        si = self.replay.sample_indices(self.tr.sample_rng)
+        coords = (jnp.asarray(si.b), jnp.asarray(si.s), jnp.asarray(si.is_weights))
+        if pipelined:
+            batch = self.replay.run_with_stores(lambda stores: self.gather_fn(stores, *coords))
+            return "batch", batch, si.idxes, si.old_ptrs
+        return "coords", coords, si.idxes, si.old_ptrs
+
+    def update(self, state, item):
+        kind, payload, idxes, old_ptrs = item
+        if kind == "batch":
+            # gathered batch is dp-sharded; plain jit inserts the grad psum
+            state, m, priorities = self.batch_step_fn(state, payload)
+            priorities = np.asarray(priorities).reshape(self.replay.dp, -1)
+        else:
+            state, m, priorities = self.replay.run_with_stores(
+                lambda stores: self.step_fn(state, stores, *payload)
+            )
+            priorities = np.asarray(priorities)
+        self.replay.update_priorities(idxes, priorities, old_ptrs)
+        return state, m
+
+
+_PLANES = {"host": _HostPlane, "device": _DevicePlane, "sharded": _ShardedPlane}
 
 
 class Trainer:
@@ -67,7 +183,16 @@ class Trainer:
             cfg = cfg.replace(action_dim=self.vec_env.action_dim)
             self.cfg = cfg
 
+        # mesh: dp x tp when the config asks for parallelism (collectives
+        # ride ICI on a real slice; tests run on the 8-fake-device CPU mesh)
+        self.mesh = None
+        if cfg.dp_size * cfg.tp_size > 1:
+            self.mesh = make_mesh(dp=cfg.dp_size, tp=cfg.tp_size,
+                                  devices=jax.devices()[: cfg.dp_size * cfg.tp_size])
+
         self.net, self.state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
+        if self.mesh is not None:
+            self.state = jax.device_put(self.state, replicated_sharding(self.mesh))
         self.env_steps_offset = 0
         self.wall_minutes_offset = 0.0
         if resume and latest_checkpoint_step(cfg.checkpoint_dir) is not None:
@@ -75,7 +200,9 @@ class Trainer:
                 cfg.checkpoint_dir, self.state
             )
 
-        self.replay = ReplayBuffer(cfg)
+        self.sample_rng = np.random.default_rng(cfg.seed + 2)
+        self.plane = _PLANES[cfg.replay_plane](self)
+        self.replay = self.plane.replay
         self.param_store = ParamStore(self.state.params)
         self.actor = VectorizedActor(
             cfg,
@@ -86,16 +213,13 @@ class Trainer:
             self.replay.add_block,
             seed=cfg.seed + 1,
         )
-        self.train_step = make_train_step(cfg, self.net)
-        self.sample_rng = np.random.default_rng(cfg.seed + 2)
         self.metrics = metrics or MetricsLogger(cfg.metrics_path, cfg.log_interval)
         self._stop = threading.Event()
 
     # ------------------------------------------------------------- plumbing
 
-    def _one_update(self, dev_batch: DeviceBatch, idxes, old_ptr):
-        self.state, m, priorities = self.train_step(self.state, dev_batch)
-        self.replay.update_priorities(idxes, np.asarray(priorities), old_ptr)
+    def _one_update(self, item):
+        self.state, m = self.plane.update(self.state, item)
         step = int(self.state.step)
         if step % self.cfg.publish_interval == 0:
             self.param_store.publish(self.state.params)
@@ -142,9 +266,7 @@ class Trainer:
         while int(self.state.step) < cfg.training_steps:
             for _ in range(max(k // self.vec_env.num_envs, 1)):
                 self.actor.step()
-            batch = self.replay.sample_batch(self.sample_rng)
-            dev = DeviceBatch.from_sampled(batch)
-            m, step = self._one_update(dev, batch.idxes, batch.old_ptr)
+            m, step = self._one_update(self.plane.sample())
             self._log(m, step)
 
     def run_threaded(self) -> None:
@@ -173,11 +295,12 @@ class Trainer:
 
         def sampler_loop():
             while not self._stop.is_set():
-                b = self.replay.sample_batch(self.sample_rng)
-                dev = DeviceBatch.from_sampled(b)  # device_put off the hot loop
+                # pipelined: gather/copy at sample time so queued items
+                # cannot be invalidated by concurrent block writes
+                item = self.plane.sample(pipelined=True)
                 while not self._stop.is_set():
                     try:
-                        batch_q.put((dev, b.idxes, b.old_ptr), timeout=0.5)
+                        batch_q.put(item, timeout=0.5)
                         break
                     except queue.Full:
                         pass
@@ -191,12 +314,12 @@ class Trainer:
         try:
             while int(self.state.step) < cfg.training_steps:
                 try:
-                    dev, idxes, old_ptr = batch_q.get(timeout=2.0)
+                    item = batch_q.get(timeout=2.0)
                 except queue.Empty:
                     if self._thread_error is not None:
                         raise RuntimeError("worker thread failed") from self._thread_error
                     continue
-                m, step = self._one_update(dev, idxes, old_ptr)
+                m, step = self._one_update(item)
                 self._log(m, step)
             if self._thread_error is not None:
                 raise RuntimeError("worker thread failed") from self._thread_error
@@ -212,6 +335,8 @@ def main(argv=None):
     p.add_argument("--env", default=None, help="override env name (e.g. catch)")
     p.add_argument("--steps", type=int, default=None)
     p.add_argument("--mode", default="threaded", choices=["threaded", "inline"])
+    p.add_argument("--replay", default=None, choices=["host", "device", "sharded"],
+                   help="replay data plane (default: preset's replay_plane)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--metrics", default=None)
     args = p.parse_args(argv)
@@ -224,6 +349,8 @@ def main(argv=None):
         overrides["training_steps"] = args.steps
     if args.metrics:
         overrides["metrics_path"] = args.metrics
+    if args.replay:
+        overrides["replay_plane"] = args.replay
     if overrides:
         cfg = cfg.replace(**overrides)
 
