@@ -120,9 +120,18 @@ class VectorizedActor:
         )
         self.params, self.param_version = param_store.latest()
 
+        self._reset_state(np.array(env.reset_all()))  # writable copy (vec
+        self.total_steps = 0     # envs may hand back read-only device buffers)
+        self._steps_since_refresh = 0
+
+    def _reset_state(self, obs: np.ndarray) -> None:
+        """Per-episode-stream state: accumulators seeded with `obs`, zeroed
+        carry/last-action/last-reward, cleared pending-cut flags. Shared by
+        __init__ and resync so restart recovery can never miss a field."""
+        cfg = self.cfg
+        E = self.env.num_envs
         self.accs: List[SequenceAccumulator] = [SequenceAccumulator(cfg) for _ in range(E)]
-        obs = np.array(env.reset_all())  # writable copy (vec envs may hand
-        for i in range(E):               # back read-only device buffers)
+        for i in range(E):
             self.accs[i].reset(obs[i])
         self.obs = obs
         self.last_action = np.zeros(E, np.int32)
@@ -132,8 +141,6 @@ class VectorizedActor:
             jnp.zeros((E, cfg.hidden_dim), jnp.float32),
         )
         self.episode_steps = np.zeros(E, np.int64)
-        self.total_steps = 0
-        self._steps_since_refresh = 0
         # envs whose accumulator awaits a bootstrap Q from the next policy call
         self._pending_cut = np.zeros(E, bool)
         self._pending_truncate = np.zeros(E, bool)
@@ -231,6 +238,16 @@ class VectorizedActor:
         if self._steps_since_refresh >= cfg.actor_update_interval:
             self._steps_since_refresh = 0
             self._maybe_refresh_params()
+
+    def resync(self) -> None:
+        """Recover to a consistent state after a mid-step fault (the
+        supervisor's restart hook). step() is not re-entrant once env.step
+        has run — a crash between env.step and the accumulator writes would
+        leave self.obs/carry describing the pre-step world while the env
+        has advanced, and re-entering would push misaligned (obs, action,
+        hidden) sequences into replay. Instead: discard every in-flight
+        accumulator window and start fresh episodes in all slots."""
+        self._reset_state(np.array(self.env.reset_all()))
 
     # ---------------------------------------------------------------- utils
 

@@ -96,6 +96,10 @@ class R2D2Config:
 
     # --- infra ------------------------------------------------------------
     seed: int = 0
+    # supervision (utils/supervision.py): restart budget per worker thread
+    # and seconds of silent heartbeat before a stall is reported
+    worker_max_restarts: int = 3
+    heartbeat_timeout: float = 120.0
     checkpoint_dir: str = "checkpoints"
     metrics_path: Optional[str] = None  # jsonl metrics file
     use_native_replay: bool = True  # C++ replay core if built, else numpy

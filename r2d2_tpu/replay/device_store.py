@@ -118,10 +118,13 @@ class DeviceReplayBuffer(ReplayControlPlane):
         vals = self.pad_block_fields(self.cfg, block)
 
         with self.lock:
-            ptr = self._account_add(
+            # write first, account last (see replay_buffer.add_block): the
+            # fallible work — shape validation in pad_block_fields and the
+            # jitted write dispatch — completes before tree/ptr mutate
+            self.stores = self._write(self.stores, self.block_ptr, vals)
+            self._account_add(
                 block.num_sequences, int(block.learning_steps.sum()), priorities, episode_reward
             )
-            self.stores = self._write(self.stores, ptr, vals)
 
     # --------------------------------------------------------------- sample
 

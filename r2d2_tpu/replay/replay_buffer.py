@@ -77,9 +77,11 @@ class ReplayBuffer(ReplayControlPlane):
         to seqs_per_block (zeros for absent sequences)."""
         S = self.cfg.seqs_per_block
         with self.lock:
-            ptr = self._account_add(
-                block.num_sequences, int(block.learning_steps.sum()), priorities, episode_reward
-            )
+            # data writes FIRST, accounting last: a malformed block (flaky
+            # env shapes) raises here before the tree/pointer mutate, so a
+            # supervised-restart run can never train on a slot whose
+            # priorities describe data that was never written
+            ptr = self.block_ptr
             steps = block.stored_steps
             self.obs_store[ptr, :steps] = block.obs
             self.last_action_store[ptr, :steps] = block.last_action
@@ -96,6 +98,9 @@ class ReplayBuffer(ReplayControlPlane):
             self.burn_in_store[ptr, :ns] = block.burn_in_steps
             self.learning_store[ptr, :ns] = block.learning_steps
             self.forward_store[ptr, :ns] = block.forward_steps
+            self._account_add(
+                block.num_sequences, int(block.learning_steps.sum()), priorities, episode_reward
+            )
 
     # --------------------------------------------------------------- sample
 

@@ -144,17 +144,18 @@ class ShardedDeviceReplay:
         vals = DeviceReplayBuffer.pad_block_fields(cfg, block)
         with self.lock:
             shard_id = self._rr
-            self._rr = (self._rr + 1) % self.dp
             shard = self.shards[shard_id]
             with shard.lock:
-                local_ptr = shard._account_add(
+                # write first, account last (see replay_buffer.add_block)
+                global_ptr = shard_id * self.blocks_per_shard + shard.block_ptr
+                self.stores = self._write(self.stores, global_ptr, vals)
+                shard._account_add(
                     block.num_sequences,
                     int(block.learning_steps.sum()),
                     priorities,
                     episode_reward,
                 )
-            global_ptr = shard_id * self.blocks_per_shard + local_ptr
-            self.stores = self._write(self.stores, global_ptr, vals)
+            self._rr = (self._rr + 1) % self.dp
 
     # --------------------------------------------------------------- sample
 

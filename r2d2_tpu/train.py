@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import argparse
 import queue
-import threading
 import time
 from typing import Callable, Optional
 
@@ -55,6 +54,7 @@ from r2d2_tpu.replay.replay_buffer import ReplayBuffer
 from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
 from r2d2_tpu.utils.checkpoint import latest_checkpoint_step, restore_checkpoint, save_checkpoint
 from r2d2_tpu.utils.metrics import MetricsLogger
+from r2d2_tpu.utils.supervision import Supervisor
 
 
 def build_vec_env(cfg: R2D2Config, seed: int = 0):
@@ -214,7 +214,6 @@ class Trainer:
             seed=cfg.seed + 1,
         )
         self.metrics = metrics or MetricsLogger(cfg.metrics_path, cfg.log_interval)
-        self._stop = threading.Event()
 
     # ------------------------------------------------------------- plumbing
 
@@ -232,7 +231,7 @@ class Trainer:
             )
         return m, step
 
-    def _log(self, m, step):
+    def _log(self, m, step, extra: Optional[dict] = None):
         n_ep, r_sum = self.replay.pop_episode_stats()
         self.metrics.log(
             {
@@ -243,6 +242,7 @@ class Trainer:
                 "q_mean": float(m["q_mean"]),
                 "episodes": n_ep,
                 "mean_return": (r_sum / n_ep) if n_ep else None,
+                **(extra or {}),
             }
         )
 
@@ -271,62 +271,63 @@ class Trainer:
 
     def run_threaded(self) -> None:
         """Actor thread + prefetch thread + learner loop (reference
-        worker.py:110-175,364-371 collapsed into shared memory)."""
+        worker.py:110-175,364-371 collapsed into shared memory). Worker
+        threads run under a Supervisor (utils/supervision.py): a crashed
+        actor/sampler iteration is restarted with the traceback recorded
+        instead of silently starving the learner (SURVEY.md section 5.3)."""
         cfg = self.cfg
         self._start_time = time.time()
         self.warmup()
 
         batch_q: "queue.Queue" = queue.Queue(maxsize=8)
-        self._thread_error: Optional[BaseException] = None
+        sup = Supervisor(heartbeat_timeout=cfg.heartbeat_timeout)
 
-        def _guard(fn):
-            def run():
-                try:
-                    fn()
-                except BaseException as e:  # surface worker failures
-                    self._thread_error = e
-                    self._stop.set()
+        def actor_body():
+            self.actor.step()
 
-            return run
+        # one sample + one bounded put attempt per call: a full queue (the
+        # learner compiling or checkpointing) retries across calls, keeping
+        # the heartbeat fresh instead of looking like a stall
+        pending = [None]
 
-        def actor_loop():
-            while not self._stop.is_set():
-                self.actor.step()
-
-        def sampler_loop():
-            while not self._stop.is_set():
+        def sampler_body():
+            if pending[0] is None:
                 # pipelined: gather/copy at sample time so queued items
                 # cannot be invalidated by concurrent block writes
-                item = self.plane.sample(pipelined=True)
-                while not self._stop.is_set():
-                    try:
-                        batch_q.put(item, timeout=0.5)
-                        break
-                    except queue.Full:
-                        pass
+                pending[0] = self.plane.sample(pipelined=True)
+            try:
+                batch_q.put(pending[0], timeout=0.5)
+                pending[0] = None
+            except queue.Full:
+                pass
 
-        threads = [
-            threading.Thread(target=_guard(actor_loop), daemon=True),
-            threading.Thread(target=_guard(sampler_loop), daemon=True),
-        ]
-        for t in threads:
-            t.start()
+        def sampler_recover():
+            pending[0] = None  # a half-built item may be inconsistent
+
+        sup.spawn("actor", actor_body, max_restarts=cfg.worker_max_restarts,
+                  on_restart=self.actor.resync)
+        sup.spawn("sampler", sampler_body, max_restarts=cfg.worker_max_restarts,
+                  on_restart=sampler_recover)
+        last_health: Optional[dict] = None
         try:
             while int(self.state.step) < cfg.training_steps:
                 try:
                     item = batch_q.get(timeout=2.0)
                 except queue.Empty:
-                    if self._thread_error is not None:
-                        raise RuntimeError("worker thread failed") from self._thread_error
+                    # raises WorkerFatalError on a dead worker; stall/restart
+                    # transitions still reach the metrics stream even though
+                    # no update is flowing (that is exactly when they matter)
+                    stats = sup.check()
+                    if stats != last_health:
+                        last_health = stats
+                        self.metrics.log({"step": int(self.state.step), **stats})
                     continue
                 m, step = self._one_update(item)
-                self._log(m, step)
-            if self._thread_error is not None:
-                raise RuntimeError("worker thread failed") from self._thread_error
+                health = sup.check()
+                last_health = health
+                self._log(m, step, extra=health)
         finally:
-            self._stop.set()
-            for t in threads:
-                t.join(timeout=5.0)
+            sup.shutdown()
 
 
 def main(argv=None):

@@ -1,0 +1,162 @@
+"""Worker supervision: heartbeats, crash restart, stall detection.
+
+The reference has no failure handling at all (SURVEY.md section 5.3): actors
+are `while True` loops killed by terminate (reference train.py:61-62); a
+crashed actor silently reduces throughput and a crashed learner hangs the
+buffer process. Here every host-side worker loop runs under a Supervisor:
+
+- each loop iteration stamps a heartbeat; a worker whose heartbeat goes
+  stale past `heartbeat_timeout` is reported as stalled (Python threads
+  cannot be preempted, so stalls are surfaced, not killed);
+- a worker that raises has its traceback printed and recorded, its
+  `on_restart` recovery hook run (e.g. VectorizedActor.resync, which
+  discards in-flight state that a mid-iteration fault may have left
+  inconsistent), and its loop re-entered — up to `max_restarts` times.
+  Past the limit, or if the recovery hook itself fails, the worker is
+  fatal and `check()` raises in the learner loop, failing the run loudly
+  instead of silently starving it;
+- restart/stall counts flow into the metrics stream.
+
+Bodies should do a bounded amount of work per call (one actor step, one
+queue-put attempt) so heartbeats stay fresh while blocked resources — a
+full queue, a compiling learner — are retried across calls, not inside one.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Dict, List, Optional
+
+
+class SupervisedWorker:
+    """One host worker loop: `body()` is called repeatedly until stop."""
+
+    def __init__(
+        self,
+        name: str,
+        body: Callable[[], None],
+        stop: threading.Event,
+        max_restarts: int = 3,
+        on_restart: Optional[Callable[[], None]] = None,
+        error_history: int = 5,
+    ):
+        self.name = name
+        self.body = body
+        self.stop = stop
+        self.max_restarts = max_restarts
+        self.on_restart = on_restart
+        self.restarts = 0
+        self.last_beat = time.monotonic()
+        self.errors: List[str] = []  # most recent `error_history` tracebacks
+        self._error_history = error_history
+        self.fatal = False
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def last_error(self) -> Optional[str]:
+        return self.errors[-1] if self.errors else None
+
+    def _record_error(self, context: str) -> None:
+        tb = traceback.format_exc()
+        with self._lock:
+            self.errors.append(tb)
+            del self.errors[: -self._error_history]
+        print(f"[supervisor] worker {self.name!r} {context}:\n{tb}", file=sys.stderr)
+
+    def _loop(self) -> None:
+        while not self.stop.is_set():
+            self.last_beat = time.monotonic()
+            try:
+                self.body()
+            except BaseException:
+                exhausted = self.restarts >= self.max_restarts
+                self._record_error(
+                    f"crashed (restart budget exhausted, {self.restarts}/{self.max_restarts})"
+                    if exhausted
+                    else f"crashed (restart {self.restarts + 1}/{self.max_restarts})"
+                )
+                if exhausted:
+                    self.fatal = True
+                    return
+                self.restarts += 1
+                if self.on_restart is not None:
+                    try:
+                        self.on_restart()
+                    except BaseException:
+                        self._record_error("recovery hook failed; going fatal")
+                        self.fatal = True
+                        return
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name=f"supervised-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def stalled_for(self) -> float:
+        return time.monotonic() - self.last_beat
+
+
+class WorkerFatalError(RuntimeError):
+    pass
+
+
+class Supervisor:
+    def __init__(self, heartbeat_timeout: float = 120.0):
+        self.heartbeat_timeout = heartbeat_timeout
+        self.workers: List[SupervisedWorker] = []
+        self.stop = threading.Event()
+        self._stall_reported: Dict[str, bool] = {}
+
+    def spawn(
+        self,
+        name: str,
+        body: Callable[[], None],
+        max_restarts: int = 3,
+        on_restart: Optional[Callable[[], None]] = None,
+    ) -> SupervisedWorker:
+        w = SupervisedWorker(
+            name, body, self.stop, max_restarts=max_restarts, on_restart=on_restart
+        )
+        self.workers.append(w)
+        w.start()
+        return w
+
+    def check(self) -> Dict[str, int]:
+        """Raise WorkerFatalError if any worker died for good; return
+        restart/stall counters for the metrics stream."""
+        restarts = 0
+        stalls = 0
+        for w in self.workers:
+            if w.fatal:
+                self.stop.set()
+                raise WorkerFatalError(
+                    f"worker {w.name!r} died ({w.restarts} restarts used); "
+                    f"last error:\n{w.last_error}"
+                )
+            restarts += w.restarts
+            if not self.stop.is_set() and w.stalled_for() > self.heartbeat_timeout:
+                stalls += 1
+                if not self._stall_reported.get(w.name):
+                    self._stall_reported[w.name] = True
+                    print(
+                        f"[supervisor] worker {w.name!r} heartbeat stale for "
+                        f"{w.stalled_for():.0f}s",
+                        file=sys.stderr,
+                    )
+            else:
+                self._stall_reported[w.name] = False
+        return {"worker_restarts": restarts, "worker_stalls": stalls}
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        self.stop.set()
+        for w in self.workers:
+            w.join(timeout)
