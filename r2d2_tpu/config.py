@@ -90,9 +90,15 @@ class R2D2Config:
     # "tp" shards wide layers (impala encoder / LSTM kernels) when > 1.
     dp_size: int = 1
     tp_size: int = 1
-    # chunk size for remat'd long-sequence scans (long-context configs);
-    # None disables gradient checkpointing of the unroll.
+    # chunk size for remat'd long-sequence scans. SCAN-BACKEND KNOB ONLY:
+    # the Pallas unroll stores no per-gate residuals (gates are recomputed
+    # in its backward kernel), so it has nothing to remat — when the pallas
+    # backend is active, scan_chunk is intentionally unused and the config
+    # stays valid for the CPU/scan fallback the test suite runs.
     scan_chunk: Optional[int] = None
+    # LSTM unroll backend: "auto" = fused Pallas kernel on TPU, lax.scan
+    # elsewhere; "scan"/"pallas" force one (ops/pallas_lstm.py)
+    lstm_backend: str = "auto"
 
     # --- infra ------------------------------------------------------------
     seed: int = 0
@@ -148,6 +154,8 @@ class R2D2Config:
             raise ValueError("action_dim > 256 would overflow uint8 replay storage")
         if self.encoder not in ("nature", "impala", "mlp"):
             raise ValueError(f"unknown encoder {self.encoder!r}")
+        if self.lstm_backend not in ("auto", "scan", "pallas"):
+            raise ValueError(f"unknown lstm_backend {self.lstm_backend!r}")
         if self.replay_plane not in ("host", "device", "sharded"):
             raise ValueError(f"unknown replay_plane {self.replay_plane!r}")
         if self.replay_plane == "sharded":

@@ -44,6 +44,10 @@ class LSTM(nn.Module):
     dtype: jnp.dtype = jnp.float32
     # remat chunk length for long unrolls; None = single un-remat'd scan
     scan_chunk: Optional[int] = None
+    # "scan": lax.scan unroll. "pallas": fused Pallas kernel (ops/
+    # pallas_lstm.py) — recurrent weights + carry stay VMEM-resident for
+    # the whole unroll. "auto": pallas on TPU, scan elsewhere.
+    backend: str = "auto"
 
     def setup(self):
         H = self.hidden_dim
@@ -78,6 +82,18 @@ class LSTM(nn.Module):
         # one MXU-sized matmul for every timestep's input projection
         proj = (xs.reshape(B * T, D) @ wi + b).reshape(B, T, 4 * self.hidden_dim)
         proj_t = jnp.swapaxes(proj, 0, 1)  # (T, B, 4H) time-major for scan
+
+        use_pallas = self.backend == "pallas" or (
+            self.backend == "auto" and jax.default_backend() == "tpu"
+        )
+        if use_pallas:
+            from r2d2_tpu.ops.pallas_lstm import lstm_unroll
+
+            outs_t, (hT, cT) = lstm_unroll(proj_t, wh, h, c)
+            return (
+                jnp.swapaxes(outs_t, 0, 1),
+                (hT.astype(self.dtype), cT.astype(self.dtype)),
+            )
 
         def step(carry, p):
             h, c = carry

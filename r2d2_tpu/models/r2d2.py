@@ -52,6 +52,7 @@ class R2D2Network(nn.Module):
     compute_dtype: str = "float32"
     impala_channels: Tuple[int, ...] = (16, 32, 32)
     scan_chunk: int | None = None
+    lstm_backend: str = "auto"
 
     @classmethod
     def from_config(cls, cfg: R2D2Config) -> "R2D2Network":
@@ -64,6 +65,7 @@ class R2D2Network(nn.Module):
             compute_dtype=cfg.compute_dtype,
             impala_channels=tuple(cfg.impala_channels),
             scan_chunk=cfg.scan_chunk,
+            lstm_backend=cfg.lstm_backend,
         )
 
     def setup(self):
@@ -71,7 +73,13 @@ class R2D2Network(nn.Module):
         self.enc = make_encoder(self.encoder, self.hidden_dim, dtype, self.impala_channels)
         # LSTM input = concat(latent, one-hot action, reward) (model.py:59)
         core_in = self.hidden_dim + self.action_dim + 1
-        self.core = LSTM(self.hidden_dim, in_dim=core_in, dtype=dtype, scan_chunk=self.scan_chunk)
+        self.core = LSTM(
+            self.hidden_dim,
+            in_dim=core_in,
+            dtype=dtype,
+            scan_chunk=self.scan_chunk,
+            backend=self.lstm_backend,
+        )
         self.adv_hidden = nn.Dense(self.hidden_dim)
         self.adv_out = nn.Dense(self.action_dim)
         self.val_hidden = nn.Dense(self.hidden_dim)

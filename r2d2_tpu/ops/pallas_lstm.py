@@ -1,0 +1,250 @@
+"""Fused Pallas LSTM unroll — the TPU kernel for the framework's hot op.
+
+The learner's sequence unroll (reference model.py:59,133-139 leans on a
+cuDNN packed-sequence LSTM) is the latency-bound part of the jitted update:
+T=85 strictly sequential recurrent steps whose per-step matmul
+(B, H) x (H, 4H) is far too small to amortize HBM traffic if the loop body
+re-fetches operands. This kernel runs the WHOLE unroll as one `pallas_call`
+with a sequential grid over time:
+
+- the recurrent weights `wh` (H, 4H) are fetched into VMEM once and stay
+  resident for all T steps (the index_map pins the same block every
+  iteration, so the pipeline does not re-copy it),
+- the (h, c) carry lives in VMEM scratch across grid steps (TPU grid
+  iterations execute sequentially, scratch persists),
+- per step: one MXU matmul (B,H)x(H,4H) + VPU gate math, fused — nothing
+  touches HBM except streaming in proj_t and streaming out h_t/c_t.
+
+The input projection x @ Wi + b for ALL timesteps is deliberately NOT in
+the kernel: it is one big (B*T, D) x (D, 4H) matmul that XLA already maps
+perfectly onto the MXU (models/lstm.py does it), and keeping it outside
+lets autodiff handle dWi/db for free.
+
+Backward is a second Pallas kernel walking the grid in reverse time order,
+carrying (dh, dc) in scratch and emitting per-step pre-activation grads dz;
+the weight gradient dWh = h_prev^T @ dz then falls out as one big MXU
+matmul outside the kernel (same trick as forward). Residuals saved: the
+h_t and c_t sequences — gates are recomputed in the backward kernel (one
+extra matmul per step, cheaper than storing 4H activations).
+
+Numerics: gate math and the carry accumulate in float32 regardless of the
+compute dtype; matmuls run in the weights' dtype with
+preferred_element_type=float32 (bfloat16 feeds the MXU at double rate).
+
+On non-TPU backends the kernels run in Pallas interpret mode, which is how
+the CPU test suite pins forward/gradient parity against the lax.scan
+reference implementation (models/lstm.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _split_gates(z: jnp.ndarray, H: int):
+    i = jax.nn.sigmoid(z[..., :H])
+    f = jax.nn.sigmoid(z[..., H : 2 * H])
+    g = jnp.tanh(z[..., 2 * H : 3 * H])
+    o = jax.nn.sigmoid(z[..., 3 * H :])
+    return i, f, g, o
+
+
+# --------------------------------------------------------------------------
+# forward kernel
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(proj_ref, wh_ref, h0_ref, c0_ref, outs_ref, cs_ref, h_s, c_s):
+    H = h_s.shape[-1]
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[:] = h0_ref[:].astype(jnp.float32)
+        c_s[:] = c0_ref[:].astype(jnp.float32)
+
+    wh = wh_ref[:]
+    z = proj_ref[0].astype(jnp.float32) + jnp.dot(
+        h_s[:].astype(wh.dtype), wh, preferred_element_type=jnp.float32
+    )
+    i, f, g, o = _split_gates(z, H)
+    c_new = f * c_s[:] + i * g
+    h_new = o * jnp.tanh(c_new)
+    h_s[:] = h_new
+    c_s[:] = c_new
+    outs_ref[0] = h_new.astype(outs_ref.dtype)
+    cs_ref[0] = c_new
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _lstm_fwd_call(proj_t, wh, h0, c0, *, interpret: bool):
+    T, B, fourH = proj_t.shape
+    H = fourH // 4
+    outs, cs = pl.pallas_call(
+        _fwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, 4 * H), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((H, 4 * H), lambda t: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H), proj_t.dtype),
+            jax.ShapeDtypeStruct((T, B, H), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
+        ],
+        interpret=interpret,
+    )(proj_t, wh, h0, c0)
+    return outs, cs
+
+
+# --------------------------------------------------------------------------
+# backward kernel (reverse time order via index_map t -> T-1-t)
+# --------------------------------------------------------------------------
+
+
+def _bwd_kernel(
+    dout_ref, proj_ref, hprev_ref, cprev_ref, cs_ref, wh_ref, dcT_ref,
+    dz_ref, dh0_ref, dc0_ref, dh_s, dc_s,
+):
+    H = dh_s.shape[-1]
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        # dh seed (the h_T cotangent) is folded into dout[-1] by the caller;
+        # the c_T cotangent seeds the cell-grad carry here.
+        dh_s[:] = jnp.zeros_like(dh_s)
+        dc_s[:] = dcT_ref[:]
+
+    wh = wh_ref[:]
+    # recompute this step's gates from saved h_{t-1}, c_{t-1}
+    z = proj_ref[0].astype(jnp.float32) + jnp.dot(
+        hprev_ref[0].astype(wh.dtype), wh, preferred_element_type=jnp.float32
+    )
+    i, f, g, o = _split_gates(z, H)
+    tanh_c = jnp.tanh(cs_ref[0])
+
+    dh = dout_ref[0].astype(jnp.float32) + dh_s[:]
+    do = dh * tanh_c
+    dc = dh * o * (1.0 - tanh_c * tanh_c) + dc_s[:]
+    di = dc * g
+    df = dc * cprev_ref[0]
+    dg = dc * i
+    dz = jnp.concatenate(
+        [
+            di * i * (1.0 - i),
+            df * f * (1.0 - f),
+            dg * (1.0 - g * g),
+            do * o * (1.0 - o),
+        ],
+        axis=-1,
+    )
+    dz_ref[0] = dz
+    # carry to step t-1
+    dh_s[:] = jnp.dot(dz.astype(wh.dtype), wh.T, preferred_element_type=jnp.float32)
+    dc_s[:] = dc * f
+    # after the last grid step (real t=0) these hold d h0 / d c0
+    dh0_ref[:] = dh_s[:]
+    dc0_ref[:] = dc_s[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _lstm_bwd_call(dout, proj_t, hprev, cprev, cs, wh, dcT, *, interpret: bool):
+    T, B, H = cs.shape
+    rev3 = lambda t: (T - 1 - t, 0, 0)
+    pinned = lambda t: (0, 0)
+    dz, dh0, dc0 = pl.pallas_call(
+        _bwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, 4 * H), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, H), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, H), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, H), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((H, 4 * H), pinned, memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), pinned, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, 4 * H), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), pinned, memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), pinned, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, 4 * H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
+        ],
+        interpret=interpret,
+    )(dout, proj_t, hprev, cprev, cs, wh, dcT)
+    return dz, dh0, dc0
+
+
+# --------------------------------------------------------------------------
+# custom-VJP public op
+# --------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def lstm_unroll(
+    proj_t: jnp.ndarray,  # (T, B, 4H) time-major input projections x@Wi+b
+    wh: jnp.ndarray,      # (H, 4H) recurrent weights
+    h0: jnp.ndarray,      # (B, H)
+    c0: jnp.ndarray,      # (B, H)
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Fused LSTM unroll: returns (outs (T, B, H), (h_T, c_T))."""
+    outs, cs = _lstm_fwd_call(proj_t, wh, h0, c0, interpret=_interpret())
+    return outs, (outs[-1].astype(jnp.float32), cs[-1])
+
+
+def _vjp_fwd(proj_t, wh, h0, c0):
+    outs, cs = _lstm_fwd_call(proj_t, wh, h0, c0, interpret=_interpret())
+    return (outs, (outs[-1].astype(jnp.float32), cs[-1])), (proj_t, wh, h0, c0, outs, cs)
+
+
+def _vjp_bwd(res, grads):
+    proj_t, wh, h0, c0, outs, cs = res
+    douts, (dhT, dcT) = grads
+    T, B, H = cs.shape
+    # h_T IS outs[-1], so its cotangent folds into dout[-1]; the c_T
+    # cotangent seeds the backward kernel's cell-grad carry at step T-1.
+    douts = douts.astype(jnp.float32).at[-1].add(dhT.astype(jnp.float32))
+    hprev = jnp.concatenate([h0.astype(outs.dtype)[None], outs[:-1]], axis=0)
+    cprev = jnp.concatenate([c0.astype(jnp.float32)[None], cs[:-1]], axis=0)
+    dz, dh0, dc0 = _lstm_bwd_call(
+        douts, proj_t, hprev, cprev, cs, wh, dcT.astype(jnp.float32),
+        interpret=_interpret(),
+    )
+    dproj = dz.astype(proj_t.dtype)
+    # weight grad as ONE big MXU matmul: (H, T*B) x (T*B, 4H)
+    dwh = jnp.dot(
+        hprev.reshape(T * B, H).astype(jnp.float32).T, dz.reshape(T * B, 4 * H),
+        preferred_element_type=jnp.float32,
+    ).astype(wh.dtype)
+    return dproj, dwh, dh0.astype(h0.dtype), dc0.astype(c0.dtype)
+
+
+lstm_unroll.defvjp(_vjp_fwd, _vjp_bwd)

@@ -36,6 +36,9 @@ class ReplayControlPlane:
         self.env_steps = 0
         self.num_episodes = 0
         self.episode_reward_sum = 0.0
+        # run-lifetime totals (never reset by pop_episode_stats)
+        self.total_episodes = 0
+        self.total_reward_sum = 0.0
         self.learning_sum = np.zeros(cfg.num_blocks, np.int64)
         self.occupied = np.zeros(cfg.num_blocks, bool)
         self.num_seq_store = np.zeros(cfg.num_blocks, np.int32)
@@ -71,6 +74,8 @@ class ReplayControlPlane:
         if episode_reward is not None:
             self.episode_reward_sum += episode_reward
             self.num_episodes += 1
+            self.total_episodes += 1
+            self.total_reward_sum += episode_reward
         return ptr
 
     def _draw(self, rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
@@ -105,3 +110,9 @@ class ReplayControlPlane:
             self.num_episodes = 0
             self.episode_reward_sum = 0.0
         return n, r
+
+    def episode_totals(self):
+        """Run-lifetime (episodes, reward_sum) — unaffected by the
+        pop-and-reset logging stream."""
+        with self.lock:
+            return self.total_episodes, self.total_reward_sum

@@ -135,6 +135,14 @@ class ShardedDeviceReplay:
             r += ri
         return n, r
 
+    def episode_totals(self):
+        n = r = 0
+        for sh in self.shards:
+            ni, ri = sh.episode_totals()
+            n += ni
+            r += ri
+        return n, r
+
     # ------------------------------------------------------------------ add
 
     def add_block(

@@ -1,0 +1,126 @@
+"""Atari-57 sweep driver (BASELINE.json config 3).
+
+The reference trains exactly one game per invocation (reference config.py:1
+hardcodes 'MsPacman'). The sweep driver runs the full Atari-57 suite — or
+any subset — through the same Trainer, one run per game, each with its own
+checkpoint directory and metrics stream plus a combined summary jsonl. All
+runs share one process and one compiled learner *architecture*: every Atari
+game has the same obs shape, and action_dim differences only change the
+dueling head, so per-game compiles reuse the XLA autotuning cache and
+back-to-back games cost seconds, not minutes, of compile.
+
+Usage:
+    python -m r2d2_tpu.sweep --games Breakout Seaquest Qbert --steps 1000
+    python -m r2d2_tpu.sweep --all --preset atari_v4_8   # the full 57
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import List, Optional, Sequence
+
+from r2d2_tpu.config import PRESETS, R2D2Config
+
+# The canonical 57-game ALE suite (Bellemare et al. ALE benchmark set, as
+# used by the R2D2 paper's Atari-57 evaluation).
+ATARI_57: tuple = (
+    "Alien", "Amidar", "Assault", "Asterix", "Asteroids", "Atlantis",
+    "BankHeist", "BattleZone", "BeamRider", "Berzerk", "Bowling", "Boxing",
+    "Breakout", "Centipede", "ChopperCommand", "CrazyClimber", "Defender",
+    "DemonAttack", "DoubleDunk", "Enduro", "FishingDerby", "Freeway",
+    "Frostbite", "Gopher", "Gravitar", "Hero", "IceHockey", "Jamesbond",
+    "Kangaroo", "Krull", "KungFuMaster", "MontezumaRevenge", "MsPacman",
+    "NameThisGame", "Phoenix", "Pitfall", "Pong", "PrivateEye", "Qbert",
+    "Riverraid", "RoadRunner", "Robotank", "Seaquest", "Skiing", "Solaris",
+    "SpaceInvaders", "StarGunner", "Surround", "Tennis", "TimePilot",
+    "Tutankham", "UpNDown", "Venture", "VideoPinball", "WizardOfWor",
+    "YarsRevenge", "Zaxxon",
+)
+
+
+def sweep_config(game: str, preset: str = "atari", root: str = "sweep", **overrides) -> R2D2Config:
+    """Per-game config: the preset with game-scoped checkpoint/metrics paths."""
+    cfg = PRESETS[preset]()
+    return cfg.replace(
+        env_name=game,
+        checkpoint_dir=os.path.join(root, game, "checkpoints"),
+        metrics_path=os.path.join(root, game, "metrics.jsonl"),
+        **overrides,
+    )
+
+
+def run_sweep(
+    games: Sequence[str],
+    preset: str = "atari",
+    root: str = "sweep",
+    steps: Optional[int] = None,
+    mode: str = "threaded",
+    resume: bool = False,
+    trainer_factory=None,
+) -> List[dict]:
+    """Train each game in sequence; returns (and writes) one summary row
+    per game: final step, mean return over the last logged episodes, and
+    wall time. `trainer_factory(cfg)` is injectable for tests."""
+    from r2d2_tpu.train import Trainer
+
+    os.makedirs(root, exist_ok=True)
+    summary_path = os.path.join(root, "summary.jsonl")
+    rows = []
+    factory = trainer_factory or (lambda cfg: Trainer(cfg, resume=resume))
+    for game in games:
+        overrides = {"training_steps": steps} if steps else {}
+        cfg = sweep_config(game, preset=preset, root=root, **overrides)
+        os.makedirs(os.path.dirname(cfg.metrics_path), exist_ok=True)
+        t0 = time.time()
+        trainer = factory(cfg)
+        if mode == "inline":
+            trainer.run_inline()
+        else:
+            trainer.run_threaded()
+        n_ep, r_sum = trainer.replay.episode_totals()
+        row = {
+            "game": game,
+            "steps": int(trainer.state.step),
+            # env_steps_offset restores the pre-resume total (train.py
+            # checkpoint/metrics paths count the same way)
+            "env_steps": trainer.replay.env_steps + trainer.env_steps_offset,
+            "episodes": n_ep,
+            "mean_return": (r_sum / n_ep) if n_ep else None,
+            "wall_minutes": (time.time() - t0) / 60.0,
+        }
+        rows.append(row)
+        with open(summary_path, "a") as fh:
+            fh.write(json.dumps(row) + "\n")
+        print(json.dumps(row))
+    return rows
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="r2d2_tpu Atari-57 sweep")
+    p.add_argument("--games", nargs="*", default=None, help="subset of games")
+    p.add_argument("--all", action="store_true", help="run the full Atari-57 suite")
+    p.add_argument("--preset", default="atari", choices=sorted(PRESETS))
+    p.add_argument("--root", default="sweep")
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--mode", default="threaded", choices=["threaded", "inline"])
+    p.add_argument("--resume", action="store_true")
+    args = p.parse_args(argv)
+    games = list(ATARI_57) if args.all else (args.games or ["MsPacman"])
+    unknown = [g for g in games if g not in ATARI_57]
+    if unknown:
+        p.error(f"not in the Atari-57 suite: {unknown}")
+    run_sweep(
+        games,
+        preset=args.preset,
+        root=args.root,
+        steps=args.steps,
+        mode=args.mode,
+        resume=args.resume,
+    )
+
+
+if __name__ == "__main__":
+    main()

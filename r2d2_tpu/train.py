@@ -54,6 +54,7 @@ from r2d2_tpu.replay.replay_buffer import ReplayBuffer
 from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
 from r2d2_tpu.utils.checkpoint import latest_checkpoint_step, restore_checkpoint, save_checkpoint
 from r2d2_tpu.utils.metrics import MetricsLogger
+from r2d2_tpu.utils.profiling import span, start_profiler_server, step_span
 from r2d2_tpu.utils.supervision import Supervisor
 
 
@@ -79,11 +80,12 @@ class _HostPlane:
         self.step_fn = make_train_step(tr.cfg, tr.net)
 
     def sample(self, pipelined: bool = False):
-        b = self.replay.sample_batch(self.tr.sample_rng)
-        dev = DeviceBatch.from_sampled(b)
-        if self.tr.mesh is not None:
-            dev = DeviceBatch(*shard_batch(self.tr.mesh, tuple(dev)))
-        return "batch", dev, b.idxes, b.old_ptr
+        with span("replay/sample"):
+            b = self.replay.sample_batch(self.tr.sample_rng)
+            dev = DeviceBatch.from_sampled(b)
+            if self.tr.mesh is not None:
+                dev = DeviceBatch(*shard_batch(self.tr.mesh, tuple(dev)))
+            return "batch", dev, b.idxes, b.old_ptr
 
     def update(self, state, item):
         _, dev, idxes, old_ptr = item
@@ -109,12 +111,13 @@ class _DevicePlane:
         self.batch_step_fn = make_batch_train_step(tr.cfg, tr.net)
 
     def sample(self, pipelined: bool = False):
-        si = self.replay.sample_indices(self.tr.sample_rng)
-        coords = (jax.device_put(si.b), jax.device_put(si.s), jax.device_put(si.is_weights))
-        if pipelined:
-            batch = self.replay.run_with_stores(lambda stores: self.gather_fn(stores, *coords))
-            return "batch", batch, si.idxes, si.old_ptr
-        return "coords", coords, si.idxes, si.old_ptr
+        with span("replay/sample"):
+            si = self.replay.sample_indices(self.tr.sample_rng)
+            coords = (jax.device_put(si.b), jax.device_put(si.s), jax.device_put(si.is_weights))
+            if pipelined:
+                batch = self.replay.run_with_stores(lambda stores: self.gather_fn(stores, *coords))
+                return "batch", batch, si.idxes, si.old_ptr
+            return "coords", coords, si.idxes, si.old_ptr
 
     def update(self, state, item):
         kind, payload, idxes, old_ptr = item
@@ -144,12 +147,13 @@ class _ShardedPlane:
         self.batch_step_fn = make_batch_train_step(tr.cfg, tr.net)
 
     def sample(self, pipelined: bool = False):
-        si = self.replay.sample_indices(self.tr.sample_rng)
-        coords = (jnp.asarray(si.b), jnp.asarray(si.s), jnp.asarray(si.is_weights))
-        if pipelined:
-            batch = self.replay.run_with_stores(lambda stores: self.gather_fn(stores, *coords))
-            return "batch", batch, si.idxes, si.old_ptrs
-        return "coords", coords, si.idxes, si.old_ptrs
+        with span("replay/sample"):
+            si = self.replay.sample_indices(self.tr.sample_rng)
+            coords = (jnp.asarray(si.b), jnp.asarray(si.s), jnp.asarray(si.is_weights))
+            if pipelined:
+                batch = self.replay.run_with_stores(lambda stores: self.gather_fn(stores, *coords))
+                return "batch", batch, si.idxes, si.old_ptrs
+            return "coords", coords, si.idxes, si.old_ptrs
 
     def update(self, state, item):
         kind, payload, idxes, old_ptrs = item
@@ -176,7 +180,14 @@ class Trainer:
         vec_env=None,
         resume: bool = False,
         metrics: Optional[MetricsLogger] = None,
+        profile_dir: Optional[str] = None,
+        profile_steps: int = 20,
     ):
+        # profiling hooks (SURVEY.md 5.1): trace the first `profile_steps`
+        # post-warmup updates — the steady-state pipeline shape
+        self.profile_dir = profile_dir
+        self._profile_remaining = profile_steps if profile_dir else 0
+        self._profile_active = False
         self.cfg = cfg
         self.vec_env = vec_env if vec_env is not None else build_vec_env(cfg, seed=cfg.seed)
         if self.vec_env.action_dim != cfg.action_dim:
@@ -218,8 +229,23 @@ class Trainer:
     # ------------------------------------------------------------- plumbing
 
     def _one_update(self, item):
-        self.state, m = self.plane.update(self.state, item)
+        # start the trace AFTER the first update: update 1 compiles the
+        # jitted step, and a trace dominated by XLA compile time defeats
+        # the point (steady-state pipeline shape)
+        if (
+            self._profile_remaining > 0
+            and not self._profile_active
+            and int(self.state.step) >= 1
+        ):
+            jax.profiler.start_trace(self.profile_dir)
+            self._profile_active = True
+        with step_span("learner_update", int(self.state.step)):
+            self.state, m = self.plane.update(self.state, item)
         step = int(self.state.step)
+        if self._profile_active:
+            self._profile_remaining -= 1
+            if self._profile_remaining <= 0:
+                self._stop_profile()
         if step % self.cfg.publish_interval == 0:
             self.param_store.publish(self.state.params)
         if step % self.cfg.save_interval == 0:
@@ -230,6 +256,16 @@ class Trainer:
                 self.wall_minutes_offset + (time.time() - self._start_time) / 60.0,
             )
         return m, step
+
+    def _stop_profile(self) -> None:
+        """Finalize an in-flight trace; safe to call repeatedly. Run modes
+        call this on every exit path so a crash or an early end of training
+        cannot lose the requested trace."""
+        if self._profile_active:
+            jax.block_until_ready(self.state.params)
+            jax.profiler.stop_trace()
+            self._profile_active = False
+            self._profile_remaining = 0
 
     def _log(self, m, step, extra: Optional[dict] = None):
         n_ep, r_sum = self.replay.pop_episode_stats()
@@ -263,11 +299,14 @@ class Trainer:
         self._start_time = time.time()
         k = env_steps_per_update or max(cfg.num_actors, 1)
         self.warmup()
-        while int(self.state.step) < cfg.training_steps:
-            for _ in range(max(k // self.vec_env.num_envs, 1)):
-                self.actor.step()
-            m, step = self._one_update(self.plane.sample())
-            self._log(m, step)
+        try:
+            while int(self.state.step) < cfg.training_steps:
+                for _ in range(max(k // self.vec_env.num_envs, 1)):
+                    self.actor.step()
+                m, step = self._one_update(self.plane.sample())
+                self._log(m, step)
+        finally:
+            self._stop_profile()
 
     def run_threaded(self) -> None:
         """Actor thread + prefetch thread + learner loop (reference
@@ -327,6 +366,7 @@ class Trainer:
                 last_health = health
                 self._log(m, step, extra=health)
         finally:
+            self._stop_profile()
             sup.shutdown()
 
 
@@ -340,6 +380,11 @@ def main(argv=None):
                    help="replay data plane (default: preset's replay_plane)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--metrics", default=None)
+    p.add_argument("--profile-dir", default=None,
+                   help="record a jax.profiler trace of the first post-warmup updates")
+    p.add_argument("--profile-steps", type=int, default=20)
+    p.add_argument("--profile-port", type=int, default=0,
+                   help="if set, start a live profiler server on this port")
     args = p.parse_args(argv)
 
     cfg = PRESETS[args.preset]()
@@ -355,7 +400,14 @@ def main(argv=None):
     if overrides:
         cfg = cfg.replace(**overrides)
 
-    trainer = Trainer(cfg, resume=args.resume)
+    if args.profile_port:
+        start_profiler_server(args.profile_port)
+    trainer = Trainer(
+        cfg,
+        resume=args.resume,
+        profile_dir=args.profile_dir,
+        profile_steps=args.profile_steps,
+    )
     if args.mode == "inline":
         trainer.run_inline()
     else:

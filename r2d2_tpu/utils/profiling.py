@@ -1,0 +1,57 @@
+"""Tracing / profiling hooks (SURVEY.md section 5.1 rebuild).
+
+The reference has no profiler at all — its only timing is wall-clock
+minutes stored in checkpoints (reference worker.py:378,452) and derived
+rates printed every 10 s (worker.py:126,135). Here:
+
+- `start_profiler_server(port)` exposes the live process to
+  `xprof`/TensorBoard-profile capture at any time (device + host traces).
+- `trace_to(dir)` context manager records a bounded trace programmatically
+  (e.g. `--profile-dir` on the trainer CLI traces the first post-warmup
+  updates, where the steady-state pipeline shape is visible).
+- `span(name)` / `step_span(name, step)` annotate HOST-side phases (replay
+  sample, block pack, priority update) so they line up against device
+  activity in the trace viewer. They are no-ops costing one context-manager
+  enter/exit when no trace is being captured, so the hot paths keep them
+  permanently.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+_server = None
+
+
+def start_profiler_server(port: int = 9012) -> None:
+    """Idempotent: starts the jax.profiler server once per process."""
+    global _server
+    if _server is None:
+        _server = jax.profiler.start_server(port)
+
+
+@contextlib.contextmanager
+def trace_to(log_dir: Optional[str]) -> Iterator[None]:
+    """Record a profiler trace into `log_dir` for the duration of the
+    context; None disables (zero overhead)."""
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def span(name: str):
+    """Named host-span annotation visible in the trace viewer."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def step_span(name: str, step: int):
+    """Step-correlated span: groups device work under learner step N."""
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)

@@ -1,0 +1,59 @@
+"""Sweep driver (r2d2_tpu/sweep.py): config construction for the full
+Atari-57 suite, and a tiny end-to-end 2-game sweep on the catch env."""
+
+import json
+import os
+
+import pytest
+
+from r2d2_tpu.config import tiny_test
+from r2d2_tpu.sweep import ATARI_57, run_sweep, sweep_config
+
+
+def test_atari_57_is_57_games():
+    assert len(ATARI_57) == 57
+    assert len(set(ATARI_57)) == 57
+    for g in ("MsPacman", "Breakout", "Seaquest", "Qbert", "MontezumaRevenge"):
+        assert g in ATARI_57
+
+
+def test_sweep_configs_validate_for_all_games(tmp_path):
+    for game in ATARI_57:
+        cfg = sweep_config(game, preset="atari", root=str(tmp_path))
+        assert cfg.env_name == game
+        assert game in cfg.checkpoint_dir
+        assert cfg.metrics_path.endswith("metrics.jsonl")
+
+
+def test_tiny_two_game_sweep(tmp_path):
+    from r2d2_tpu.train import Trainer
+
+    root = str(tmp_path / "sweep")
+
+    def factory(cfg):
+        # swap the Atari env for the fast catch env, keep everything else
+        cfg = tiny_test().replace(
+            env_name="catch",
+            training_steps=3,
+            checkpoint_dir=cfg.checkpoint_dir,
+            metrics_path=cfg.metrics_path,
+        )
+        return Trainer(cfg)
+
+    rows = run_sweep(
+        ["Breakout", "Pong"], root=root, mode="inline", trainer_factory=factory
+    )
+    assert [r["game"] for r in rows] == ["Breakout", "Pong"]
+    for r in rows:
+        assert r["steps"] == 3
+        assert r["env_steps"] > 0
+    with open(os.path.join(root, "summary.jsonl")) as fh:
+        lines = [json.loads(l) for l in fh]
+    assert len(lines) == 2
+
+
+def test_cli_rejects_unknown_game():
+    from r2d2_tpu.sweep import main
+
+    with pytest.raises(SystemExit):
+        main(["--games", "NotAGame"])
