@@ -147,6 +147,11 @@ class VectorizedActor:
 
     # ------------------------------------------------------------------ api
 
+    @property
+    def steps_per_call(self) -> int:
+        """Env transitions one step() yields (collector duck-type)."""
+        return self.env.num_envs
+
     def run_steps(self, n: int) -> None:
         for _ in range(n):
             self.step()

@@ -1,0 +1,323 @@
+"""Fully on-device experience collection (L4, device data path end to end).
+
+The host VectorizedActor (actor.py) removes the reference's per-env CPU
+forward bottleneck (reference worker.py:699-700) by batching the policy,
+but every env step is still a host->device round trip and every block a
+host->HBM upload. For pure-JAX functional envs (envs/catch.py, and any env
+exposing reset/step/render as jit-vmappable functions) the ENTIRE
+collection loop runs as one jitted lax.scan chunk on device:
+
+    policy act -> epsilon-greedy over the ladder vector -> env dynamics ->
+    render -> block packing (n-step returns, terminal-as-gamma-0 encoding,
+    per-sequence counters, true-window-start stored hiddens, rescaled-space
+    initial priorities)
+
+and the packed block fields are handed to the HBM replay store
+(DeviceReplayBuffer.add_blocks_batch) WITHOUT visiting host memory. Host
+work per chunk: sum-tree bookkeeping over a few kilobytes of priorities
+and counters.
+
+Chunk semantics == reference actor semantics with max_episode_steps ==
+chunk_len: each chunk starts fresh episodes in every slot (zero carry,
+NOOP last-action, zero reward — reference worker.py:488-509), steps until
+each env's episode terminates (slots that finish early idle out the rest
+of the chunk), and slots still running at the chunk end are TRUNCATED with
+a bootstrap Q from one final policy evaluation — exactly the host actor's
+deferred-cut path (actor.py). Packing reproduces
+replay.accumulator.SequenceAccumulator bit-for-bit, including the quirk-1
+(stored-state alignment) and quirk-6/7 (rescaled-space initial priority)
+fixes; tests/test_collect.py pins equivalence against the host actor path
+on identical trajectories.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.models.r2d2 import R2D2Network
+from r2d2_tpu.ops.epsilon import epsilon_ladder
+from r2d2_tpu.ops.priority import mixed_td_priorities
+from r2d2_tpu.ops.value_rescale import inverse_value_rescale, value_rescale
+
+
+def _where_rows(mask: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Row-wise select: mask (E,) broadcast over a/b's trailing dims."""
+    return jnp.where(mask.reshape(mask.shape + (1,) * (a.ndim - 1)), a, b)
+
+
+def make_collect_fn(
+    cfg: R2D2Config, net: R2D2Network, fn_env, num_envs: int, chunk_len: int
+):
+    """Build the jitted chunk collector.
+
+    fn_env protocol (all jit/vmap-safe): reset(key) -> state,
+    step(state, action) -> (state', reward, done), render(state) -> uint8
+    obs of cfg.obs_shape.
+
+    Returns collect(params, env_state, epsilons, key) ->
+      (fields, priorities, num_seq, sizes, dones, ep_rewards,
+       fresh_env_state, key')
+    where `fields` is a dict of (E, ...) store-slot-shaped device arrays
+    keyed exactly like DeviceReplayBuffer.stores.
+    """
+    E, T = num_envs, chunk_len
+    L, Bn, n = cfg.learning_steps, cfg.burn_in_steps, cfg.forward_steps
+    S, bl, slot = cfg.seqs_per_block, cfg.block_length, cfg.block_slot_len
+    H, A = cfg.hidden_dim, cfg.action_dim
+    gamma, eps_h = cfg.gamma, cfg.value_rescale_eps
+    if not (0 < T <= bl):
+        raise ValueError(f"chunk_len {T} must be in (0, block_length={bl}]")
+
+    vreset = jax.vmap(fn_env.reset)
+    vstep = jax.vmap(fn_env.step)
+    vrender = jax.vmap(fn_env.render)
+
+    t1 = jnp.arange(T + 1)
+    tT = jnp.arange(T)
+    sid = jnp.arange(S)
+
+    def _pack(obs, final_obs, actions, rewards, qs, hiddens, size, done, qf):
+        """Pack ONE env's chunk into store-slot-shaped block fields.
+
+        Mirrors SequenceAccumulator.finish (replay/accumulator.py) with
+        fixed shapes + masks: obs (T, ...), actions/rewards (T,) already
+        zero-masked past `size`, qs (T, A), hiddens (T, 2, H) post-step
+        states, size scalar int, done scalar bool, qf (A,) the final
+        policy eval for the truncation bootstrap."""
+        valid_t1 = t1 <= size          # stored entries 0..size
+        valid_T = tT < size            # recorded transitions
+
+        stored_obs = jnp.concatenate([obs, final_obs[None]], axis=0)
+        stored_obs = jnp.where(
+            valid_t1.reshape(-1, *([1] * (obs.ndim - 1))), stored_obs, 0
+        )
+        zero1i = jnp.zeros(1, jnp.int32)
+        zero1f = jnp.zeros(1, jnp.float32)
+        stored_la = jnp.where(valid_t1, jnp.concatenate([zero1i, actions]), 0)
+        stored_lr = jnp.where(valid_t1, jnp.concatenate([zero1f, rewards]), 0.0)
+        pad1 = slot - (T + 1)
+        f_obs = jnp.pad(stored_obs, ((0, pad1),) + ((0, 0),) * (obs.ndim - 1))
+        f_la = jnp.pad(stored_la, (0, pad1))
+        f_lr = jnp.pad(stored_lr, (0, pad1))
+
+        # n-step return R_t = sum_{k<n} gamma^k r_{t+k}, zeros past the end
+        # (ops/returns.n_step_returns semantics, reference worker.py:593-595)
+        rpad = jnp.concatenate([rewards, jnp.zeros(max(n - 1, 0), jnp.float32)])
+        R = jnp.zeros(T, jnp.float32)
+        for k in range(n):
+            R = R + (gamma**k) * jax.lax.dynamic_slice_in_dim(rpad, k, T)
+        R = jnp.where(valid_T, R, 0.0)
+
+        # bootstrap discount gamma_n(t): gamma^n on full windows, shrinking
+        # gamma^{size-t} toward a truncation, 0 past a terminal
+        # (ops/returns.n_step_gammas semantics, reference worker.py:543-554)
+        max_fwd = jnp.minimum(size, n)
+        exp_tail = jnp.clip(size - tT, 1, n).astype(jnp.float32)
+        g_tail = jnp.where(done, 0.0, jnp.power(jnp.float32(gamma), exp_tail))
+        gamma_n = jnp.where(tT < size - max_fwd, jnp.float32(gamma**n), g_tail)
+        gamma_n = jnp.where(valid_T, gamma_n, 0.0)
+
+        padT = bl - T
+        f_action = jnp.pad(actions, (0, padT))
+        f_R = jnp.pad(R, (0, padT))
+        f_gamma = jnp.pad(gamma_n, (0, padT))
+
+        # per-sequence counters (reference worker.py:606-610; int32 per
+        # SURVEY.md quirk 12). curr_burn_in == 0: chunks are episode-aligned.
+        num_seq = (size + L - 1) // L
+        valid_seq = sid < num_seq
+        burn = jnp.where(valid_seq, jnp.minimum(sid * L, Bn), 0)
+        learn = jnp.clip(size - sid * L, 0, L)
+        cum = jnp.cumsum(learn)
+        fwd = jnp.where(valid_seq, jnp.clip(size + 1 - cum, 0, n), 0)
+
+        # stored recurrent state at the TRUE window start (quirk-1 fix):
+        # hidden_buf[t] = state before consuming obs t; index 0 is the
+        # episode-start zero state
+        stored_hid = jnp.concatenate(
+            [jnp.zeros((1, 2, H), jnp.float32), hiddens], axis=0
+        )
+        wstart = jnp.clip(sid * L - burn, 0, T)
+        hid_seq = jnp.where(valid_seq[:, None, None], stored_hid[wstart], 0.0)
+
+        # actor-side initial priorities in rescaled space (quirk-6/7 fix):
+        # bootstrap value is max_a Q(s_{min(t+max_fwd, size)}), zeroed at a
+        # terminal (SequenceAccumulator.finish edge-pad closed form)
+        qarr = jnp.concatenate([qs, qf[None].astype(jnp.float32)], axis=0)
+        qarr = jnp.where((t1 >= size)[:, None] & done, 0.0, qarr)
+        boot_idx = jnp.minimum(tT + max_fwd, size)
+        max_q = jnp.max(qarr, axis=1)[boot_idx]
+        taken_q = qarr[tT, actions]
+        target = value_rescale(R + gamma_n * inverse_value_rescale(max_q, eps_h), eps_h)
+        abs_td = jnp.where(valid_T, jnp.abs(target - taken_q), 0.0)
+        td_pad = jnp.pad(abs_td, (0, padT)).reshape(S, L)
+        m = (jnp.arange(L)[None, :] < learn[:, None]).astype(jnp.float32)
+        prios = mixed_td_priorities(td_pad, m, cfg.td_mix_eta)
+
+        fields = {
+            "obs": f_obs.astype(jnp.uint8),
+            "last_action": f_la.astype(jnp.int32),
+            "last_reward": f_lr.astype(jnp.float32),
+            "action": f_action.astype(jnp.int32),
+            "n_step_reward": f_R,
+            "gamma": f_gamma,
+            "hidden": hid_seq,
+            "burn_in": burn.astype(jnp.int32),
+            "learning": learn.astype(jnp.int32),
+            "forward": fwd.astype(jnp.int32),
+        }
+        return fields, prios, num_seq.astype(jnp.int32)
+
+    def collect(params, env_state, epsilons, key):
+        def body(carry, key_t):
+            env_state, h, c, la, lr, active = carry
+            obs = vrender(env_state)
+            q, (h2, c2) = net.apply(params, obs, la, lr, (h, c), method=net.act)
+            ke, ka = jax.random.split(key_t)
+            explore = jax.random.uniform(ke, (E,)) < epsilons
+            rand_a = jax.random.randint(ka, (E,), 0, A)
+            act = jnp.where(explore, rand_a, jnp.argmax(q, axis=-1)).astype(jnp.int32)
+            new_env, reward, done = vstep(env_state, act)
+            # freeze slots whose episode already ended: their remaining
+            # steps are padding (and step `size` renders the terminal obs)
+            env_state = jax.tree.map(
+                lambda new, old: _where_rows(active, new, old), new_env, env_state
+            )
+            reward = jnp.where(active, reward.astype(jnp.float32), 0.0)
+            act = jnp.where(active, act, 0)
+            done = done & active
+            rec = {
+                "obs": obs,
+                "action": act,
+                "reward": reward,
+                "q": q.astype(jnp.float32),
+                "hidden": jnp.stack([h2, c2], axis=1).astype(jnp.float32),
+                "applied": active,
+                "done": done,
+            }
+            la2 = jnp.where(active, act, la)
+            lr2 = jnp.where(active, reward, lr)
+            return (env_state, h2, c2, la2, lr2, active & ~done), rec
+
+        keys = jax.random.split(key, T + 2)
+        init = (
+            env_state,
+            jnp.zeros((E, H), jnp.float32),
+            jnp.zeros((E, H), jnp.float32),
+            jnp.zeros(E, jnp.int32),
+            jnp.zeros(E, jnp.float32),
+            jnp.ones(E, bool),
+        )
+        (env_f, h_f, c_f, la_f, lr_f, _), rec = jax.lax.scan(body, init, keys[:T])
+
+        final_obs = vrender(env_f)
+        q_final, _ = net.apply(params, final_obs, la_f, lr_f, (h_f, c_f), method=net.act)
+
+        sizes = jnp.sum(rec["applied"].astype(jnp.int32), axis=0)  # (E,)
+        dones = jnp.any(rec["done"], axis=0)
+        ep_rewards = jnp.sum(rec["reward"], axis=0)
+
+        env_major = lambda x: jnp.swapaxes(x, 0, 1)  # (T, E, ...) -> (E, T, ...)
+        fields, priorities, num_seq = jax.vmap(_pack)(
+            env_major(rec["obs"]),
+            final_obs,
+            env_major(rec["action"]),
+            env_major(rec["reward"]),
+            env_major(rec["q"]),
+            env_major(rec["hidden"]),
+            sizes,
+            dones,
+            q_final,
+        )
+        fresh_env = vreset(jax.random.split(keys[T + 1], E))
+        return fields, priorities, num_seq, sizes, dones, ep_rewards, fresh_env, keys[T]
+
+    return jax.jit(collect)
+
+
+class DeviceCollector:
+    """Drives the jitted chunk collector against a DeviceReplayBuffer.
+
+    Duck-type-compatible with VectorizedActor where the Trainer needs it:
+    step() advances collection (one CHUNK here, not one env step),
+    steps_per_call reports how many env transitions a step() yields at
+    most, and resync() restores a consistent state after a supervised
+    restart."""
+
+    def __init__(
+        self,
+        cfg: R2D2Config,
+        net: R2D2Network,
+        param_store,
+        fn_env,
+        replay,
+        epsilons: Optional[np.ndarray] = None,
+        seed: int = 0,
+        chunk_len: Optional[int] = None,
+    ):
+        E = cfg.num_actors
+        self.cfg = cfg
+        self.E = E
+        self.chunk = int(chunk_len or min(cfg.block_length, cfg.max_episode_steps))
+        if cfg.max_episode_steps > self.chunk:
+            import warnings
+
+            warnings.warn(
+                f"DeviceCollector truncates every episode at chunk_len="
+                f"{self.chunk} (< max_episode_steps={cfg.max_episode_steps}): "
+                "chunks are episode-aligned, so states beyond one chunk are "
+                "never visited. Fine for short-episode envs (catch); use "
+                "collector='host' if episodes must run longer than "
+                "block_length.",
+                stacklevel=2,
+            )
+        self.replay = replay
+        self.param_store = param_store
+        self._fn_env = fn_env
+        eps = (
+            np.asarray(epsilons, np.float32)
+            if epsilons is not None
+            else epsilon_ladder(E, cfg.base_eps, cfg.eps_alpha)
+        )
+        assert len(eps) == E
+        self.epsilons = jnp.asarray(eps, jnp.float32)
+        self._collect = make_collect_fn(cfg, net, fn_env, E, self.chunk)
+        self.key = jax.random.PRNGKey(seed)
+        kr, self.key = jax.random.split(self.key)
+        self.env_state = jax.vmap(fn_env.reset)(jax.random.split(kr, E))
+        self.total_steps = 0
+
+    @property
+    def steps_per_call(self) -> int:
+        return self.E * self.chunk
+
+    def step(self) -> int:
+        """Collect one chunk and push E blocks into replay; returns the
+        number of env transitions recorded."""
+        params, _ = self.param_store.latest()
+        (fields, prios, num_seq, sizes, dones, ep_rewards, self.env_state, self.key) = (
+            self._collect(params, self.env_state, self.epsilons, self.key)
+        )
+        sizes_np = np.asarray(sizes)
+        self.replay.add_blocks_batch(
+            fields,
+            np.asarray(num_seq),
+            sizes_np,
+            np.asarray(prios),
+            np.asarray(ep_rewards),
+            np.asarray(dones),
+        )
+        recorded = int(sizes_np.sum())
+        self.total_steps += recorded
+        return recorded
+
+    def resync(self) -> None:
+        """Supervised-restart hook: fresh episodes in every slot (the
+        in-flight chunk, if any, was never pushed — nothing to unwind)."""
+        kr, self.key = jax.random.split(self.key)
+        self.env_state = jax.vmap(self._fn_env.reset)(jax.random.split(kr, self.E))

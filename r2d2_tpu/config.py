@@ -113,6 +113,11 @@ class R2D2Config:
     # "device" (HBM store + fused in-jit gather, single chip), "sharded"
     # (HBM store sharded over the dp mesh axis + shard_map train step)
     replay_plane: str = "host"
+    # experience collection: "host" (VectorizedActor — batched jitted
+    # policy, env stepped on host) or "device" (collect.DeviceCollector —
+    # the WHOLE loop incl. env dynamics and block packing in one jitted
+    # scan; needs a pure-JAX functional env and replay_plane="device")
+    collector: str = "host"
 
     # --- derived ----------------------------------------------------------
     @property
@@ -158,6 +163,13 @@ class R2D2Config:
             raise ValueError(f"unknown lstm_backend {self.lstm_backend!r}")
         if self.replay_plane not in ("host", "device", "sharded"):
             raise ValueError(f"unknown replay_plane {self.replay_plane!r}")
+        if self.collector not in ("host", "device"):
+            raise ValueError(f"unknown collector {self.collector!r}")
+        if self.collector == "device" and self.replay_plane != "device":
+            raise ValueError(
+                "collector='device' writes packed blocks straight into the "
+                "HBM store; it requires replay_plane='device'"
+            )
         if self.replay_plane == "sharded":
             if self.dp_size * self.tp_size <= 1:
                 raise ValueError("replay_plane='sharded' needs a device mesh "

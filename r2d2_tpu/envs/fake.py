@@ -7,8 +7,10 @@ closed-form expected values (SURVEY.md section 4 'fake backends').
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import NamedTuple, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -38,3 +40,40 @@ class ScriptedEnv:
         self.t += 1
         done = self.t >= self.episode_len
         return self._obs(), float(reward), bool(done), {}
+
+
+class ScriptedFnState(NamedTuple):
+    t: jnp.ndarray    # int32 timestep
+    key: jnp.ndarray  # PRNG key (unused by the deterministic dynamics)
+
+
+class ScriptedFnEnv:
+    """Functional (jit/vmap-safe) twin of ScriptedEnv, for the on-device
+    collector: same reward script, same timestep-encoded obs, same fixed
+    episode length — so the device collection path can be compared
+    field-by-field against the host actor path on identical trajectories."""
+
+    def __init__(
+        self,
+        obs_shape: Tuple[int, ...] = (12, 12, 1),
+        action_dim: int = 4,
+        episode_len: int = 9,
+        rewards: Optional[Sequence[float]] = None,
+    ):
+        self.obs_shape = obs_shape
+        self.action_dim = self.NUM_ACTIONS = action_dim
+        self.episode_len = episode_len
+        script = list(rewards) if rewards is not None else [float(i % 3) for i in range(episode_len)]
+        self._rewards = jnp.asarray(script, jnp.float32)
+
+    def reset(self, key: jax.Array) -> ScriptedFnState:
+        return ScriptedFnState(jnp.zeros((), jnp.int32), key)
+
+    def render(self, s: ScriptedFnState) -> jnp.ndarray:
+        return jnp.full(self.obs_shape, (s.t % 256).astype(jnp.uint8), jnp.uint8)
+
+    def step(self, s: ScriptedFnState, action: jnp.ndarray):
+        reward = self._rewards[s.t % len(self._rewards)]
+        t2 = s.t + 1
+        done = t2 >= self.episode_len
+        return ScriptedFnState(t2, s.key), reward, done

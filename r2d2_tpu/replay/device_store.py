@@ -86,6 +86,13 @@ class DeviceReplayBuffer(ReplayControlPlane):
 
         self._write = jax.jit(_write, donate_argnums=(0,))
 
+        # batched scatter write for the on-device collector: E slots land
+        # in one donated dispatch (vals stay in HBM end to end)
+        def _write_batch(stores, ptrs, vals):
+            return {k: arr.at[ptrs].set(vals[k]) for k, arr in stores.items()}
+
+        self._write_batch = jax.jit(_write_batch, donate_argnums=(0,))
+
     # ------------------------------------------------------------------ add
 
     @staticmethod
@@ -125,6 +132,40 @@ class DeviceReplayBuffer(ReplayControlPlane):
             self._account_add(
                 block.num_sequences, int(block.learning_steps.sum()), priorities, episode_reward
             )
+
+    def add_blocks_batch(
+        self,
+        fields: Dict[str, jnp.ndarray],
+        num_seq: np.ndarray,
+        learning_totals: np.ndarray,
+        priorities: np.ndarray,
+        episode_rewards: np.ndarray,
+        dones: np.ndarray,
+    ) -> None:
+        """Write E collector-packed blocks in one scatter (collect.py).
+
+        fields: dict of (E, slot, ...) DEVICE arrays keyed like
+        self.stores — they never visit host memory. num_seq /
+        learning_totals / priorities (E, seqs_per_block) / episode_rewards
+        / dones are small host arrays for sum-tree + stats accounting.
+        episode_rewards[i] counts only when dones[i] (a truncated chunk is
+        not a finished episode)."""
+        E = len(num_seq)
+        nb = self.cfg.num_blocks
+        if E > nb:
+            raise ValueError(f"{E} blocks per batch exceeds store of {nb} slots")
+        with self.lock:
+            ptrs = (self.block_ptr + np.arange(E)) % nb
+            self.stores = self._write_batch(
+                self.stores, jnp.asarray(ptrs, jnp.int32), fields
+            )
+            for i in range(E):
+                self._account_add(
+                    int(num_seq[i]),
+                    int(learning_totals[i]),
+                    priorities[i],
+                    float(episode_rewards[i]) if dones[i] else None,
+                )
 
     # --------------------------------------------------------------- sample
 

@@ -62,8 +62,10 @@ def run_sweep(
     trainer_factory=None,
 ) -> List[dict]:
     """Train each game in sequence; returns (and writes) one summary row
-    per game: final step, mean return over the last logged episodes, and
-    wall time. `trainer_factory(cfg)` is injectable for tests."""
+    per game: final step, run-lifetime mean episode return (every episode
+    since collection started, warmup included — the per-interval learning
+    curve lives in each game's metrics.jsonl), and wall time.
+    `trainer_factory(cfg)` is injectable for tests."""
     from r2d2_tpu.train import Trainer
 
     os.makedirs(root, exist_ok=True)

@@ -68,6 +68,23 @@ def build_vec_env(cfg: R2D2Config, seed: int = 0):
     return HostEnvPool([make_env(cfg, seed=seed + i) for i in range(cfg.num_actors)])
 
 
+def build_fn_env(cfg: R2D2Config):
+    """Functional (jit/vmap-safe) env core for the on-device collector."""
+    name = cfg.env_name.lower()
+    if name == "catch":
+        from r2d2_tpu.envs.catch import CatchEnv
+
+        return CatchEnv(height=cfg.obs_shape[0], width=cfg.obs_shape[1])
+    if name == "scripted":
+        from r2d2_tpu.envs.fake import ScriptedFnEnv
+
+        return ScriptedFnEnv(obs_shape=cfg.obs_shape, action_dim=cfg.action_dim)
+    raise ValueError(
+        f"env {cfg.env_name!r} has no pure-JAX functional core; "
+        "use collector='host' for emulator/host-protocol envs"
+    )
+
+
 class _HostPlane:
     """Host numpy replay; batches ship host->device each update. With a
     mesh, batches shard over dp and XLA inserts the gradient psum. Batches
@@ -178,6 +195,7 @@ class Trainer:
         self,
         cfg: R2D2Config,
         vec_env=None,
+        fn_env=None,
         resume: bool = False,
         metrics: Optional[MetricsLogger] = None,
         profile_dir: Optional[str] = None,
@@ -189,9 +207,16 @@ class Trainer:
         self._profile_remaining = profile_steps if profile_dir else 0
         self._profile_active = False
         self.cfg = cfg
-        self.vec_env = vec_env if vec_env is not None else build_vec_env(cfg, seed=cfg.seed)
-        if self.vec_env.action_dim != cfg.action_dim:
-            cfg = cfg.replace(action_dim=self.vec_env.action_dim)
+        self.fn_env = None
+        if cfg.collector == "device":
+            self.vec_env = None
+            self.fn_env = fn_env if fn_env is not None else build_fn_env(cfg)
+            env_action_dim = self.fn_env.NUM_ACTIONS
+        else:
+            self.vec_env = vec_env if vec_env is not None else build_vec_env(cfg, seed=cfg.seed)
+            env_action_dim = self.vec_env.action_dim
+        if env_action_dim != cfg.action_dim:
+            cfg = cfg.replace(action_dim=env_action_dim)
             self.cfg = cfg
 
         # mesh: dp x tp when the config asks for parallelism (collectives
@@ -211,19 +236,30 @@ class Trainer:
                 cfg.checkpoint_dir, self.state
             )
 
+        # first update after THIS construction compiles the jitted step;
+        # the profiler gate skips it even when resuming from step > 0
+        self._initial_step = int(self.state.step)
         self.sample_rng = np.random.default_rng(cfg.seed + 2)
         self.plane = _PLANES[cfg.replay_plane](self)
         self.replay = self.plane.replay
         self.param_store = ParamStore(self.state.params)
-        self.actor = VectorizedActor(
-            cfg,
-            self.net,
-            self.param_store,
-            self.vec_env,
-            epsilon_ladder(cfg.num_actors, cfg.base_eps, cfg.eps_alpha),
-            self.replay.add_block,
-            seed=cfg.seed + 1,
-        )
+        if cfg.collector == "device":
+            from r2d2_tpu.collect import DeviceCollector
+
+            self.actor = DeviceCollector(
+                cfg, self.net, self.param_store, self.fn_env, self.replay,
+                seed=cfg.seed + 1,
+            )
+        else:
+            self.actor = VectorizedActor(
+                cfg,
+                self.net,
+                self.param_store,
+                self.vec_env,
+                epsilon_ladder(cfg.num_actors, cfg.base_eps, cfg.eps_alpha),
+                self.replay.add_block,
+                seed=cfg.seed + 1,
+            )
         self.metrics = metrics or MetricsLogger(cfg.metrics_path, cfg.log_interval)
 
     # ------------------------------------------------------------- plumbing
@@ -235,7 +271,7 @@ class Trainer:
         if (
             self._profile_remaining > 0
             and not self._profile_active
-            and int(self.state.step) >= 1
+            and int(self.state.step) >= self._initial_step + 1
         ):
             jax.profiler.start_trace(self.profile_dir)
             self._profile_active = True
@@ -289,7 +325,7 @@ class Trainer:
         steps = 0
         while not self.replay.can_sample():
             self.actor.step()
-            steps += self.vec_env.num_envs
+            steps += self.actor.steps_per_call
             if max_steps is not None and steps >= max_steps:
                 raise RuntimeError("warmup exceeded max_steps without filling replay")
 
@@ -301,7 +337,7 @@ class Trainer:
         self.warmup()
         try:
             while int(self.state.step) < cfg.training_steps:
-                for _ in range(max(k // self.vec_env.num_envs, 1)):
+                for _ in range(max(k // self.actor.steps_per_call, 1)):
                     self.actor.step()
                 m, step = self._one_update(self.plane.sample())
                 self._log(m, step)
@@ -378,6 +414,9 @@ def main(argv=None):
     p.add_argument("--mode", default="threaded", choices=["threaded", "inline"])
     p.add_argument("--replay", default=None, choices=["host", "device", "sharded"],
                    help="replay data plane (default: preset's replay_plane)")
+    p.add_argument("--collector", default=None, choices=["host", "device"],
+                   help="experience collection: host actor loop or fully "
+                        "on-device jitted chunks (pure-JAX envs only)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--metrics", default=None)
     p.add_argument("--profile-dir", default=None,
@@ -397,6 +436,10 @@ def main(argv=None):
         overrides["metrics_path"] = args.metrics
     if args.replay:
         overrides["replay_plane"] = args.replay
+    if args.collector:
+        overrides["collector"] = args.collector
+        if args.collector == "device" and args.replay is None:
+            overrides["replay_plane"] = "device"
     if overrides:
         cfg = cfg.replace(**overrides)
 

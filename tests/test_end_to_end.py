@@ -72,3 +72,42 @@ def test_evaluate_series(trained):
     assert [r["step"] for r in rows] == [15, 30]
     assert all(np.isfinite(r["mean_reward"]) for r in rows)
     assert all(r["env_frames"] == r["env_steps"] * 4 for r in rows)
+
+
+def test_device_collector_training(tmp_path):
+    """The all-device pipeline: jitted chunk collection -> HBM store ->
+    fused update, driven inline and threaded through the Trainer."""
+    cfg = tiny_test().replace(
+        env_name="catch",
+        collector="device",
+        replay_plane="device",
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        training_steps=10,
+        save_interval=5,
+        learning_starts=48,
+        max_episode_steps=16,
+    )
+    trainer = Trainer(cfg)
+    trainer.run_inline()
+    assert int(trainer.state.step) == 10
+    assert trainer.replay.env_steps >= 48
+    assert trainer.actor.total_steps == trainer.replay.env_steps
+    n_ep, _ = trainer.replay.pop_episode_stats()  # drained by _log already
+    totals = trainer.replay.episode_totals()
+    assert totals[0] > 0
+
+
+def test_device_collector_threaded(tmp_path):
+    cfg = tiny_test().replace(
+        env_name="catch",
+        collector="device",
+        replay_plane="device",
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        training_steps=6,
+        save_interval=100,
+        learning_starts=48,
+        max_episode_steps=16,
+    )
+    trainer = Trainer(cfg)
+    trainer.run_threaded()
+    assert int(trainer.state.step) == 6
