@@ -178,6 +178,10 @@ def make_collect_fn(
             env_state, h, c, la, lr, active = carry
             obs = vrender(env_state)
             q, (h2, c2) = net.apply(params, obs, la, lr, (h, c), method=net.act)
+            # scan carry stays f32 regardless of compute dtype (bf16->f32
+            # is exact, and act re-casts on use — same values as the host
+            # actor's bf16 carry)
+            h2, c2 = h2.astype(jnp.float32), c2.astype(jnp.float32)
             ke, ka = jax.random.split(key_t)
             explore = jax.random.uniform(ke, (E,)) < epsilons
             rand_a = jax.random.randint(ka, (E,), 0, A)
