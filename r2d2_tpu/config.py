@@ -107,6 +107,11 @@ class R2D2Config:
     worker_max_restarts: int = 3
     heartbeat_timeout: float = 120.0
     checkpoint_dir: str = "checkpoints"
+    # persist replay contents (replay/snapshot.py) at end of run and
+    # restore them on --resume: a resumed run continues from the SAME
+    # replay distribution instead of refilling from scratch. Costs one
+    # obs-store-sized .npz write (~7 KB/transition at 84x84).
+    snapshot_replay: bool = False
     metrics_path: Optional[str] = None  # jsonl metrics file
     use_native_replay: bool = True  # C++ replay core if built, else numpy
     # replay data plane: "host" (numpy store, batches shipped per update),

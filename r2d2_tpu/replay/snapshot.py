@@ -1,0 +1,184 @@
+"""Replay snapshots: persist the full replay state for true resume.
+
+The reference has no resume path at all (SURVEY.md section 5.4); even this
+framework's learner checkpoints (utils/checkpoint.py) restore optimization
+exactly but refill replay from fresh experience. For workloads where replay
+contents matter across restarts (long warmups, offline analysis, failure
+recovery mid-curriculum), these helpers save and restore EVERYTHING the
+replay subsystem holds:
+
+- control plane: sum-tree leaf priorities, circular block pointer, size /
+  env-step / episode accounting, per-slot sequence counts, staleness state;
+- data plane: every store field — host numpy arrays (ReplayBuffer),
+  single-chip HBM stores (DeviceReplayBuffer, downloaded/uploaded once),
+  or dp-sharded HBM stores (ShardedDeviceReplay, restored with their
+  NamedSharding intact).
+
+A restored buffer is bit-identical to the saved one: sampling with the same
+RNG stream yields the same batches (pinned by tests/test_snapshot.py).
+Consistency: the whole payload is captured under the buffer lock(s), so a
+snapshot taken while collection threads are writing is a clean point-in-time
+cut; the file write itself happens outside the locks and lands atomically
+(temp file + os.replace), so a crash mid-write can never leave a truncated
+snapshot that poisons --resume.
+
+Format: one .npz (uncompressed — obs dominate and are incompressible-ish
+uint8; write speed matters more). Obs storage dominates the file size:
+~7 KB/transition at 84x84, so snapshot cadence is the caller's cost knob —
+the Trainer writes one at end-of-run when cfg.snapshot_replay is set and
+restores it on --resume.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import jax
+import numpy as np
+
+from r2d2_tpu.replay.control_plane import ReplayControlPlane
+from r2d2_tpu.replay.device_store import DeviceReplayBuffer
+from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+
+STORE_FIELDS = (
+    "obs", "last_action", "last_reward", "action", "n_step_reward",
+    "gamma", "hidden", "burn_in", "learning", "forward",
+)
+
+_COUNTERS = (
+    "block_ptr", "size", "env_steps", "num_episodes", "episode_reward_sum",
+    "total_episodes", "total_reward_sum",
+)
+
+
+def _plane_state(plane: ReplayControlPlane, prefix: str = "") -> Dict[str, np.ndarray]:
+    d = {prefix + "tree_leaves": plane.tree.leaves()}
+    for k in _COUNTERS:
+        d[prefix + k] = np.asarray(getattr(plane, k))
+    d[prefix + "learning_sum"] = plane.learning_sum.copy()
+    d[prefix + "occupied"] = plane.occupied.copy()
+    d[prefix + "num_seq_store"] = plane.num_seq_store.copy()
+    return d
+
+
+def _restore_plane(plane: ReplayControlPlane, d, prefix: str = "") -> None:
+    plane.tree.load_leaves(d[prefix + "tree_leaves"])
+    for k in _COUNTERS:
+        v = d[prefix + k][()]
+        setattr(plane, k, float(v) if "reward" in k else int(v))
+    plane.learning_sum[:] = d[prefix + "learning_sum"]
+    plane.occupied[:] = d[prefix + "occupied"]
+    plane.num_seq_store[:] = d[prefix + "num_seq_store"]
+
+
+def _check_kind(kind: str, want: str) -> None:
+    if kind != want:
+        raise ValueError(f"snapshot kind {kind!r} != replay plane {want!r}")
+
+
+def _validated_stores(d, current: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Load every store field from the npz, checking shape/dtype against the
+    live buffer BEFORE the caller mutates anything — a mismatched snapshot
+    must leave the buffer untouched."""
+    out = {}
+    for k in STORE_FIELDS:
+        cur = current[k]
+        val = d["store_" + k]
+        if val.shape != cur.shape or val.dtype != cur.dtype:
+            raise ValueError(
+                f"store {k}: snapshot {val.shape}/{val.dtype} != "
+                f"buffer {cur.shape}/{cur.dtype}"
+            )
+        out[k] = val
+    return out
+
+
+def _atomic_savez(path: str, payload: Dict[str, np.ndarray]) -> None:
+    # keep the .npz suffix on the temp name: np.savez APPENDS .npz to
+    # filenames without it, which would break the rename
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **payload)
+    os.replace(tmp, path)
+
+
+def save_replay(replay, path: str) -> None:
+    """Snapshot any replay plane (host / device / sharded) to `path`.
+
+    The payload (control state + a copy of every store) is captured under
+    the buffer lock; the npz write happens after release."""
+    from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
+
+    if isinstance(replay, ShardedDeviceReplay):
+        with replay.lock:
+            payload: Dict[str, np.ndarray] = {"kind": np.asarray("sharded")}
+            payload["rr"] = np.asarray(replay._rr)
+            for i, shard in enumerate(replay.shards):
+                with shard.lock:
+                    payload.update(_plane_state(shard, prefix=f"shard{i}_"))
+            for k in STORE_FIELDS:
+                payload["store_" + k] = np.asarray(replay.stores[k])
+    elif isinstance(replay, DeviceReplayBuffer):
+        with replay.lock:
+            payload = {"kind": np.asarray("device")}
+            payload.update(_plane_state(replay))
+            for k in STORE_FIELDS:
+                payload["store_" + k] = np.asarray(replay.stores[k])
+    elif isinstance(replay, ReplayBuffer):
+        with replay.lock:
+            payload = {"kind": np.asarray("host")}
+            payload.update(_plane_state(replay))
+            for k in STORE_FIELDS:
+                # copy under the lock: np.savez runs after release, and the
+                # live stores keep mutating under collection threads
+                payload["store_" + k] = getattr(replay, k + "_store").copy()
+    else:
+        raise TypeError(f"unknown replay type {type(replay).__name__}")
+    _atomic_savez(path, payload)
+
+
+def restore_replay(replay, path: str) -> None:
+    """Restore a snapshot into a freshly built replay of the SAME config.
+
+    Mismatches (different plane kind, capacity, obs shape, hidden dim, dp)
+    raise BEFORE any state is touched — a failed restore leaves the buffer
+    exactly as constructed."""
+    from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
+
+    with np.load(path, allow_pickle=False) as d:
+        kind = str(d["kind"])
+        if isinstance(replay, ShardedDeviceReplay):
+            _check_kind(kind, "sharded")
+            with replay.lock:
+                vals = _validated_stores(d, replay.stores)
+                for i in range(len(replay.shards)):  # leaf-count pre-check
+                    if len(d[f"shard{i}_tree_leaves"]) != replay.shards[i].tree.capacity:
+                        raise ValueError(f"shard {i}: tree size mismatch")
+                replay._rr = int(d["rr"][()])
+                for i, shard in enumerate(replay.shards):
+                    with shard.lock:
+                        _restore_plane(shard, d, prefix=f"shard{i}_")
+                replay.stores = {
+                    k: jax.device_put(v, replay.stores[k].sharding)
+                    for k, v in vals.items()
+                }
+        elif isinstance(replay, DeviceReplayBuffer):
+            _check_kind(kind, "device")
+            with replay.lock:
+                vals = _validated_stores(d, replay.stores)
+                if len(d["tree_leaves"]) != replay.tree.capacity:
+                    raise ValueError("tree size mismatch")
+                _restore_plane(replay, d)
+                replay.stores = {k: jax.device_put(v) for k, v in vals.items()}
+        elif isinstance(replay, ReplayBuffer):
+            _check_kind(kind, "host")
+            with replay.lock:
+                current = {k: getattr(replay, k + "_store") for k in STORE_FIELDS}
+                vals = _validated_stores(d, current)
+                if len(d["tree_leaves"]) != replay.tree.capacity:
+                    raise ValueError("tree size mismatch")
+                _restore_plane(replay, d)
+                for k in STORE_FIELDS:
+                    current[k][:] = vals[k]
+        else:
+            raise TypeError(f"unknown replay type {type(replay).__name__}")

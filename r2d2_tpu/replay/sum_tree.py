@@ -103,3 +103,20 @@ class SumTree:
 
     def priorities_of(self, idxes: np.ndarray) -> np.ndarray:
         return self.tree[np.asarray(idxes, dtype=np.int64) + self.leaf_offset]
+
+    # ------------------------------------------------------- snapshot support
+
+    def leaves(self) -> np.ndarray:
+        """Raw leaf priorities (already ^alpha), for replay snapshots."""
+        return self.tree[self.leaf_offset : self.leaf_offset + self.capacity].copy()
+
+    def load_leaves(self, values: np.ndarray) -> None:
+        """Restore raw leaf priorities (as returned by leaves()) and rebuild
+        every internal sum bottom-up."""
+        if len(values) != self.capacity:
+            raise ValueError(f"expected {self.capacity} leaves, got {len(values)}")
+        self.tree[:] = 0.0
+        self.tree[self.leaf_offset : self.leaf_offset + self.capacity] = values
+        for k in range(self.num_layers - 1, 0, -1):
+            p = np.arange(2 ** (k - 1) - 1, 2**k - 1)
+            self.tree[p] = self.tree[2 * p + 1] + self.tree[2 * p + 2]

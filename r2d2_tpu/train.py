@@ -24,6 +24,7 @@ on learning_starts.
 from __future__ import annotations
 
 import argparse
+import os
 import queue
 import time
 from typing import Callable, Optional
@@ -231,10 +232,12 @@ class Trainer:
             self.state = jax.device_put(self.state, replicated_sharding(self.mesh))
         self.env_steps_offset = 0
         self.wall_minutes_offset = 0.0
+        self._resumed = False
         if resume and latest_checkpoint_step(cfg.checkpoint_dir) is not None:
             self.state, self.env_steps_offset, self.wall_minutes_offset = restore_checkpoint(
                 cfg.checkpoint_dir, self.state
             )
+            self._resumed = True
 
         # first update after THIS construction compiles the jitted step;
         # the profiler gate skips it even when resuming from step > 0
@@ -242,6 +245,16 @@ class Trainer:
         self.sample_rng = np.random.default_rng(cfg.seed + 2)
         self.plane = _PLANES[cfg.replay_plane](self)
         self.replay = self.plane.replay
+        if self._resumed and cfg.snapshot_replay:
+            from r2d2_tpu.replay.snapshot import restore_replay
+
+            snap = self._replay_snapshot_path()
+            if os.path.exists(snap):
+                restore_replay(self.replay, snap)
+                # restored env steps are part of the run total already
+                # counted by env_steps_offset from the learner checkpoint;
+                # rebase so the sum isn't double-counted
+                self.env_steps_offset -= self.replay.env_steps
         self.param_store = ParamStore(self.state.params)
         if cfg.collector == "device":
             from r2d2_tpu.collect import DeviceCollector
@@ -293,6 +306,31 @@ class Trainer:
             )
         return m, step
 
+    def _replay_snapshot_path(self) -> str:
+        return os.path.join(self.cfg.checkpoint_dir, "replay_snapshot.npz")
+
+    def save_replay_snapshot(self) -> str:
+        """Persist full replay contents (replay/snapshot.py); returns the
+        path. Run modes call this on exit when cfg.snapshot_replay is set."""
+        from r2d2_tpu.replay.snapshot import save_replay
+
+        os.makedirs(self.cfg.checkpoint_dir, exist_ok=True)
+        path = self._replay_snapshot_path()
+        save_replay(self.replay, path)
+        return path
+
+    def _snapshot_on_exit(self) -> None:
+        """finally-block wrapper: the snapshot is the largest write of the
+        run (obs-store-sized), so a failure here (ENOSPC) must not replace
+        the in-flight training exception with its own."""
+        try:
+            self.save_replay_snapshot()
+        except Exception as e:  # noqa: BLE001 — log-and-continue on exit
+            import traceback
+
+            print(f"replay snapshot failed on exit: {e!r}")
+            traceback.print_exc()
+
     def _stop_profile(self) -> None:
         """Finalize an in-flight trace; safe to call repeatedly. Run modes
         call this on every exit path so a crash or an early end of training
@@ -343,6 +381,8 @@ class Trainer:
                 self._log(m, step)
         finally:
             self._stop_profile()
+            if cfg.snapshot_replay:
+                self._snapshot_on_exit()
 
     def run_threaded(self) -> None:
         """Actor thread + prefetch thread + learner loop (reference
@@ -404,6 +444,8 @@ class Trainer:
         finally:
             self._stop_profile()
             sup.shutdown()
+            if cfg.snapshot_replay:
+                self._snapshot_on_exit()
 
 
 def main(argv=None):
@@ -418,6 +460,9 @@ def main(argv=None):
                    help="experience collection: host actor loop or fully "
                         "on-device jitted chunks (pure-JAX envs only)")
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--snapshot-replay", action="store_true",
+                   help="save full replay contents at end of run and restore "
+                        "them on --resume (replay/snapshot.py)")
     p.add_argument("--metrics", default=None)
     p.add_argument("--profile-dir", default=None,
                    help="record a jax.profiler trace of the first post-warmup updates")
@@ -440,6 +485,8 @@ def main(argv=None):
         overrides["collector"] = args.collector
         if args.collector == "device" and args.replay is None:
             overrides["replay_plane"] = "device"
+    if args.snapshot_replay:
+        overrides["snapshot_replay"] = True
     if overrides:
         cfg = cfg.replace(**overrides)
 
