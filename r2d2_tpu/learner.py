@@ -248,6 +248,45 @@ def make_fused_train_step(cfg: R2D2Config, net: R2D2Network, donate: bool = True
     return jax.jit(fused, donate_argnums=(0,) if donate else ())
 
 
+def make_fused_multi_train_step(
+    cfg: R2D2Config, net: R2D2Network, num_steps: int, donate: bool = True
+):
+    """K train steps in ONE dispatch: lax.scan over stacked sample
+    coordinates, each iteration gathering its batch from the HBM store and
+    applying the full update (in-jit target sync included).
+
+    Exactly equivalent to running the K single fused steps sequentially on
+    the same pre-drawn coordinates (pinned by test) — the host simply was
+    not involved between them. This is the dispatch-latency amortizer: on
+    hardware where each jit call costs ~milliseconds of launch/tunnel
+    latency, per-update overhead drops K-fold. The semantic trade is that
+    priorities and new blocks apply to the tree at K-update granularity —
+    the reference's own pipeline already tolerates a deeper lag (its batch
+    queue + learner prefetch hold ~12 batches, reference worker.py:364-371).
+
+    Signature: (state, stores, b, s, w) with b/s/w of shape (K, B);
+    returns (state, metrics-of-last-step, priorities (K, B))."""
+    raw = _raw_train_step(cfg, net)
+    gather_batch = make_store_gather(cfg)
+
+    def multi(state: TrainState, stores, b, s, w):
+        if b.shape[0] != num_steps:
+            raise ValueError(
+                f"coordinate stack has {b.shape[0]} steps, expected {num_steps}"
+            )
+
+        def body(state, xs):
+            bb, ss, ww = xs
+            batch = gather_batch(stores, bb, ss, ww)
+            state, metrics, prios = raw(state, batch)
+            return state, (metrics, prios)
+
+        state, (metrics, prios) = jax.lax.scan(body, state, (b, s, w))
+        return state, jax.tree.map(lambda x: x[-1], metrics), prios
+
+    return jax.jit(multi, donate_argnums=(0,) if donate else ())
+
+
 def make_gather_step(cfg: R2D2Config):
     """Jitted (stores, b, s, is_weights) -> DeviceBatch: materialize the
     sampled windows into a fresh HBM batch AT SAMPLE TIME.

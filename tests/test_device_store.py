@@ -92,3 +92,50 @@ def test_device_store_eviction_and_staleness(both_buffers):
     after = dev.tree.priorities_of(np.arange(12))
     np.testing.assert_allclose(after[:6], before[:6])  # overwritten slots masked
     np.testing.assert_allclose(after[6:], 9.0**cfg.prio_exponent)
+
+
+def test_multi_step_matches_sequential_fused():
+    """K updates folded into one dispatch == K sequential fused steps on
+    the same pre-drawn coordinates: same final params, same priorities."""
+    import jax.numpy as jnp
+
+    from r2d2_tpu.learner import make_fused_multi_train_step, make_fused_train_step
+
+    cfg = tiny_test().replace(target_net_update_interval=2)  # sync mid-chunk
+    net, state0 = init_train_state(cfg, jax.random.PRNGKey(0))
+    replay = DeviceReplayBuffer(cfg)
+    rng = np.random.default_rng(0)
+    from bench import synth_block
+
+    for _ in range(6):
+        replay.add_block(
+            synth_block(cfg, rng),
+            rng.uniform(0.5, 2.0, cfg.seqs_per_block).astype(np.float32),
+            None,
+        )
+    K = 3
+    draws = [replay.sample_indices(np.random.default_rng(i)) for i in range(K)]
+
+    single = make_fused_train_step(cfg, net, donate=False)
+    state = state0
+    prios_seq = []
+    for si in draws:
+        state, m, p = replay.run_with_stores(
+            lambda stores, si=si: single(
+                state, stores, jnp.asarray(si.b), jnp.asarray(si.s), jnp.asarray(si.is_weights)
+            )
+        )
+        prios_seq.append(np.asarray(p))
+
+    multi = make_fused_multi_train_step(cfg, net, K, donate=False)
+    b = jnp.stack([jnp.asarray(si.b) for si in draws])
+    s = jnp.stack([jnp.asarray(si.s) for si in draws])
+    w = jnp.stack([jnp.asarray(si.is_weights) for si in draws])
+    state_m, m_m, p_m = replay.run_with_stores(lambda stores: multi(state0, stores, b, s, w))
+
+    assert int(state_m.step) == int(state.step) == K
+    for a, bb in zip(jax.tree.leaves(state_m.params), jax.tree.leaves(state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=1e-6)
+    for a, bb in zip(jax.tree.leaves(state_m.target_params), jax.tree.leaves(state.target_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(p_m), np.stack(prios_seq), atol=1e-5)
