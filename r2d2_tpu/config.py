@@ -123,6 +123,12 @@ class R2D2Config:
     # the WHOLE loop incl. env dynamics and block packing in one jitted
     # scan; needs a pure-JAX functional env and replay_plane="device")
     collector: str = "host"
+    # learner updates folded into one dispatch (device plane only):
+    # lax.scan over K pre-drawn coordinate sets amortizes the per-call
+    # launch latency K-fold (learner.make_fused_multi_train_step). K > 1
+    # trades priority/publish granularity for throughput — the reference's
+    # own pipeline already lags ~12 batches (worker.py:364-371).
+    updates_per_dispatch: int = 1
 
     # --- derived ----------------------------------------------------------
     @property
@@ -170,6 +176,18 @@ class R2D2Config:
             raise ValueError(f"unknown replay_plane {self.replay_plane!r}")
         if self.collector not in ("host", "device"):
             raise ValueError(f"unknown collector {self.collector!r}")
+        if self.updates_per_dispatch < 1:
+            raise ValueError("updates_per_dispatch must be >= 1")
+        if self.updates_per_dispatch > 1 and self.replay_plane != "device":
+            raise ValueError(
+                "updates_per_dispatch > 1 is implemented for the device "
+                "replay plane (fused in-jit gathers)"
+            )
+        if self.training_steps % self.updates_per_dispatch != 0:
+            raise ValueError(
+                "training_steps must be a multiple of updates_per_dispatch "
+                "(each dispatch advances the step counter by that amount)"
+            )
         if self.collector == "device" and self.replay_plane != "device":
             raise ValueError(
                 "collector='device' writes packed blocks straight into the "

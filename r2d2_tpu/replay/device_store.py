@@ -169,18 +169,34 @@ class DeviceReplayBuffer(ReplayControlPlane):
 
     # --------------------------------------------------------------- sample
 
+    def _draw_sample_idx(self, rng: np.random.Generator) -> SampleIdx:
+        """One tree draw packaged as SampleIdx. Caller holds self.lock."""
+        b, s, idxes, is_weights = self._draw(rng)
+        return SampleIdx(
+            b=b.astype(np.int32),
+            s=s.astype(np.int32),
+            is_weights=is_weights,
+            idxes=idxes,
+            old_ptr=self.block_ptr,
+            env_steps=self.env_steps,
+        )
+
     def sample_indices(self, rng: np.random.Generator) -> SampleIdx:
         """Tree draw only — the kilobyte that crosses the wire per update."""
         with self.lock:
-            b, s, idxes, is_weights = self._draw(rng)
-            return SampleIdx(
-                b=b.astype(np.int32),
-                s=s.astype(np.int32),
-                is_weights=is_weights,
-                idxes=idxes,
-                old_ptr=self.block_ptr,
-                env_steps=self.env_steps,
-            )
+            return self._draw_sample_idx(rng)
+
+    def sample_and_run(self, rng: np.random.Generator, k: int, fn: Callable):
+        """Draw k coordinate sets and dispatch fn(stores, draws) under ONE
+        lock hold (multi-update path, learner.make_fused_multi_train_step).
+
+        Safety: the lock orders this dispatch before any later add_block's
+        donated write; the device stream executes in dispatch order, so the
+        in-jit gathers read exactly the data the coordinates were drawn
+        against — an add can never retarget a sampled slot in between."""
+        with self.lock:
+            draws = [self._draw_sample_idx(rng) for _ in range(k)]
+            return draws, fn(self.stores, draws)
 
     # ------------------------------------------------------------- dispatch
 

@@ -92,6 +92,8 @@ class _HostPlane:
     are copied out of the store at sample time, so queued items can never
     go stale (pipelined == inline here)."""
 
+    steps_per_update = 1
+
     def __init__(self, tr: "Trainer"):
         self.tr = tr
         self.replay = ReplayBuffer(tr.cfg)
@@ -124,11 +126,21 @@ class _DevicePlane:
     def __init__(self, tr: "Trainer"):
         self.tr = tr
         self.replay = DeviceReplayBuffer(tr.cfg)
+        self.K = self.steps_per_update = tr.cfg.updates_per_dispatch
+        if self.K > 1:
+            from r2d2_tpu.learner import make_fused_multi_train_step
+
+            self.multi_fn = make_fused_multi_train_step(tr.cfg, tr.net, self.K)
         self.step_fn = make_fused_train_step(tr.cfg, tr.net)
         self.gather_fn = make_gather_step(tr.cfg)
         self.batch_step_fn = make_batch_train_step(tr.cfg, tr.net)
 
     def sample(self, pipelined: bool = False):
+        if self.K > 1:
+            # multi-update dispatch draws its own coordinates at update
+            # time (atomically with the dispatch) — queued coordinates
+            # could be retargeted by adds landing while the item waits
+            return ("multi", None, None, None)
         with span("replay/sample"):
             si = self.replay.sample_indices(self.tr.sample_rng)
             coords = (jax.device_put(si.b), jax.device_put(si.s), jax.device_put(si.is_weights))
@@ -137,8 +149,28 @@ class _DevicePlane:
                 return "batch", batch, si.idxes, si.old_ptr
             return "coords", coords, si.idxes, si.old_ptr
 
+    def _multi_update(self, state):
+        """K updates in one dispatch: draw + dispatch under one lock hold
+        (DeviceReplayBuffer.sample_and_run), then apply the (K, B)
+        priorities row-by-row under each draw's own staleness window."""
+
+        def dispatch(stores, draws):
+            b = jnp.asarray(np.stack([d.b for d in draws]))
+            s = jnp.asarray(np.stack([d.s for d in draws]))
+            w = jnp.asarray(np.stack([d.is_weights for d in draws]))
+            return self.multi_fn(state, stores, b, s, w)
+
+        draws, (new_state, m, priorities) = self.replay.sample_and_run(
+            self.tr.sample_rng, self.K, dispatch
+        )
+        for row, d in zip(np.asarray(priorities), draws):
+            self.replay.update_priorities(d.idxes, row, d.old_ptr)
+        return new_state, m
+
     def update(self, state, item):
         kind, payload, idxes, old_ptr = item
+        if kind == "multi":
+            return self._multi_update(state)
         if kind == "batch":
             state, m, priorities = self.batch_step_fn(state, payload)
         else:
@@ -154,6 +186,8 @@ class _ShardedPlane:
     shard, gradient psum over dp (replay/sharded_store.py). Same
     inline/pipelined split as _DevicePlane; the pipelined gather runs under
     shard_map so each device materializes its local sub-batch."""
+
+    steps_per_update = 1
 
     def __init__(self, tr: "Trainer"):
         if tr.mesh is None:
@@ -242,6 +276,11 @@ class Trainer:
         # first update after THIS construction compiles the jitted step;
         # the profiler gate skips it even when resuming from step > 0
         self._initial_step = int(self.state.step)
+        # host-side mirror of state.step: reading the device scalar every
+        # update would force a full stream sync per update (the tunneled
+        # backend only syncs on host readback); increments are known
+        # exactly (updates_per_dispatch per plane.update)
+        self._step = self._initial_step
         self.sample_rng = np.random.default_rng(cfg.seed + 2)
         self.plane = _PLANES[cfg.replay_plane](self)
         self.replay = self.plane.replay
@@ -284,20 +323,24 @@ class Trainer:
         if (
             self._profile_remaining > 0
             and not self._profile_active
-            and int(self.state.step) >= self._initial_step + 1
+            and self._step >= self._initial_step + 1
         ):
             jax.profiler.start_trace(self.profile_dir)
             self._profile_active = True
-        with step_span("learner_update", int(self.state.step)):
+        prev = self._step
+        with step_span("learner_update", prev):
             self.state, m = self.plane.update(self.state, item)
-        step = int(self.state.step)
+        self._step += self.plane.steps_per_update
+        step = self._step
         if self._profile_active:
-            self._profile_remaining -= 1
+            self._profile_remaining -= self.plane.steps_per_update
             if self._profile_remaining <= 0:
                 self._stop_profile()
-        if step % self.cfg.publish_interval == 0:
+        # interval CROSSINGS, not equality: a K-update dispatch may jump
+        # past the exact multiple
+        if step // self.cfg.publish_interval > prev // self.cfg.publish_interval:
             self.param_store.publish(self.state.params)
-        if step % self.cfg.save_interval == 0:
+        if step // self.cfg.save_interval > prev // self.cfg.save_interval:
             save_checkpoint(
                 self.cfg.checkpoint_dir,
                 self.state,
@@ -372,9 +415,12 @@ class Trainer:
         cfg = self.cfg
         self._start_time = time.time()
         k = env_steps_per_update or max(cfg.num_actors, 1)
+        # one dispatch is steps_per_update learner updates: scale collection
+        # so the env-step : update ratio the caller asked for is preserved
+        k *= self.plane.steps_per_update
         self.warmup()
         try:
-            while int(self.state.step) < cfg.training_steps:
+            while self._step < cfg.training_steps:
                 for _ in range(max(k // self.actor.steps_per_call, 1)):
                     self.actor.step()
                 m, step = self._one_update(self.plane.sample())
@@ -425,7 +471,7 @@ class Trainer:
                   on_restart=sampler_recover)
         last_health: Optional[dict] = None
         try:
-            while int(self.state.step) < cfg.training_steps:
+            while self._step < cfg.training_steps:
                 try:
                     item = batch_q.get(timeout=2.0)
                 except queue.Empty:
@@ -435,7 +481,7 @@ class Trainer:
                     stats = sup.check()
                     if stats != last_health:
                         last_health = stats
-                        self.metrics.log({"step": int(self.state.step), **stats})
+                        self.metrics.log({"step": self._step, **stats})
                     continue
                 m, step = self._one_update(item)
                 health = sup.check()
@@ -459,6 +505,9 @@ def main(argv=None):
     p.add_argument("--collector", default=None, choices=["host", "device"],
                    help="experience collection: host actor loop or fully "
                         "on-device jitted chunks (pure-JAX envs only)")
+    p.add_argument("--updates-per-dispatch", type=int, default=None,
+                   help="fold K learner updates into one jitted dispatch "
+                        "(device replay plane; amortizes launch latency)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--snapshot-replay", action="store_true",
                    help="save full replay contents at end of run and restore "
@@ -487,6 +536,10 @@ def main(argv=None):
             overrides["replay_plane"] = "device"
     if args.snapshot_replay:
         overrides["snapshot_replay"] = True
+    if args.updates_per_dispatch is not None:
+        overrides["updates_per_dispatch"] = args.updates_per_dispatch
+        if args.replay is None and args.collector != "device":
+            overrides["replay_plane"] = "device"
     if overrides:
         cfg = cfg.replace(**overrides)
 

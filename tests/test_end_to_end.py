@@ -111,3 +111,29 @@ def test_device_collector_threaded(tmp_path):
     trainer = Trainer(cfg)
     trainer.run_threaded()
     assert int(trainer.state.step) == 6
+
+
+@pytest.mark.parametrize("mode", ["inline", "threaded"])
+def test_multi_update_dispatch_training(tmp_path, mode):
+    """updates_per_dispatch > 1: K updates per dispatch through the real
+    Trainer — cadence crossings (publish/save) still fire and training
+    reaches the step target."""
+    cfg = tiny_test().replace(
+        env_name="catch",
+        replay_plane="device",
+        updates_per_dispatch=4,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        training_steps=12,
+        save_interval=5,  # crossings at 5 and 10 land mid-chunk
+        learning_starts=48,
+        max_episode_steps=16,
+    )
+    trainer = Trainer(cfg)
+    if mode == "inline":
+        trainer.run_inline(env_steps_per_update=4)
+    else:
+        trainer.run_threaded()
+    assert trainer._step == 12
+    assert int(trainer.state.step) == 12
+    # save_interval crossings 5 and 10 both produced checkpoints
+    assert len(list_checkpoint_steps(cfg.checkpoint_dir)) == 2
