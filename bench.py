@@ -63,6 +63,88 @@ def synth_block(cfg, rng: np.random.Generator) -> Block:
     )
 
 
+def system_main():
+    """Full-system throughput: on-device collection (collect.py) and the
+    K-update learner dispatch sharing ONE chip concurrently — the complete
+    TPU-native R2D2 (actor + replay + learner) with no synthetic data.
+
+    Env: catch at Atari resolution (84x84, device-rendered; this image has
+    no ALE and one host core — SURVEY.md section 2.4), full-size network.
+    Prints one JSON line with learner env-frames/s (the BASELINE.md metric)
+    measured WHILE collection sustains its own rate on the same chip."""
+    from r2d2_tpu.train import Trainer
+
+    E = 256
+    cfg = default_atari().replace(
+        env_name="catch",
+        action_dim=3,
+        compute_dtype="bfloat16",
+        num_actors=E,
+        max_episode_steps=82,  # catch: ball lands after height-2 steps
+        collector="device",
+        replay_plane="device",
+        updates_per_dispatch=16,
+        # capacity counts SLOTS x block_length, but catch blocks hold only
+        # 82 steps (one episode), so the effective transition capacity is
+        # num_blocks x 82 = 82k — budget learning_starts against that
+        buffer_capacity=400_000,
+        learning_starts=40_000,
+        training_steps=1_000_000,
+        save_interval=1_000_000,  # no checkpoint I/O inside the window
+    )
+    trainer = Trainer(cfg)
+    print(f"warmup: filling {cfg.learning_starts} transitions...", file=sys.stderr)
+    t0 = time.time()
+    trainer.warmup()
+    trainer._start_time = time.time()
+    print(f"warmup done in {time.time()-t0:.1f}s", file=sys.stderr)
+
+    stop = threading.Event()
+
+    def actor_loop():
+        while not stop.is_set():
+            trainer.actor.step()
+
+    # compile both paths before the window
+    item = trainer.plane.sample()
+    m, _ = trainer._one_update(item)
+    _ = int(np.asarray(trainer.state.step))
+
+    at = threading.Thread(target=actor_loop, daemon=True)
+    at.start()
+    target_seconds = 30.0
+    steps0, env0 = trainer._step, trainer.replay.env_steps
+    t0 = time.time()
+    while time.time() - t0 < target_seconds:
+        m, _ = trainer._one_update(trainer.plane.sample())
+    _ = int(np.asarray(trainer.state.step))  # stream sync
+    # snapshot BOTH counters at the same instant as elapsed: a collector
+    # chunk landing during stop/join must not count toward the window
+    elapsed = time.time() - t0
+    env = trainer.replay.env_steps - env0
+    upd = trainer._step - steps0
+    stop.set()
+    at.join(timeout=10.0)
+    learner_fps = upd / elapsed * cfg.batch_size * cfg.learning_steps * 4
+    collect_fps = env / elapsed * 4
+    print(
+        f"{upd} updates + {env} env steps in {elapsed:.1f}s "
+        f"(loss {float(m['loss']):.4f})",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "full_system_learner_env_frames_per_sec_per_chip",
+                "value": round(learner_fps, 1),
+                "unit": "env_frames/s",
+                "vs_baseline": round(learner_fps / BASELINE_FRAMES_PER_SEC, 3),
+                "concurrent_collection_env_frames_per_sec": round(collect_fps, 1),
+            }
+        )
+    )
+
+
 def main():
     cfg = default_atari().replace(
         compute_dtype="bfloat16",
@@ -205,4 +287,17 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    p = argparse.ArgumentParser(description="r2d2_tpu benchmarks")
+    p.add_argument(
+        "--mode", default="learner", choices=["learner", "system"],
+        help="learner: fused-update throughput on synthetic replay (the "
+             "driver's default metric). system: concurrent on-device "
+             "collection + learning, end to end.",
+    )
+    args = p.parse_args()
+    if args.mode == "system":
+        system_main()
+    else:
+        main()

@@ -281,6 +281,13 @@ class Trainer:
         # backend only syncs on host readback); increments are known
         # exactly (updates_per_dispatch per plane.update)
         self._step = self._initial_step
+        if self._initial_step % cfg.updates_per_dispatch != 0:
+            raise ValueError(
+                f"resumed step {self._initial_step} is not a multiple of "
+                f"updates_per_dispatch={cfg.updates_per_dispatch}; training "
+                "would overshoot training_steps — resume with the K the "
+                "checkpoint was trained with (or K=1)"
+            )
         self.sample_rng = np.random.default_rng(cfg.seed + 2)
         self.plane = _PLANES[cfg.replay_plane](self)
         self.replay = self.plane.replay
@@ -538,7 +545,15 @@ def main(argv=None):
         overrides["snapshot_replay"] = True
     if args.updates_per_dispatch is not None:
         overrides["updates_per_dispatch"] = args.updates_per_dispatch
-        if args.replay is None and args.collector != "device":
+        # convenience only for the single-chip default: never silently
+        # replace an explicitly-chosen or preset sharded/device plane —
+        # config.validate() surfaces incompatible combinations instead
+        if (
+            args.updates_per_dispatch > 1
+            and args.replay is None
+            and args.collector != "device"
+            and cfg.replay_plane == "host"
+        ):
             overrides["replay_plane"] = "device"
     if overrides:
         cfg = cfg.replace(**overrides)
