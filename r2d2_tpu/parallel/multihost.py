@@ -1,0 +1,115 @@
+"""Multi-host (multi-process) support: DCN + ICI spanning meshes.
+
+The reference is strictly single-host (SURVEY.md section 5.8: mp.Queues and
+shared memory; no NCCL/MPI). The TPU-native scale-out story is standard JAX
+SPMD: every host process runs the SAME program, `jax.devices()` is the
+GLOBAL device list, and one Mesh spans all of them — collectives ride ICI
+within a slice and DCN between slices, inserted by XLA from the same
+shardings that the single-host tests exercise on the 8-fake-device CPU mesh.
+
+Division of labor per host (mirrors the single-host design 1:1):
+
+- learner step: the shard_map/psum train step (learner.py) is already
+  multi-host-correct — each process feeds its ADDRESSABLE shards and XLA
+  runs the global program. Params/opt state replicated; gradient psum over
+  the global dp axis.
+- replay + collection: each host owns the control planes (sum trees,
+  pointers) for the dp shards whose devices it hosts, and its collector
+  writes blocks only into those local shards (`local_axis_indices` below
+  tells it which). No cross-host replay traffic exists by construction —
+  the same zero-copy locality argument as the single-host sharded plane
+  (replay/sharded_store.py), now with hosts as the unit.
+- weight publish to actors is host-local (each host's actors read its own
+  ParamStore snapshot of the replicated params).
+
+This module provides the three pieces a launcher needs; everything else is
+the same code the tests run single-host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from r2d2_tpu.parallel.mesh import make_mesh
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed for a multi-process run; returns True if
+    a multi-process runtime was set up.
+
+    Arguments fall back to the standard env vars (JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID) and, on TPU pods, to the TPU
+    metadata autodetection built into jax.distributed.initialize().
+    Single-process (no coordinator configured) is a no-op — the rest of
+    the framework behaves identically either way."""
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    num_processes = num_processes if num_processes is not None else (
+        int(env_np) if env_np else None
+    )
+    process_id = process_id if process_id is not None else (
+        int(env_pid) if env_pid else None
+    )
+    if coordinator_address is None and num_processes is None:
+        return False  # single-process run
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_count() > 1
+
+
+def make_global_mesh(
+    dp: Optional[int] = None, tp: int = 1, devices: Optional[Sequence] = None
+) -> Mesh:
+    """A dp x tp mesh over the GLOBAL device list (all processes).
+
+    dp defaults to global_device_count / tp. Device order follows
+    jax.devices(), which groups by process — so consecutive dp indices map
+    to one host's devices first, keeping each host's replay shards on its
+    own chips (ICI-local gathers, DCN only for the gradient psum legs that
+    cross hosts)."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if dp is None:
+        if len(devices) % tp != 0:
+            raise ValueError(f"{len(devices)} devices not divisible by tp={tp}")
+        dp = len(devices) // tp
+    return make_mesh(dp=dp, tp=tp, devices=devices)
+
+
+def local_axis_indices(mesh: Mesh, axis: str = "dp") -> List[int]:
+    """Indices along `axis` whose devices are addressable from THIS process.
+
+    The multi-host replay layout hangs off this: a host constructs control
+    planes and runs collectors only for its local shard indices; remote
+    shards are other hosts' responsibility. An axis index counts as local
+    when every device in its slice is addressable (with process-grouped
+    device order and tp <= devices-per-host this is all-or-nothing; a
+    partially-addressable slice raises, because splitting one shard's
+    control plane across hosts is not a supported layout)."""
+    pid = jax.process_index()
+    local = []
+    arr = mesh.devices  # ndarray shaped by mesh axis order
+    axis_num = list(mesh.axis_names).index(axis)
+    for i in range(arr.shape[axis_num]):
+        devs = np.take(arr, i, axis=axis_num).ravel()
+        owned = [d.process_index == pid for d in devs]
+        if all(owned):
+            local.append(i)
+        elif any(owned):
+            raise ValueError(
+                f"{axis} index {i} is split across processes; choose mesh "
+                "factors so each shard's devices live on one host"
+            )
+    return local

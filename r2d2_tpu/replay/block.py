@@ -49,3 +49,23 @@ class Block:
     @property
     def stored_steps(self) -> int:
         return len(self.obs)
+
+
+def store_field_specs(cfg):
+    """Per-slot (shape, dtype) of every replay-store field, WITHOUT the
+    leading block axis — the single source of truth shared by all device
+    store planes (device_store / sharded_store / multihost_store). Adding a
+    Block field means extending this map and pad_block_fields once."""
+    S, slot, bl = cfg.seqs_per_block, cfg.block_slot_len, cfg.block_length
+    return {
+        "obs": ((slot, *cfg.obs_shape), np.uint8),
+        "last_action": ((slot,), np.int32),
+        "last_reward": ((slot,), np.float32),
+        "action": ((bl,), np.int32),
+        "n_step_reward": ((bl,), np.float32),
+        "gamma": ((bl,), np.float32),
+        "hidden": ((S, 2, cfg.hidden_dim), np.float32),
+        "burn_in": ((S,), np.int32),
+        "learning": ((S,), np.int32),
+        "forward": ((S,), np.int32),
+    }

@@ -20,6 +20,21 @@ from r2d2_tpu.config import R2D2Config
 from r2d2_tpu.replay.sum_tree import SumTree
 
 
+def shard_config(cfg: R2D2Config, dp: int) -> R2D2Config:
+    """The per-shard (1/dp) view of a config, for dp-sharded replay planes:
+    each shard's control plane sees its slice of capacity/batch and knows
+    nothing of the mesh."""
+    return cfg.replace(
+        buffer_capacity=cfg.buffer_capacity // dp,
+        learning_starts=max(cfg.learning_starts // dp, 1),
+        batch_size=cfg.batch_size // dp,
+        dp_size=1,
+        tp_size=1,
+        replay_plane="host",
+        updates_per_dispatch=1,
+    )
+
+
 class ReplayControlPlane:
     def __init__(self, cfg: R2D2Config, native: Optional[object] = None):
         self.cfg = cfg

@@ -42,7 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from r2d2_tpu.config import R2D2Config
-from r2d2_tpu.replay.block import Block
+from r2d2_tpu.replay.block import Block, store_field_specs
 from r2d2_tpu.replay.control_plane import ReplayControlPlane
 
 
@@ -61,20 +61,10 @@ class SampleIdx:
 class DeviceReplayBuffer(ReplayControlPlane):
     def __init__(self, cfg: R2D2Config):
         super().__init__(cfg)
-        S = cfg.seqs_per_block
-        nb, slot, bl = cfg.num_blocks, cfg.block_slot_len, cfg.block_length
-
+        nb = cfg.num_blocks
         self.stores: Dict[str, jnp.ndarray] = {
-            "obs": jnp.zeros((nb, slot, *cfg.obs_shape), jnp.uint8),
-            "last_action": jnp.zeros((nb, slot), jnp.int32),
-            "last_reward": jnp.zeros((nb, slot), jnp.float32),
-            "action": jnp.zeros((nb, bl), jnp.int32),
-            "n_step_reward": jnp.zeros((nb, bl), jnp.float32),
-            "gamma": jnp.zeros((nb, bl), jnp.float32),
-            "hidden": jnp.zeros((nb, S, 2, cfg.hidden_dim), jnp.float32),
-            "burn_in": jnp.zeros((nb, S), jnp.int32),
-            "learning": jnp.zeros((nb, S), jnp.int32),
-            "forward": jnp.zeros((nb, S), jnp.int32),
+            k: jnp.zeros((nb, *shape), dt)
+            for k, (shape, dt) in store_field_specs(cfg).items()
         }
 
         # donated slot write: XLA updates the big arrays in place

@@ -1,0 +1,253 @@
+"""Multi-host dp-sharded replay: per-host local stores, one global program.
+
+Extends the single-host sharded plane (replay/sharded_store.py) across
+processes, replacing the reference's nothing (it is single-host by
+construction, SURVEY.md section 5.8) with the standard JAX multi-host
+architecture — per-host ASYNC data planes + one SYNCHRONOUS SPMD learner:
+
+- each host owns the control planes (sum trees, pointers, episode stats)
+  and HBM stores for the dp shards whose devices it hosts
+  (parallel/multihost.local_axis_indices); its collectors write blocks
+  round-robin into those LOCAL shards only. No replay bytes ever cross
+  hosts.
+- the train step is the SAME shard_map step as single-host
+  (learner.make_sharded_fused_train_step over the global mesh). Every
+  process calls it in lockstep — standard SPMD — passing global array
+  VIEWS assembled zero-copy from the per-host buffers with
+  jax.make_array_from_single_device_arrays. Gradient psum rides ICI
+  within a host and DCN between hosts, inserted by XLA.
+- sampled coordinates are drawn host-locally per shard and assembled the
+  same way; priorities come back (dp, B/dp) dp-sharded, and each host
+  applies only its addressable rows to its own trees under each shard's
+  own staleness window.
+
+Sampling gates host-locally (every shard needs learning_starts/dp
+transitions) so no control-plane traffic crosses hosts either; hosts stay
+in lockstep purely through the collective train step, exactly like any
+SPMD data-parallel trainer.
+
+Current scope: tp=1 (tensor parallelism composes with multi-host at the
+mesh level but splits a shard's store across devices; single-host tp>1 is
+covered by ShardedDeviceReplay). Cross-host IS-weight normalization uses
+each host's local batch minimum rather than a global collective — the
+weights differ from the single-tree values by a per-host constant factor
+bounded by the priority spread; with learning-rate-scale semantics this is
+the standard approximation distributed PER implementations make.
+
+Verified end to end by tests/test_multihost.py: a REAL 2-process CPU run
+(jax.distributed) trains steps whose loss matches the single-process
+4-device ShardedDeviceReplay run on identical blocks and draws.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.replay.block import Block, store_field_specs
+from r2d2_tpu.replay.control_plane import ReplayControlPlane, shard_config
+from r2d2_tpu.replay.device_store import DeviceReplayBuffer
+from r2d2_tpu.parallel.multihost import local_axis_indices
+
+
+class MultiHostShardedReplay:
+    def __init__(self, cfg: R2D2Config, mesh: Mesh, seed: int = 0):
+        if mesh.shape.get("tp", 1) != 1:
+            raise ValueError("MultiHostShardedReplay supports tp=1 meshes")
+        dp = mesh.shape["dp"]
+        if cfg.num_blocks % dp != 0 or cfg.batch_size % dp != 0:
+            raise ValueError("num_blocks and batch_size must divide over dp")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.dp = dp
+        self.blocks_per_shard = cfg.num_blocks // dp
+        self.local_ids: List[int] = local_axis_indices(mesh, "dp")
+        if not self.local_ids:
+            raise ValueError("this process owns no dp shards")
+        self.shard_cfg = shard_config(cfg, dp)
+        self.shards: Dict[int, ReplayControlPlane] = {
+            g: ReplayControlPlane(self.shard_cfg) for g in self.local_ids
+        }
+        axis = list(mesh.axis_names).index("dp")
+        self._shard_device = {
+            g: np.take(mesh.devices, g, axis=axis).ravel()[0] for g in self.local_ids
+        }
+
+        specs = store_field_specs(cfg)
+        nbs = self.blocks_per_shard
+        self._global_field_shape = {
+            k: (cfg.num_blocks, *shape) for k, (shape, _) in specs.items()
+        }
+        # per-local-shard single-device stores
+        self.stores: Dict[int, Dict[str, jnp.ndarray]] = {
+            g: {
+                k: jax.device_put(np.zeros((nbs, *shape), dt), self._shard_device[g])
+                for k, (shape, dt) in specs.items()
+            }
+            for g in self.local_ids
+        }
+
+        def _write(stores, ptr, vals):
+            return {
+                k: jax.lax.dynamic_update_index_in_dim(arr, vals[k], ptr, axis=0)
+                for k, arr in stores.items()
+            }
+
+        self._write = jax.jit(_write, donate_argnums=(0,))
+        self._rr = 0  # round-robin over LOCAL shards
+        self._seed = seed
+        self._epoch = 0  # sample_global counter (part of the draw seeds)
+        # store-level lock: add_block's donated write swaps stores[g], so a
+        # concurrent run_step must not be assembling/dispatching over the
+        # old buffers (same contract as run_with_stores on the other device
+        # planes). Lock order is ALWAYS self.lock -> shard.lock.
+        self.lock = threading.Lock()
+
+    # ---------------------------------------------------------------- state
+
+    def __len__(self) -> int:
+        """Transitions stored on THIS host (local shards only)."""
+        return sum(len(s) for s in self.shards.values())
+
+    @property
+    def env_steps(self) -> int:
+        return sum(s.env_steps for s in self.shards.values())
+
+    def can_sample(self) -> bool:
+        """Host-local gate: every local shard can serve its sub-batch.
+        With symmetric collection across hosts this opens within one block
+        of the global gate, with zero cross-host control traffic."""
+        return all(
+            len(s) >= self.shard_cfg.learning_starts and s.tree.total > 0
+            for s in self.shards.values()
+        )
+
+    def pop_episode_stats(self):
+        n = r = 0
+        for sh in self.shards.values():
+            ni, ri = sh.pop_episode_stats()
+            n += ni
+            r += ri
+        return n, r
+
+    def episode_totals(self):
+        n = r = 0
+        for sh in self.shards.values():
+            ni, ri = sh.episode_totals()
+            n += ni
+            r += ri
+        return n, r
+
+    # ------------------------------------------------------------------ add
+
+    def add_block(
+        self, block: Block, priorities: np.ndarray, episode_reward: Optional[float]
+    ) -> None:
+        """Write one block into the next LOCAL shard (host-local op; other
+        hosts add to their own shards independently)."""
+        vals = DeviceReplayBuffer.pad_block_fields(self.cfg, block)
+        with self.lock:
+            g = self.local_ids[self._rr]
+            shard = self.shards[g]
+            with shard.lock:
+                self.stores[g] = self._write(self.stores[g], shard.block_ptr, vals)
+                shard._account_add(
+                    block.num_sequences, int(block.learning_steps.sum()),
+                    priorities, episode_reward,
+                )
+            self._rr = (self._rr + 1) % len(self.local_ids)
+
+    # --------------------------------------------------------------- global
+
+    def _assemble(self, per_shard: Dict[int, jnp.ndarray], global_shape, spec: P):
+        """Zero-copy global view over per-host single-device buffers."""
+        sharding = NamedSharding(self.mesh, spec)
+        return jax.make_array_from_single_device_arrays(
+            tuple(global_shape), sharding, [per_shard[g] for g in self.local_ids]
+        )
+
+    def global_stores(self) -> Dict[str, jnp.ndarray]:
+        return {
+            k: self._assemble(
+                {g: self.stores[g][k] for g in self.local_ids},
+                self._global_field_shape[k],
+                P("dp"),
+            )
+            for k in self._global_field_shape
+        }
+
+    def sample_global(self):
+        """Draw B/dp sequences per LOCAL shard and assemble the global
+        (dp, B/dp) coordinate arrays for the shard_map step.
+
+        Each shard's draw stream is seeded by (seed, GLOBAL shard id,
+        epoch) — host-layout-independent, so the same seeds produce the
+        same global sample whether the shards live on one process or many
+        (pinned by the 2-process test).
+
+        Returns (b, s, w) global arrays plus host-side (idxes_by_shard,
+        old_ptrs_by_shard) for the priority round trip."""
+        Bs = self.cfg.batch_size // self.dp
+        epoch = self._epoch
+        self._epoch += 1
+        idxes_by_shard: Dict[int, np.ndarray] = {}
+        old_ptrs: Dict[int, int] = {}
+        per_b, per_s, per_w = {}, {}, {}
+        for g in self.local_ids:
+            rng = np.random.default_rng((self._seed, g, epoch))
+            shard = self.shards[g]
+            with shard.lock:
+                b, s, idxes, _w = shard._draw(rng)
+                old_ptrs[g] = shard.block_ptr
+                p = shard.tree.priorities_of(idxes)
+            # per-host IS normalization (see module docstring)
+            positive = p[p > 0.0]
+            min_p = positive.min() if positive.size else 1.0
+            w = np.power(np.maximum(p, min_p) / min_p, -self.cfg.is_exponent)
+            dev = self._shard_device[g]
+            per_b[g] = jax.device_put(b.astype(np.int32)[None], dev)
+            per_s[g] = jax.device_put(s.astype(np.int32)[None], dev)
+            per_w[g] = jax.device_put(w.astype(np.float32)[None], dev)
+            idxes_by_shard[g] = idxes
+        shape = (self.dp, Bs)
+        return (
+            self._assemble(per_b, shape, P("dp")),
+            self._assemble(per_s, shape, P("dp")),
+            self._assemble(per_w, shape, P("dp")),
+            idxes_by_shard,
+            old_ptrs,
+        )
+
+    def update_priorities(
+        self, idxes_by_shard: Dict[int, np.ndarray], priorities, old_ptrs: Dict[int, int]
+    ) -> None:
+        """Apply the step's (dp, B/dp) dp-sharded priorities: each host
+        reads only its addressable rows."""
+        dev_to_g = {d: g for g, d in self._shard_device.items()}
+        for shard_piece in priorities.addressable_shards:
+            g = dev_to_g[shard_piece.device]
+            row = np.asarray(shard_piece.data)[0]
+            self.shards[g].update_priorities(idxes_by_shard[g], row, old_ptrs[g])
+
+    # ------------------------------------------------------------- dispatch
+
+    def run_step(self, step_fn: Callable, state):
+        """One collective training step: sample locally, assemble global
+        views, run the shard_map step (EVERY process must call this in the
+        same order — standard SPMD), apply local priorities.
+
+        step_fn: learner.make_sharded_fused_train_step(cfg, net, mesh)."""
+        with self.lock:
+            # sample + assemble + dispatch under the store lock: a
+            # concurrent add_block's donated swap must not invalidate the
+            # buffers behind the global views mid-dispatch
+            b, s, w, idxes_by_shard, old_ptrs = self.sample_global()
+            new_state, metrics, priorities = step_fn(state, self.global_stores(), b, s, w)
+        self.update_priorities(idxes_by_shard, priorities, old_ptrs)
+        return new_state, metrics

@@ -42,8 +42,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from r2d2_tpu.config import R2D2Config
-from r2d2_tpu.replay.block import Block
-from r2d2_tpu.replay.control_plane import ReplayControlPlane
+from r2d2_tpu.replay.block import Block, store_field_specs
+from r2d2_tpu.replay.control_plane import ReplayControlPlane, shard_config
 from r2d2_tpu.replay.device_store import DeviceReplayBuffer
 
 
@@ -72,31 +72,15 @@ class ShardedDeviceReplay:
         self.blocks_per_shard = cfg.num_blocks // dp
         # per-shard view: 1/dp of capacity and batch; the shard config is
         # single-plane (its own control plane knows nothing of the mesh)
-        shard_cfg = cfg.replace(
-            buffer_capacity=cfg.buffer_capacity // dp,
-            learning_starts=max(cfg.learning_starts // dp, 1),
-            batch_size=cfg.batch_size // dp,
-            dp_size=1,
-            tp_size=1,
-            replay_plane="host",
-        )
+        shard_cfg = shard_config(cfg, dp)
         self.shards = [ReplayControlPlane(shard_cfg) for _ in range(dp)]
         self._rr = 0  # round-robin write cursor over shards
 
-        S = cfg.seqs_per_block
-        nb, slot, bl = cfg.num_blocks, cfg.block_slot_len, cfg.block_length
+        nb = cfg.num_blocks
         shd = NamedSharding(mesh, P("dp"))
         self.stores: Dict[str, jnp.ndarray] = {
-            "obs": jnp.zeros((nb, slot, *cfg.obs_shape), jnp.uint8, device=shd),
-            "last_action": jnp.zeros((nb, slot), jnp.int32, device=shd),
-            "last_reward": jnp.zeros((nb, slot), jnp.float32, device=shd),
-            "action": jnp.zeros((nb, bl), jnp.int32, device=shd),
-            "n_step_reward": jnp.zeros((nb, bl), jnp.float32, device=shd),
-            "gamma": jnp.zeros((nb, bl), jnp.float32, device=shd),
-            "hidden": jnp.zeros((nb, S, 2, cfg.hidden_dim), jnp.float32, device=shd),
-            "burn_in": jnp.zeros((nb, S), jnp.int32, device=shd),
-            "learning": jnp.zeros((nb, S), jnp.int32, device=shd),
-            "forward": jnp.zeros((nb, S), jnp.int32, device=shd),
+            k: jnp.zeros((nb, *shape), dt, device=shd)
+            for k, (shape, dt) in store_field_specs(cfg).items()
         }
 
         def _write(stores, ptr, vals):

@@ -1,0 +1,74 @@
+"""Shared driver for the multi-host replay tests.
+
+`build_and_run(mesh)` fills a MultiHostShardedReplay with per-shard
+deterministic blocks and runs 3 collective train steps — called BOTH by the
+in-process single-host reference (4 fake devices, all shards local) and by
+the real 2-process children this file spawns as `python multihost_child.py
+<pid> <nprocs> <port>`. Identical per-shard content + layout-independent
+draw seeds mean the two topologies must produce the same losses.
+"""
+
+import json
+import sys
+
+
+def build_and_run(mesh):
+    import jax
+    import numpy as np
+
+    from bench import synth_block
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.learner import init_train_state, make_sharded_fused_train_step
+    from r2d2_tpu.parallel.mesh import replicated_sharding
+    from r2d2_tpu.replay.multihost_store import MultiHostShardedReplay
+
+    cfg = tiny_test().replace(batch_size=8)
+    replay = MultiHostShardedReplay(cfg, mesh, seed=5)
+    # per-GLOBAL-shard content streams: the same blocks land in the same
+    # shards regardless of how shards are spread over processes
+    rngs = {g: np.random.default_rng(100 + g) for g in replay.local_ids}
+    for _ in range(2):
+        for g in replay.local_ids:
+            block = synth_block(cfg, rngs[g])
+            prios = np.full(cfg.seqs_per_block, 1.0, np.float32)  # equal ->
+            replay.add_block(block, prios, None)  # IS weights exactly 1.0
+    assert replay.can_sample()
+
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    state = jax.device_put(state, replicated_sharding(mesh))
+    step_fn = make_sharded_fused_train_step(cfg, net, mesh, donate=False)
+    losses = []
+    for _ in range(3):
+        state, metrics = replay.run_step(step_fn, state)
+        losses.append(float(metrics["loss"]))
+    checksum = float(
+        sum(np.abs(np.asarray(x)).sum() for x in jax.tree.leaves(state.params))
+    )
+    return losses, checksum
+
+
+def main():
+    import os
+
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo_root)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(f"localhost:{port}", nprocs, pid)
+    assert jax.process_count() == nprocs, jax.process_count()
+
+    from r2d2_tpu.parallel.multihost import make_global_mesh
+
+    mesh = make_global_mesh(tp=1)
+    losses, checksum = build_and_run(mesh)
+    print(
+        "CHILD_RESULT "
+        + json.dumps({"pid": pid, "losses": losses, "checksum": checksum}),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+"""Multi-host helpers (parallel/multihost.py) on the 8-fake-device CPU
+platform: single-process no-op init, global mesh construction, and
+local-shard enumeration (all shards local when there is one process)."""
+
+import jax
+import numpy as np
+import pytest
+
+from r2d2_tpu.parallel.multihost import (
+    initialize_distributed,
+    local_axis_indices,
+    make_global_mesh,
+)
+
+
+def test_initialize_noop_single_process(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert initialize_distributed() is False
+
+
+def test_global_mesh_defaults():
+    mesh = make_global_mesh(tp=2)
+    assert mesh.shape["tp"] == 2
+    assert mesh.shape["dp"] == len(jax.devices()) // 2
+    with pytest.raises(ValueError):
+        make_global_mesh(tp=3)  # 8 % 3 != 0
+
+
+def test_local_axis_indices_all_local():
+    mesh = make_global_mesh(dp=4, tp=2)
+    assert local_axis_indices(mesh, "dp") == [0, 1, 2, 3]
+    assert local_axis_indices(mesh, "tp") == [0, 1]
+
+
+def test_local_axis_indices_detects_foreign_and_split_shards():
+    class FakeDev:
+        def __init__(self, pid):
+            self.process_index = pid
+
+    mesh = make_global_mesh(dp=4, tp=2)
+
+    # simulate 2 hosts owning dp halves: indices 0,1 local to process 0
+    fake = np.array(
+        [[FakeDev(i // 2)] * 2 for i in range(4)], dtype=object
+    )
+
+    class FakeMesh:
+        devices = fake
+        axis_names = ("dp", "tp")
+
+    assert local_axis_indices(FakeMesh(), "dp") == [0, 1]
+
+    # a dp shard split across hosts must raise
+    split = np.array(
+        [[FakeDev(0), FakeDev(1)]] + [[FakeDev(1)] * 2] * 3, dtype=object
+    )
+
+    class SplitMesh:
+        devices = split
+        axis_names = ("dp", "tp")
+
+    with pytest.raises(ValueError):
+        local_axis_indices(SplitMesh(), "dp")
+
+
+def test_multihost_store_single_process():
+    """MultiHostShardedReplay on a 4-device single-process mesh: fills,
+    samples, trains, and applies priorities."""
+    from multihost_child import build_and_run
+    from r2d2_tpu.parallel.multihost import make_global_mesh
+
+    mesh = make_global_mesh(dp=4, tp=1, devices=jax.devices()[:4])
+    losses, checksum = build_and_run(mesh)
+    assert len(losses) == 3 and all(np.isfinite(l) for l in losses)
+    assert np.isfinite(checksum)
+
+
+def test_two_process_run_matches_single_process():
+    """REAL multi-host: 2 jax.distributed processes (2 CPU devices each)
+    train the same blocks/draws as the single-process 4-device run and
+    must produce the same losses — the whole multi-host stack (local
+    stores, global array assembly, cross-process psum) end to end."""
+    import json
+    import os
+    import subprocess
+    import sys as _sys
+
+    from multihost_child import build_and_run
+    from r2d2_tpu.parallel.multihost import make_global_mesh
+
+    mesh = make_global_mesh(dp=4, tp=1, devices=jax.devices()[:4])
+    ref_losses, ref_checksum = build_and_run(mesh)
+
+    port = 12700 + os.getpid() % 250
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = repo_root + ":" + env.get("PYTHONPATH", "")
+    script = os.path.join(os.path.dirname(__file__), "multihost_child.py")
+    procs = [
+        subprocess.Popen(
+            [_sys.executable, script, str(pid), "2", str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in range(2)
+    ]
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"child failed:\n{out}\n{err[-2000:]}"
+        for line in out.splitlines():
+            if line.startswith("CHILD_RESULT "):
+                r = json.loads(line[len("CHILD_RESULT "):])
+                results[r["pid"]] = r
+    assert set(results) == {0, 1}
+    for r in results.values():
+        np.testing.assert_allclose(r["losses"], ref_losses, atol=1e-4)
+        np.testing.assert_allclose(r["checksum"], ref_checksum, rtol=1e-5)
