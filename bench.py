@@ -125,6 +125,7 @@ def system_main():
     upd = trainer._step - steps0
     stop.set()
     at.join(timeout=10.0)
+    trainer.finish_updates()  # apply the final in-flight priority chunk
     learner_fps = upd / elapsed * cfg.batch_size * cfg.learning_steps * 4
     collect_fps = env / elapsed * 4
     print(

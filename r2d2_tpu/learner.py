@@ -331,7 +331,13 @@ def make_sharded_gather_step(cfg: R2D2Config, mesh):
     return jax.jit(gathered)
 
 
-def make_sharded_fused_train_step(cfg: R2D2Config, net: R2D2Network, mesh, donate: bool = True):
+def make_sharded_fused_train_step(
+    cfg: R2D2Config,
+    net: R2D2Network,
+    mesh,
+    donate: bool = True,
+    is_from_priorities: bool = False,
+):
     """Fused train step over a dp-SHARDED device replay store
     (replay/sharded_store.ShardedDeviceReplay).
 
@@ -344,7 +350,15 @@ def make_sharded_fused_train_step(cfg: R2D2Config, net: R2D2Network, mesh, donat
     Signature: (state, stores, b, s, is_weights) -> (state, metrics,
     priorities) where b/s/is_weights are (dp, B/dp) stacked per-shard
     coordinates with b LOCAL to each shard, and priorities come back
-    (dp, B/dp)."""
+    (dp, B/dp).
+
+    is_from_priorities=True: the third coordinate array carries RAW sampled
+    tree priorities instead of precomputed IS weights; the step normalizes
+    them in-jit against the BATCH-GLOBAL minimum via a pmin collective over
+    dp. This is how the multi-host replay gets exact single-tree IS
+    semantics with zero cross-host control traffic (replay/
+    multihost_store.py) — each host only knows its local priorities, the
+    collective finds the global min."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
@@ -354,7 +368,16 @@ def make_sharded_fused_train_step(cfg: R2D2Config, net: R2D2Network, mesh, donat
     def body(state: TrainState, stores, b, s, is_weights):
         # local views: stores = this device's (nb/dp, ...) block shard;
         # b/s/is_weights arrive (1, B/dp) from their stacked (dp, B/dp) form
-        batch = gather_batch(stores, b[0], s[0], is_weights[0])
+        w = is_weights[0]
+        if is_from_priorities:
+            p = w
+            pos_min = jnp.min(jnp.where(p > 0, p, jnp.inf))
+            min_p = jax.lax.pmin(pos_min, "dp")
+            min_p = jnp.where(jnp.isfinite(min_p), min_p, 1.0)
+            # same formula as SumTree.sample (zero-priority leaves clamp
+            # to the min -> weight 1.0)
+            w = jnp.power(jnp.maximum(p, min_p) / min_p, -cfg.is_exponent)
+        batch = gather_batch(stores, b[0], s[0], w)
         new_state, metrics, priorities = raw(state, batch)
         return new_state, metrics, priorities[None, :]
 

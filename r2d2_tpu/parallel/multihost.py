@@ -80,12 +80,9 @@ def make_global_mesh(
     to one host's devices first, keeping each host's replay shards on its
     own chips (ICI-local gathers, DCN only for the gradient psum legs that
     cross hosts)."""
-    devices = list(devices) if devices is not None else jax.devices()
-    if dp is None:
-        if len(devices) % tp != 0:
-            raise ValueError(f"{len(devices)} devices not divisible by tp={tp}")
-        dp = len(devices) // tp
-    return make_mesh(dp=dp, tp=tp, devices=devices)
+    # make_mesh already defaults dp to len(devices)//tp and validates the
+    # factorization; this wrapper only supplies the GLOBAL device list
+    return make_mesh(dp=dp, tp=tp, devices=devices if devices is not None else jax.devices())
 
 
 def local_axis_indices(mesh: Mesh, axis: str = "dp") -> List[int]:

@@ -28,15 +28,16 @@ SPMD data-parallel trainer.
 
 Current scope: tp=1 (tensor parallelism composes with multi-host at the
 mesh level but splits a shard's store across devices; single-host tp>1 is
-covered by ShardedDeviceReplay). Cross-host IS-weight normalization uses
-each host's local batch minimum rather than a global collective — the
-weights differ from the single-tree values by a per-host constant factor
-bounded by the priority spread; with learning-rate-scale semantics this is
-the standard approximation distributed PER implementations make.
+covered by ShardedDeviceReplay). IS-weight normalization is EXACT
+single-tree semantics: hosts ship raw sampled priorities and the train
+step finds the batch-global minimum with a pmin collective over dp
+(learner.make_sharded_fused_train_step(is_from_priorities=True)) — the
+device mesh does the one piece of global coordination the weights need.
 
 Verified end to end by tests/test_multihost.py: a REAL 2-process CPU run
-(jax.distributed) trains steps whose loss matches the single-process
-4-device ShardedDeviceReplay run on identical blocks and draws.
+(jax.distributed) trains 3 steps whose losses match the single-process
+4-device run of this plane exactly, and the assembled data plane matches
+ShardedDeviceReplay loss-for-loss on identical contents and coordinates.
 """
 
 from __future__ import annotations
@@ -191,13 +192,15 @@ class MultiHostShardedReplay:
         same global sample whether the shards live on one process or many
         (pinned by the 2-process test).
 
-        Returns (b, s, w) global arrays plus host-side (idxes_by_shard,
-        old_ptrs_by_shard) for the priority round trip."""
+        Returns (b, s, raw_priorities) global arrays plus host-side
+        (idxes_by_shard, old_ptrs_by_shard) for the priority round trip.
+        The third array feeds a step built with is_from_priorities=True."""
         Bs = self.cfg.batch_size // self.dp
         epoch = self._epoch
         self._epoch += 1
         idxes_by_shard: Dict[int, np.ndarray] = {}
         old_ptrs: Dict[int, int] = {}
+        prios: Dict[int, np.ndarray] = {}
         per_b, per_s, per_w = {}, {}, {}
         for g in self.local_ids:
             rng = np.random.default_rng((self._seed, g, epoch))
@@ -205,16 +208,20 @@ class MultiHostShardedReplay:
             with shard.lock:
                 b, s, idxes, _w = shard._draw(rng)
                 old_ptrs[g] = shard.block_ptr
-                p = shard.tree.priorities_of(idxes)
-            # per-host IS normalization (see module docstring)
-            positive = p[p > 0.0]
-            min_p = positive.min() if positive.size else 1.0
-            w = np.power(np.maximum(p, min_p) / min_p, -self.cfg.is_exponent)
+                prios[g] = shard.tree.priorities_of(idxes)
             dev = self._shard_device[g]
             per_b[g] = jax.device_put(b.astype(np.int32)[None], dev)
             per_s[g] = jax.device_put(s.astype(np.int32)[None], dev)
-            per_w[g] = jax.device_put(w.astype(np.float32)[None], dev)
             idxes_by_shard[g] = idxes
+        # ship RAW priorities: IS weights are computed IN the train step
+        # against the batch-global minimum via a pmin collective over dp
+        # (learner.make_sharded_fused_train_step(is_from_priorities=True)).
+        # Exact single-tree semantics, layout-independent, and no
+        # cross-host control traffic.
+        for g in self.local_ids:
+            per_w[g] = jax.device_put(
+                prios[g].astype(np.float32)[None], self._shard_device[g]
+            )
         shape = (self.dp, Bs)
         return (
             self._assemble(per_b, shape, P("dp")),
@@ -242,7 +249,9 @@ class MultiHostShardedReplay:
         views, run the shard_map step (EVERY process must call this in the
         same order — standard SPMD), apply local priorities.
 
-        step_fn: learner.make_sharded_fused_train_step(cfg, net, mesh)."""
+        step_fn: learner.make_sharded_fused_train_step(cfg, net, mesh,
+        is_from_priorities=True) — the step computes IS weights from the
+        raw priorities with a global pmin."""
         with self.lock:
             # sample + assemble + dispatch under the store lock: a
             # concurrent add_block's donated swap must not invalidate the

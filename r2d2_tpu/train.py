@@ -127,6 +127,7 @@ class _DevicePlane:
         self.tr = tr
         self.replay = DeviceReplayBuffer(tr.cfg)
         self.K = self.steps_per_update = tr.cfg.updates_per_dispatch
+        self._pending = None  # deferred (priorities, draws) readback
         if self.K > 1:
             from r2d2_tpu.learner import make_fused_multi_train_step
 
@@ -152,7 +153,15 @@ class _DevicePlane:
     def _multi_update(self, state):
         """K updates in one dispatch: draw + dispatch under one lock hold
         (DeviceReplayBuffer.sample_and_run), then apply the (K, B)
-        priorities row-by-row under each draw's own staleness window."""
+        priorities row-by-row under each draw's own staleness window.
+
+        The priority readback is DEFERRED one dispatch: reading this
+        chunk's priorities immediately would stall the host for the chunk's
+        execution plus a full device->host round trip; instead the transfer
+        is started async and collected while the NEXT chunk executes. Tree
+        priorities lag one extra chunk (bounded, same class as the
+        reference's ~12-batch pipeline lag); the pointer-window mask still
+        rejects rows whose slots were overwritten meanwhile."""
 
         def dispatch(stores, draws):
             b = jnp.asarray(np.stack([d.b for d in draws]))
@@ -163,9 +172,26 @@ class _DevicePlane:
         draws, (new_state, m, priorities) = self.replay.sample_and_run(
             self.tr.sample_rng, self.K, dispatch
         )
-        for row, d in zip(np.asarray(priorities), draws):
-            self.replay.update_priorities(d.idxes, row, d.old_ptr)
+        try:
+            priorities.copy_to_host_async()
+        except AttributeError:
+            pass
+        prev, self._pending = self._pending, (priorities, draws)
+        if prev is not None:
+            self.drain_pending(prev)
         return new_state, m
+
+    def drain_pending(self, pending=None) -> None:
+        """Apply a deferred (priorities, draws) pair to the tree. Called
+        with the previous chunk's pair each update, and once with the final
+        in-flight pair when a run mode exits."""
+        if pending is None:
+            pending, self._pending = self._pending, None
+        if pending is None:
+            return
+        prios, draws = pending
+        for row, d in zip(np.asarray(prios), draws):
+            self.replay.update_priorities(d.idxes, row, d.old_ptr)
 
     def update(self, state, item):
         kind, payload, idxes, old_ptr = item
@@ -356,6 +382,15 @@ class Trainer:
             )
         return m, step
 
+    def finish_updates(self) -> None:
+        """Flush any deferred per-plane work (e.g. the K>1 device plane's
+        in-flight priority readback). Every update-driving loop — the run
+        modes here and external drivers like bench.py — calls this once
+        when it stops updating."""
+        drain = getattr(self.plane, "drain_pending", None)
+        if drain is not None:
+            drain()
+
     def _replay_snapshot_path(self) -> str:
         return os.path.join(self.cfg.checkpoint_dir, "replay_snapshot.npz")
 
@@ -434,6 +469,7 @@ class Trainer:
                 self._log(m, step)
         finally:
             self._stop_profile()
+            self.finish_updates()
             if cfg.snapshot_replay:
                 self._snapshot_on_exit()
 
@@ -497,6 +533,7 @@ class Trainer:
         finally:
             self._stop_profile()
             sup.shutdown()
+            self.finish_updates()
             if cfg.snapshot_replay:
                 self._snapshot_on_exit()
 

@@ -36,7 +36,9 @@ def build_and_run(mesh):
 
     net, state = init_train_state(cfg, jax.random.PRNGKey(0))
     state = jax.device_put(state, replicated_sharding(mesh))
-    step_fn = make_sharded_fused_train_step(cfg, net, mesh, donate=False)
+    step_fn = make_sharded_fused_train_step(
+        cfg, net, mesh, donate=False, is_from_priorities=True
+    )
     losses = []
     for _ in range(3):
         state, metrics = replay.run_step(step_fn, state)

@@ -92,7 +92,11 @@ def test_two_process_run_matches_single_process():
     mesh = make_global_mesh(dp=4, tp=1, devices=jax.devices()[:4])
     ref_losses, ref_checksum = build_and_run(mesh)
 
-    port = 12700 + os.getpid() % 250
+    import socket
+
+    with socket.socket() as sock:  # OS-assigned free port, no collisions
+        sock.bind(("localhost", 0))
+        port = sock.getsockname()[1]
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -117,3 +121,57 @@ def test_two_process_run_matches_single_process():
     for r in results.values():
         np.testing.assert_allclose(r["losses"], ref_losses, atol=1e-4)
         np.testing.assert_allclose(r["checksum"], ref_checksum, rtol=1e-5)
+
+
+def test_multihost_data_plane_matches_sharded_store():
+    """Cross-plane equivalence: identical block contents and the SAME
+    sample coordinates through MultiHostShardedReplay's assembled global
+    views and through ShardedDeviceReplay's native global stores must give
+    the same loss from the same shard_map step."""
+    from bench import synth_block
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.learner import init_train_state, make_sharded_fused_train_step
+    from r2d2_tpu.parallel.mesh import replicated_sharding
+    from r2d2_tpu.parallel.multihost import make_global_mesh
+    from r2d2_tpu.replay.multihost_store import MultiHostShardedReplay
+    from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
+
+    import jax.numpy as jnp
+
+    mesh = make_global_mesh(dp=4, tp=1, devices=jax.devices()[:4])
+    cfg = tiny_test().replace(batch_size=8)
+    mh = MultiHostShardedReplay(cfg, mesh, seed=9)
+    sh = ShardedDeviceReplay(cfg.replace(dp_size=4, replay_plane="sharded"), mesh)
+
+    # identical fill: both planes round-robin blocks over shards 0..3
+    rngs = {g: np.random.default_rng(300 + g) for g in range(4)}
+    for _ in range(2):
+        for g in range(4):
+            block = synth_block(cfg, rngs[g])
+            prios = np.asarray([1.0 + 0.5 * g + 0.1 * i for i in range(cfg.seqs_per_block)], np.float32)
+            mh.add_block(block, prios, None)
+            sh.add_block(block, prios, None)
+
+    b, s, raw_p, idxes_by_shard, old_ptrs = mh.sample_global()
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    state = jax.device_put(state, replicated_sharding(mesh))
+    flagged = make_sharded_fused_train_step(
+        cfg, net, mesh, donate=False, is_from_priorities=True
+    )
+    plain = make_sharded_fused_train_step(cfg, net, mesh, donate=False)
+
+    # multihost path: assembled global views + in-step IS normalization
+    _, m_mh, p_mh = flagged(state, mh.global_stores(), b, s, raw_p)
+
+    # sharded path: native stores + HOST-computed weights (SumTree.sample
+    # formula) from the SAME raw priorities — both stores and the in-step
+    # pmin normalization must agree with the single-tree semantics
+    p_np = np.asarray(raw_p).astype(np.float64)
+    positive = p_np[p_np > 0.0]
+    min_p = positive.min() if positive.size else 1.0
+    w_host = np.power(np.maximum(p_np, min_p) / min_p, -cfg.is_exponent).astype(np.float32)
+    coords = (jnp.asarray(np.asarray(b)), jnp.asarray(np.asarray(s)), jnp.asarray(w_host))
+    _, m_sh, p_sh = sh.run_with_stores(lambda stores: plain(state, stores, *coords))
+
+    np.testing.assert_allclose(float(m_mh["loss"]), float(m_sh["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p_mh), np.asarray(p_sh), atol=1e-5)
