@@ -97,6 +97,31 @@ def evaluate_series(cfg: R2D2Config, vec_env, out_path: Optional[str] = None, se
     return rows
 
 
+def plot_series(rows, out_path: str) -> str:
+    """Reference test.py:42-58 parity: the two learning-curve panels —
+    mean reward vs env frames and vs wall-clock hours — saved as one
+    image (format from the extension; reference used .jpg)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(11, 4))
+    frames = [r["env_frames"] for r in rows]
+    hours = [r["hours"] for r in rows]
+    reward = [r["mean_reward"] for r in rows]
+    ax1.plot(frames, reward, marker="o")
+    ax1.set_xlabel("environment frames")
+    ax1.set_ylabel("mean episode reward")
+    ax2.plot(hours, reward, marker="o")
+    ax2.set_xlabel("training time (hours)")
+    ax2.set_ylabel("mean episode reward")
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path
+
+
 def main(argv=None):
     from r2d2_tpu.train import build_vec_env
 
@@ -104,13 +129,18 @@ def main(argv=None):
     p.add_argument("--preset", default="atari", choices=sorted(PRESETS))
     p.add_argument("--env", default=None)
     p.add_argument("--out", default=None)
+    p.add_argument("--plot", default=None,
+                   help="save the two-panel learning curve (reward vs "
+                        "frames / vs hours) to this image path")
     args = p.parse_args(argv)
     cfg = PRESETS[args.preset]()
     if args.env:
         cfg = cfg.replace(env_name=args.env)
     vec_env = build_vec_env(cfg, seed=123)
     cfg = cfg.replace(action_dim=vec_env.action_dim)
-    evaluate_series(cfg, vec_env, out_path=args.out)
+    rows = evaluate_series(cfg, vec_env, out_path=args.out)
+    if args.plot and rows:
+        plot_series(rows, args.plot)
 
 
 if __name__ == "__main__":

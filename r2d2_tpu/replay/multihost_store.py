@@ -200,7 +200,6 @@ class MultiHostShardedReplay:
         self._epoch += 1
         idxes_by_shard: Dict[int, np.ndarray] = {}
         old_ptrs: Dict[int, int] = {}
-        prios: Dict[int, np.ndarray] = {}
         per_b, per_s, per_w = {}, {}, {}
         for g in self.local_ids:
             rng = np.random.default_rng((self._seed, g, epoch))
@@ -208,20 +207,17 @@ class MultiHostShardedReplay:
             with shard.lock:
                 b, s, idxes, _w = shard._draw(rng)
                 old_ptrs[g] = shard.block_ptr
-                prios[g] = shard.tree.priorities_of(idxes)
+                p = shard.tree.priorities_of(idxes)
             dev = self._shard_device[g]
             per_b[g] = jax.device_put(b.astype(np.int32)[None], dev)
             per_s[g] = jax.device_put(s.astype(np.int32)[None], dev)
+            # ship RAW priorities: IS weights are computed IN the train
+            # step against the batch-global minimum via a pmin collective
+            # over dp (make_sharded_fused_train_step(is_from_priorities=
+            # True)) — exact single-tree semantics, layout-independent,
+            # no cross-host control traffic
+            per_w[g] = jax.device_put(p.astype(np.float32)[None], dev)
             idxes_by_shard[g] = idxes
-        # ship RAW priorities: IS weights are computed IN the train step
-        # against the batch-global minimum via a pmin collective over dp
-        # (learner.make_sharded_fused_train_step(is_from_priorities=True)).
-        # Exact single-tree semantics, layout-independent, and no
-        # cross-host control traffic.
-        for g in self.local_ids:
-            per_w[g] = jax.device_put(
-                prios[g].astype(np.float32)[None], self._shard_device[g]
-            )
         shape = (self.dp, Bs)
         return (
             self._assemble(per_b, shape, P("dp")),

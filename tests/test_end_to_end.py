@@ -137,3 +137,14 @@ def test_multi_update_dispatch_training(tmp_path, mode):
     assert int(trainer.state.step) == 12
     # save_interval crossings 5 and 10 both produced checkpoints
     assert len(list_checkpoint_steps(cfg.checkpoint_dir)) == 2
+
+
+def test_evaluate_plot(trained, tmp_path):
+    from r2d2_tpu.evaluate import plot_series
+
+    vec = CatchVecEnv(num_envs=2, height=12, width=12, seed=9)
+    rows = evaluate_series(trained.cfg, vec)
+    out = plot_series(rows, str(tmp_path / "curve.jpg"))
+    import os
+
+    assert os.path.getsize(out) > 1000
