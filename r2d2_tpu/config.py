@@ -116,7 +116,9 @@ class R2D2Config:
     use_native_replay: bool = True  # C++ replay core if built, else numpy
     # replay data plane: "host" (numpy store, batches shipped per update),
     # "device" (HBM store + fused in-jit gather, single chip), "sharded"
-    # (HBM store sharded over the dp mesh axis + shard_map train step)
+    # (HBM store sharded over the dp mesh axis + shard_map train step),
+    # "multihost" (per-process local shards over a GLOBAL mesh — the
+    # jax.distributed scale-out of "sharded"; replay/multihost_store.py)
     replay_plane: str = "host"
     # experience collection: "host" (VectorizedActor — batched jitted
     # policy, env stepped on host) or "device" (collect.DeviceCollector —
@@ -172,8 +174,28 @@ class R2D2Config:
             raise ValueError(f"unknown encoder {self.encoder!r}")
         if self.lstm_backend not in ("auto", "scan", "pallas"):
             raise ValueError(f"unknown lstm_backend {self.lstm_backend!r}")
-        if self.replay_plane not in ("host", "device", "sharded"):
+        if self.replay_plane not in ("host", "device", "sharded", "multihost"):
             raise ValueError(f"unknown replay_plane {self.replay_plane!r}")
+        if self.replay_plane == "multihost":
+            if self.tp_size != 1:
+                raise ValueError("replay_plane='multihost' supports tp_size=1")
+            if self.collector != "host":
+                raise ValueError(
+                    "replay_plane='multihost' uses the host actor path "
+                    "(device-collector support is single-chip only)"
+                )
+            if self.updates_per_dispatch != 1:
+                raise ValueError(
+                    "replay_plane='multihost' dispatches one collective "
+                    "step at a time (updates_per_dispatch must be 1)"
+                )
+            if self.snapshot_replay:
+                raise ValueError(
+                    "snapshot_replay is not implemented for the multihost "
+                    "plane (per-host snapshots of a collective store would "
+                    "need coordinated restore); use the sharded plane for "
+                    "snapshotting"
+                )
         if self.collector not in ("host", "device"):
             raise ValueError(f"unknown collector {self.collector!r}")
         if self.updates_per_dispatch < 1:

@@ -248,7 +248,41 @@ class _ShardedPlane:
         return state, m
 
 
-_PLANES = {"host": _HostPlane, "device": _DevicePlane, "sharded": _ShardedPlane}
+class _MultiHostPlane:
+    """Per-process local replay shards over a GLOBAL (possibly multi-
+    process) mesh; one collective shard_map step per update with in-step
+    IS normalization (replay/multihost_store.py). Every process runs the
+    same Trainer loop — updates are SPMD-collective, so processes stay in
+    lockstep through the step dispatches themselves; collection and
+    logging are host-local."""
+
+    steps_per_update = 1
+
+    def __init__(self, tr: "Trainer"):
+        from r2d2_tpu.replay.multihost_store import MultiHostShardedReplay
+
+        if tr.mesh is None:
+            raise ValueError("multihost plane needs a mesh")
+        self.tr = tr
+        self.replay = MultiHostShardedReplay(tr.cfg, tr.mesh, seed=tr.cfg.seed + 3)
+        self.step_fn = make_sharded_fused_train_step(
+            tr.cfg, tr.net, tr.mesh, is_from_priorities=True
+        )
+
+    def sample(self, pipelined: bool = False):
+        # draws happen inside run_step, atomically with the dispatch
+        return ("multihost", None, None, None)
+
+    def update(self, state, item):
+        return self.replay.run_step(self.step_fn, state)
+
+
+_PLANES = {
+    "host": _HostPlane,
+    "device": _DevicePlane,
+    "sharded": _ShardedPlane,
+    "multihost": _MultiHostPlane,
+}
 
 
 class Trainer:
@@ -283,7 +317,25 @@ class Trainer:
         # mesh: dp x tp when the config asks for parallelism (collectives
         # ride ICI on a real slice; tests run on the 8-fake-device CPU mesh)
         self.mesh = None
-        if cfg.dp_size * cfg.tp_size > 1:
+        if cfg.replay_plane == "multihost":
+            # GLOBAL mesh over every process's devices (parallel/multihost);
+            # dp_size<=1 means "all global devices". A partial dp_size is
+            # rejected here: slicing the global device list could leave a
+            # process with zero local shards.
+            from r2d2_tpu.parallel.multihost import make_global_mesh
+
+            n_global = len(jax.devices())
+            if cfg.dp_size > 1 and cfg.dp_size != n_global:
+                raise ValueError(
+                    f"multihost plane spans ALL global devices: dp_size="
+                    f"{cfg.dp_size} != {n_global} devices (set dp_size<=1 "
+                    "to mean 'all', or use replay_plane='sharded' for a "
+                    "single-host subset)"
+                )
+            self.mesh = make_global_mesh(
+                dp=cfg.dp_size if cfg.dp_size > 1 else None, tp=1
+            )
+        elif cfg.dp_size * cfg.tp_size > 1:
             self.mesh = make_mesh(dp=cfg.dp_size, tp=cfg.tp_size,
                                   devices=jax.devices()[: cfg.dp_size * cfg.tp_size])
 
@@ -374,13 +426,30 @@ class Trainer:
         if step // self.cfg.publish_interval > prev // self.cfg.publish_interval:
             self.param_store.publish(self.state.params)
         if step // self.cfg.save_interval > prev // self.cfg.save_interval:
+            # in a multi-process run every process calls this: orbax saves
+            # distributed arrays collectively (needs a shared checkpoint
+            # path across hosts, the standard orbax contract)
             save_checkpoint(
                 self.cfg.checkpoint_dir,
                 self.state,
-                self.replay.env_steps + self.env_steps_offset,
+                self._global_env_steps(),
                 self.wall_minutes_offset + (time.time() - self._start_time) / 60.0,
             )
         return m, step
+
+    def _global_env_steps(self) -> int:
+        """Run-total env steps. replay.env_steps is host-local on the
+        multihost plane, so a multi-process run sums across processes (an
+        allgather collective — safe here because every process reaches the
+        checkpoint crossing in lockstep)."""
+        local = self.replay.env_steps + self.env_steps_offset
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            return int(
+                multihost_utils.process_allgather(np.int64(local)).sum()
+            )
+        return local
 
     def finish_updates(self) -> None:
         """Flush any deferred per-plane work (e.g. the K>1 device plane's
@@ -544,8 +613,14 @@ def main(argv=None):
     p.add_argument("--env", default=None, help="override env name (e.g. catch)")
     p.add_argument("--steps", type=int, default=None)
     p.add_argument("--mode", default="threaded", choices=["threaded", "inline"])
-    p.add_argument("--replay", default=None, choices=["host", "device", "sharded"],
+    p.add_argument("--replay", default=None,
+                   choices=["host", "device", "sharded", "multihost"],
                    help="replay data plane (default: preset's replay_plane)")
+    p.add_argument("--distributed", action="store_true",
+                   help="initialize jax.distributed from the standard env "
+                        "vars (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES "
+                        "/ JAX_PROCESS_ID) before building the trainer; "
+                        "pair with --replay multihost")
     p.add_argument("--collector", default=None, choices=["host", "device"],
                    help="experience collection: host actor loop or fully "
                         "on-device jitted chunks (pure-JAX envs only)")
@@ -563,6 +638,11 @@ def main(argv=None):
     p.add_argument("--profile-port", type=int, default=0,
                    help="if set, start a live profiler server on this port")
     args = p.parse_args(argv)
+
+    if args.distributed:
+        from r2d2_tpu.parallel.multihost import initialize_distributed
+
+        initialize_distributed()
 
     cfg = PRESETS[args.preset]()
     overrides = {}

@@ -175,3 +175,27 @@ def test_multihost_data_plane_matches_sharded_store():
 
     np.testing.assert_allclose(float(m_mh["loss"]), float(m_sh["loss"]), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(p_mh), np.asarray(p_sh), atol=1e-5)
+
+
+def test_trainer_multihost_plane(tmp_path):
+    """Trainer with replay_plane='multihost' (single process, 8 fake
+    devices all local): end-to-end training through the collective plane."""
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.train import Trainer
+
+    cfg = tiny_test().replace(
+        env_name="catch",
+        replay_plane="multihost",
+        batch_size=8,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        training_steps=6,
+        save_interval=3,
+        learning_starts=48,
+    )
+    trainer = Trainer(cfg)
+    assert trainer.mesh.shape["dp"] == len(jax.devices())
+    trainer.run_inline(env_steps_per_update=4)
+    assert trainer._step == 6
+    assert int(trainer.state.step) == 6
+    n, r = trainer.replay.episode_totals()
+    assert n > 0
