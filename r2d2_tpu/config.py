@@ -210,10 +210,10 @@ class R2D2Config:
                 "training_steps must be a multiple of updates_per_dispatch "
                 "(each dispatch advances the step counter by that amount)"
             )
-        if self.collector == "device" and self.replay_plane != "device":
+        if self.collector == "device" and self.replay_plane not in ("device", "sharded"):
             raise ValueError(
                 "collector='device' writes packed blocks straight into the "
-                "HBM store; it requires replay_plane='device'"
+                "HBM store; it requires replay_plane='device' or 'sharded'"
             )
         if self.replay_plane == "sharded":
             if self.dp_size * self.tp_size <= 1:

@@ -31,6 +31,7 @@ def shard_config(cfg: R2D2Config, dp: int) -> R2D2Config:
         dp_size=1,
         tp_size=1,
         replay_plane="host",
+        collector="host",  # collection is the PARENT plane's concern
         updates_per_dispatch=1,
     )
 

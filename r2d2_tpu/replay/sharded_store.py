@@ -94,6 +94,18 @@ class ShardedDeviceReplay:
             donate_argnums=(0,),
             out_shardings={k: shd for k in self.stores},
         )
+
+        # batched scatter for the on-device collector: E global slots in
+        # one donated dispatch (XLA reshards the collector's output onto
+        # the owning shards)
+        def _write_batch(stores, ptrs, vals):
+            return {k: arr.at[ptrs].set(vals[k]) for k, arr in stores.items()}
+
+        self._write_batch = jax.jit(
+            _write_batch,
+            donate_argnums=(0,),
+            out_shardings={k: shd for k in self.stores},
+        )
         self.lock = threading.Lock()
 
     # ---------------------------------------------------------------- state
@@ -148,6 +160,56 @@ class ShardedDeviceReplay:
                     episode_reward,
                 )
             self._rr = (self._rr + 1) % self.dp
+
+    def add_blocks_batch(
+        self,
+        fields: Dict[str, jnp.ndarray],
+        num_seq: np.ndarray,
+        learning_totals: np.ndarray,
+        priorities: np.ndarray,
+        episode_rewards: np.ndarray,
+        dones: np.ndarray,
+    ) -> None:
+        """Write E collector-packed blocks round-robin across shards in one
+        scatter (collect.DeviceCollector contract, mirroring
+        DeviceReplayBuffer.add_blocks_batch). Fields stay on device end to
+        end; only the per-block accounting scalars are host-side."""
+        E = len(num_seq)
+        bps = self.blocks_per_shard
+        if E > self.dp * bps:
+            raise ValueError(f"{E} blocks per batch exceeds {self.dp * bps} slots")
+        with self.lock:
+            shard_ids = [(self._rr + i) % self.dp for i in range(E)]
+            # hold EVERY affected shard's lock across write + account
+            # (ascending order; other paths only ever hold one at a time):
+            # a sampler draw between the scatter and the accounting would
+            # pair new slot data with the evicted blocks' tree state —
+            # add_block's single-shard lock gives the same guarantee
+            locks = [self.shards[sid].lock for sid in sorted(set(shard_ids))]
+            for lk in locks:
+                lk.acquire()
+            try:
+                # destination slots BEFORE accounting mutates the pointers
+                # (write first, account last — same contract as add_block)
+                sim = {sid: self.shards[sid].block_ptr for sid in set(shard_ids)}
+                ptrs = np.empty(E, np.int64)
+                for i, sid in enumerate(shard_ids):
+                    ptrs[i] = sid * bps + sim[sid]
+                    sim[sid] = (sim[sid] + 1) % bps
+                self.stores = self._write_batch(
+                    self.stores, jnp.asarray(ptrs, jnp.int32), fields
+                )
+                for i, sid in enumerate(shard_ids):
+                    self.shards[sid]._account_add(
+                        int(num_seq[i]),
+                        int(learning_totals[i]),
+                        priorities[i],
+                        float(episode_rewards[i]) if dones[i] else None,
+                    )
+                self._rr = (self._rr + E) % self.dp
+            finally:
+                for lk in reversed(locks):
+                    lk.release()
 
     # --------------------------------------------------------------- sample
 

@@ -439,17 +439,17 @@ class Trainer:
 
     def _global_env_steps(self) -> int:
         """Run-total env steps. replay.env_steps is host-local on the
-        multihost plane, so a multi-process run sums across processes (an
-        allgather collective — safe here because every process reaches the
-        checkpoint crossing in lockstep)."""
-        local = self.replay.env_steps + self.env_steps_offset
+        multihost plane, so a multi-process run sums it across processes
+        (an allgather collective — safe here because every process reaches
+        the checkpoint crossing in lockstep). env_steps_offset is ALREADY a
+        global total restored from the checkpoint, so it is added exactly
+        once, outside the sum."""
+        local = self.replay.env_steps
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
-            return int(
-                multihost_utils.process_allgather(np.int64(local)).sum()
-            )
-        return local
+            local = int(multihost_utils.process_allgather(np.int64(local)).sum())
+        return local + self.env_steps_offset
 
     def finish_updates(self) -> None:
         """Flush any deferred per-plane work (e.g. the K>1 device plane's
@@ -627,6 +627,10 @@ def main(argv=None):
     p.add_argument("--updates-per-dispatch", type=int, default=None,
                    help="fold K learner updates into one jitted dispatch "
                         "(device replay plane; amortizes launch latency)")
+    p.add_argument("--dp", type=int, default=None,
+                   help="data-parallel mesh size (overrides preset dp_size)")
+    p.add_argument("--tp", type=int, default=None,
+                   help="tensor-parallel mesh size (overrides preset tp_size)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--snapshot-replay", action="store_true",
                    help="save full replay contents at end of run and restore "
@@ -660,6 +664,10 @@ def main(argv=None):
             overrides["replay_plane"] = "device"
     if args.snapshot_replay:
         overrides["snapshot_replay"] = True
+    if args.dp is not None:
+        overrides["dp_size"] = args.dp
+    if args.tp is not None:
+        overrides["tp_size"] = args.tp
     if args.updates_per_dispatch is not None:
         overrides["updates_per_dispatch"] = args.updates_per_dispatch
         # convenience only for the single-chip default: never silently

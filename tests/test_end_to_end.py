@@ -148,3 +148,50 @@ def test_evaluate_plot(trained, tmp_path):
     import os
 
     assert os.path.getsize(out) > 1000
+
+
+def test_long_context_training(tmp_path):
+    """Scaled-down long_context preset shape (SURVEY.md section 5.7): long
+    learning span, remat-chunked LSTM scan (seq 74 = 2 chunks of 37),
+    trained end to end through the device plane."""
+    cfg = tiny_test().replace(
+        env_name="catch",
+        replay_plane="device",
+        burn_in_steps=8,
+        learning_steps=64,
+        forward_steps=2,
+        block_length=64,
+        buffer_capacity=640,
+        scan_chunk=37,  # 8+64+2 = 74 -> two remat chunks
+        lstm_backend="scan",
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        training_steps=4,
+        save_interval=100,
+        learning_starts=96,
+        max_episode_steps=64,
+    )
+    assert cfg.seq_len == 74
+    trainer = Trainer(cfg)
+    trainer.run_inline(env_steps_per_update=8)
+    assert trainer._step == 4
+
+
+def test_device_collector_with_sharded_plane(tmp_path):
+    """On-device collection feeding the dp-sharded HBM replay: blocks
+    round-robin across shards in one scatter, shard_map learner trains."""
+    cfg = tiny_test().replace(
+        env_name="catch",
+        collector="device",
+        replay_plane="sharded",
+        dp_size=4,
+        batch_size=8,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        training_steps=6,
+        save_interval=100,
+        learning_starts=48,
+        max_episode_steps=16,
+    )
+    trainer = Trainer(cfg)
+    trainer.run_inline()
+    assert trainer._step == 6
+    assert all(len(s) > 0 for s in trainer.replay.shards)
