@@ -195,3 +195,22 @@ def test_device_collector_with_sharded_plane(tmp_path):
     trainer.run_inline()
     assert trainer._step == 6
     assert all(len(s) > 0 for s in trainer.replay.shards)
+
+
+def test_impala_encoder_training(tmp_path):
+    """IMPALA-ResNet encoder variant (BASELINE.json config 4 shape, scaled
+    down) trained end to end on the device plane."""
+    cfg = tiny_test().replace(
+        env_name="catch",
+        encoder="impala",
+        impala_channels=(4, 8),
+        replay_plane="device",
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        training_steps=3,
+        save_interval=100,
+        learning_starts=48,
+        max_episode_steps=16,
+    )
+    trainer = Trainer(cfg)
+    trainer.run_inline(env_steps_per_update=4)
+    assert trainer._step == 3
