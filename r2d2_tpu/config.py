@@ -174,6 +174,19 @@ class R2D2Config:
             raise ValueError(f"unknown encoder {self.encoder!r}")
         if self.lstm_backend not in ("auto", "scan", "pallas"):
             raise ValueError(f"unknown lstm_backend {self.lstm_backend!r}")
+        if (
+            self.tp_size > 1
+            and self.lstm_backend == "pallas"
+            and self.replay_plane in ("host", "device")
+        ):
+            # only the plain-jit planes tp-shard the kernels; shard_map
+            # planes keep params replicated, where pallas stays valid
+            raise ValueError(
+                "tp_size > 1 on the host/device planes shards the LSTM "
+                "kernels via GSPMD, which cannot partition the Pallas "
+                "unroll; use lstm_backend='scan' (or 'auto', which "
+                "resolves to scan there)"
+            )
         if self.replay_plane not in ("host", "device", "sharded", "multihost"):
             raise ValueError(f"unknown replay_plane {self.replay_plane!r}")
         if self.replay_plane == "multihost":

@@ -56,6 +56,13 @@ class R2D2Network(nn.Module):
 
     @classmethod
     def from_config(cls, cfg: R2D2Config) -> "R2D2Network":
+        # tp>1 on the plain-jit planes shards the LSTM kernels via GSPMD
+        # annotations, which cannot partition around the Pallas unroll —
+        # auto resolves to scan exactly there (shard_map planes keep params
+        # replicated and keep the fused kernel)
+        backend = cfg.lstm_backend
+        if cfg.tp_size > 1 and cfg.replay_plane in ("host", "device") and backend == "auto":
+            backend = "scan"
         return cls(
             action_dim=cfg.action_dim,
             hidden_dim=cfg.hidden_dim,
@@ -65,7 +72,7 @@ class R2D2Network(nn.Module):
             compute_dtype=cfg.compute_dtype,
             impala_channels=tuple(cfg.impala_channels),
             scan_chunk=cfg.scan_chunk,
-            lstm_backend=cfg.lstm_backend,
+            lstm_backend=backend,
         )
 
     def setup(self):

@@ -3,10 +3,11 @@
 Axes:
   dp — data parallel: the learner batch splits across this axis; gradient
        all-reduce (psum) is inserted by XLA because params are replicated.
-  tp — tensor parallel: reserved for sharding wide kernels (impala encoder,
-       LSTM 4H projections) at model scales where it pays; at R2D2's model
-       size params stay replicated, but the axis exists so a tp>1 config is
-       expressible without restructuring (SURVEY.md section 2.3 TP row).
+  tp — tensor parallel: on the plain-jit planes (host/device replay) the
+       LSTM's wide kernels shard their 4H axis over tp via the GSPMD
+       annotations from `train_state_shardings` below; the shard_map
+       planes (sharded/multihost replay) declare replicated params and
+       keep tp=1 (SURVEY.md section 2.3 TP row).
 
 Batches shard their leading (batch) dimension over dp; everything else is
 replicated. With params replicated and batch sharded, jit emits a psum over
@@ -47,3 +48,30 @@ def shard_batch(mesh: Mesh, batch_pytree):
     """device_put every leaf with its batch dim sharded over dp."""
     sh = batch_sharding(mesh)
     return jax.tree.map(lambda x: jax.device_put(x, sh), batch_pytree)
+
+
+def train_state_shardings(state, mesh: Mesh):
+    """Per-leaf NamedShardings for a TrainState: the LSTM's wide kernels
+    (wi/wh: (in, 4H), the model's largest matmuls) shard their OUTPUT axis
+    over tp; everything else replicates. With tp=1 this degenerates to
+    fully-replicated, so it is safe to apply unconditionally on any mesh.
+
+    Scope: the plain-jit learner paths (host/device planes) — XLA/GSPMD
+    partitions the matmuls and inserts the tp collectives from these
+    annotations alone. The shard_map paths (sharded/multihost planes) keep
+    params replicated per their P() in_specs; they are dp-scaling designs.
+
+    Adam's mu/nu mirror the param tree structure, so the same path rule
+    shards them consistently (optimizer math is elementwise)."""
+
+    def spec_for(path, leaf):
+        keys = {getattr(p, "key", getattr(p, "name", "")) for p in path}
+        if leaf.ndim == 2 and keys & {"wi", "wh"}:
+            return P(None, "tp")
+        return P()
+
+    import jax.tree_util as jtu
+
+    return jtu.tree_map_with_path(
+        lambda p, l: NamedSharding(mesh, spec_for(p, l)), state
+    )

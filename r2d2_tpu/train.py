@@ -341,7 +341,17 @@ class Trainer:
 
         self.net, self.state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
         if self.mesh is not None:
-            self.state = jax.device_put(self.state, replicated_sharding(self.mesh))
+            if cfg.replay_plane in ("host", "device"):
+                # plain-jit planes: LSTM kernels shard over tp (GSPMD
+                # inserts the collectives); tp=1 degenerates to replicated
+                from r2d2_tpu.parallel.mesh import train_state_shardings
+
+                self.state = jax.device_put(
+                    self.state, train_state_shardings(self.state, self.mesh)
+                )
+            else:
+                # shard_map planes declare P() (replicated) param in_specs
+                self.state = jax.device_put(self.state, replicated_sharding(self.mesh))
         self.env_steps_offset = 0
         self.wall_minutes_offset = 0.0
         self._resumed = False
