@@ -1,0 +1,104 @@
+"""Learning demo on one TPU chip: the full pipeline solves catch.
+
+Default configuration (verified to reach eval reward 1.0 — perfect play —
+in ~4000 updates / ~5 minutes on one v5e chip): 26x26 device-rendered
+catch, IMPALA encoder, 128-hidden LSTM, bf16, on-device collection (E=64
+envs in one jitted scan), HBM replay, K=8 fused learner dispatches.
+
+--full switches to the flagship Atari-scale system (84x84, Nature trunk,
+512-hidden LSTM — the bench.py configuration). That scale learns too, but
+value propagation across 82-step episodes from a terminal-only reward
+needs tens of thousands of updates (the reference budgets 100k,
+config.py:15), far past a minutes-scale demo; run it with --steps 50000+
+and --resume across sessions.
+
+    python examples/catch_demo.py --out runs/catch_demo
+
+Artifacts: {out}/metrics.jsonl, {out}/eval.jsonl, {out}/curve.jpg,
+checkpoints under {out}/ckpt.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def demo_config(out: str, steps: int, actors: int, full: bool):
+    from r2d2_tpu.config import R2D2Config, default_atari
+
+    common = dict(
+        env_name="catch",
+        action_dim=3,
+        compute_dtype="bfloat16",
+        collector="device",
+        replay_plane="device",
+        num_actors=actors,
+        training_steps=steps,
+        save_interval=max(steps // 8, 16),
+        checkpoint_dir=os.path.join(out, "ckpt"),
+        metrics_path=os.path.join(out, "metrics.jsonl"),
+    )
+    if full:
+        return default_atari().replace(
+            max_episode_steps=82,  # catch: ball lands after height-2 steps
+            updates_per_dispatch=16,
+            # catch blocks hold one 82-step episode; see bench.system_main
+            buffer_capacity=400_000,
+            learning_starts=40_000,
+            **common,
+        )
+    return R2D2Config(
+        obs_shape=(26, 26, 1),
+        encoder="impala",
+        impala_channels=(8, 16),
+        hidden_dim=128,
+        max_episode_steps=24,
+        updates_per_dispatch=8,
+        burn_in_steps=10,
+        learning_steps=20,
+        forward_steps=5,
+        block_length=40,
+        buffer_capacity=80_000,
+        learning_starts=10_000,
+        gamma=0.99,
+        target_net_update_interval=100,
+        **common,
+    ).validate()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="runs/catch_demo")
+    p.add_argument("--steps", type=int, default=4000)
+    p.add_argument("--actors", type=int, default=64)
+    p.add_argument("--full", action="store_true",
+                   help="flagship Atari-scale config (needs --steps 50000+)")
+    p.add_argument("--resume", action="store_true",
+                   help="continue from the checkpoints under --out")
+    args = p.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+
+    from r2d2_tpu.envs.catch import CatchVecEnv
+    from r2d2_tpu.evaluate import evaluate_series, plot_series
+    from r2d2_tpu.train import Trainer
+
+    cfg = demo_config(args.out, args.steps, args.actors, args.full)
+    trainer = Trainer(cfg, resume=args.resume)
+    trainer.run_threaded()
+
+    h = cfg.obs_shape[0]
+    vec = CatchVecEnv(num_envs=16, height=h, width=h, seed=1234)
+    rows = evaluate_series(cfg, vec, out_path=os.path.join(args.out, "eval.jsonl"))
+    if not rows:
+        print("no checkpoints to evaluate (steps < save_interval?)")
+        return
+    plot_series(rows, os.path.join(args.out, "curve.jpg"))
+    print(f"final mean reward: {rows[-1]['mean_reward']:.3f}")
+
+
+if __name__ == "__main__":
+    main()

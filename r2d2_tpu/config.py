@@ -134,6 +134,20 @@ class R2D2Config:
 
     # --- derived ----------------------------------------------------------
     @property
+    def plain_jit_plane(self) -> bool:
+        """Plain-jit learner planes (GSPMD partitions from shardings alone)
+        vs shard_map planes (replicated params declared in the specs)."""
+        return self.replay_plane in ("host", "device")
+
+    @property
+    def tp_shards_params(self) -> bool:
+        """True when tp>1 actually shards the LSTM kernels via GSPMD — the
+        plain-jit planes only (the rule lives here ONCE: config validation,
+        the model's LSTM backend resolution, and the Trainer's state
+        placement all read it)."""
+        return self.tp_size > 1 and self.plain_jit_plane
+
+    @property
     def seq_len(self) -> int:
         """burn_in + learning + forward = 85 at defaults (config.py:30)."""
         return self.burn_in_steps + self.learning_steps + self.forward_steps
@@ -174,13 +188,7 @@ class R2D2Config:
             raise ValueError(f"unknown encoder {self.encoder!r}")
         if self.lstm_backend not in ("auto", "scan", "pallas"):
             raise ValueError(f"unknown lstm_backend {self.lstm_backend!r}")
-        if (
-            self.tp_size > 1
-            and self.lstm_backend == "pallas"
-            and self.replay_plane in ("host", "device")
-        ):
-            # only the plain-jit planes tp-shard the kernels; shard_map
-            # planes keep params replicated, where pallas stays valid
+        if self.tp_shards_params and self.lstm_backend == "pallas":
             raise ValueError(
                 "tp_size > 1 on the host/device planes shards the LSTM "
                 "kernels via GSPMD, which cannot partition the Pallas "

@@ -56,12 +56,11 @@ class R2D2Network(nn.Module):
 
     @classmethod
     def from_config(cls, cfg: R2D2Config) -> "R2D2Network":
-        # tp>1 on the plain-jit planes shards the LSTM kernels via GSPMD
-        # annotations, which cannot partition around the Pallas unroll —
-        # auto resolves to scan exactly there (shard_map planes keep params
-        # replicated and keep the fused kernel)
+        # GSPMD cannot partition around the Pallas unroll, so auto resolves
+        # to scan exactly where the kernels are tp-sharded (shard_map
+        # planes keep params replicated and keep the fused kernel)
         backend = cfg.lstm_backend
-        if cfg.tp_size > 1 and cfg.replay_plane in ("host", "device") and backend == "auto":
+        if cfg.tp_shards_params and backend == "auto":
             backend = "scan"
         return cls(
             action_dim=cfg.action_dim,

@@ -341,7 +341,7 @@ class Trainer:
 
         self.net, self.state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
         if self.mesh is not None:
-            if cfg.replay_plane in ("host", "device"):
+            if cfg.plain_jit_plane:
                 # plain-jit planes: LSTM kernels shard over tp (GSPMD
                 # inserts the collectives); tp=1 degenerates to replicated
                 from r2d2_tpu.parallel.mesh import train_state_shardings
