@@ -50,6 +50,12 @@ def _where_rows(mask: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarra
     return jnp.where(mask.reshape(mask.shape + (1,) * (a.ndim - 1)), a, b)
 
 
+def default_chunk_len(cfg: R2D2Config) -> int:
+    """The chunk rule shared by collection and device-side eval: episodes
+    are truncated at block_length (a block holds at most one episode)."""
+    return min(cfg.block_length, cfg.max_episode_steps)
+
+
 def make_collect_fn(
     cfg: R2D2Config, net: R2D2Network, fn_env, num_envs: int, chunk_len: int
 ):
@@ -267,7 +273,7 @@ class DeviceCollector:
         E = cfg.num_actors
         self.cfg = cfg
         self.E = E
-        self.chunk = int(chunk_len or min(cfg.block_length, cfg.max_episode_steps))
+        self.chunk = int(chunk_len or default_chunk_len(cfg))
         if cfg.max_episode_steps > self.chunk:
             import warnings
 

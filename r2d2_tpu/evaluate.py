@@ -73,14 +73,77 @@ def evaluate_params(
     return float(ep_reward.mean())
 
 
-def evaluate_series(cfg: R2D2Config, vec_env, out_path: Optional[str] = None, seed: int = 0):
-    """Reference test.py:14-58 equivalent over the orbax series."""
+def evaluate_params_device(
+    cfg: R2D2Config,
+    net,
+    params,
+    fn_env,
+    num_envs: int = 16,
+    seed: int = 0,
+    collect_fn=None,
+):
+    """Device-side evaluation for pure-JAX envs: one jitted chunk runs
+    `num_envs` near-greedy episodes (policy + env dynamics in a lax.scan,
+    collect.make_collect_fn) and only episode rewards return to the host.
+
+    On latency-heavy links this is the difference between one dispatch and
+    hundreds of per-step round trips. Pass a prebuilt `collect_fn` (from
+    `make_eval_collect_fn`) when calling repeatedly.
+
+    Episodes must fit the eval chunk (min(max_episode_steps, block_length),
+    the collector's chunk rule): slots still running at the chunk end make
+    the score a partial-return estimate, reported with a warning."""
+    if collect_fn is None:
+        collect_fn = make_eval_collect_fn(cfg, net, fn_env, num_envs)
+    key = jax.random.PRNGKey(seed)
+    env_state = jax.vmap(fn_env.reset)(jax.random.split(key, num_envs))
+    eps = jnp.full(num_envs, cfg.test_epsilon, jnp.float32)
+    (_, _, _, sizes, dones, ep_rewards, _, _) = collect_fn(
+        params, env_state, eps, jax.random.PRNGKey(seed + 1)
+    )
+    dones = np.asarray(dones)
+    ep_rewards = np.asarray(ep_rewards)
+    if not dones.all():
+        import warnings
+
+        warnings.warn(
+            f"{int((~dones).sum())}/{len(dones)} eval episodes outlived the "
+            "chunk; the mean includes their PARTIAL returns (size the env's "
+            "episodes within block_length for exact device-side eval)",
+            stacklevel=2,
+        )
+    return float(ep_rewards.mean())
+
+
+def make_eval_collect_fn(cfg: R2D2Config, net, fn_env, num_envs: int):
+    """The jitted eval chunk: the collector's scan at its default chunk
+    length (one episode per slot when episodes fit)."""
+    from r2d2_tpu.collect import default_chunk_len, make_collect_fn
+
+    return make_collect_fn(cfg, net, fn_env, num_envs, default_chunk_len(cfg))
+
+
+def evaluate_series(
+    cfg: R2D2Config,
+    vec_env,
+    out_path: Optional[str] = None,
+    seed: int = 0,
+    reward_fn=None,
+):
+    """Reference test.py:14-58 equivalent over the orbax series.
+
+    reward_fn(net, params) -> float overrides the per-checkpoint
+    evaluation (e.g. a device-side evaluator for pure-JAX envs); default
+    is the host vec-env rollout."""
     net, template = init_train_state(cfg, jax.random.PRNGKey(0))
     policy = make_policy(net)
     rows = []
     for step in list_checkpoint_steps(cfg.checkpoint_dir):
         state, env_steps, wall_minutes = restore_checkpoint(cfg.checkpoint_dir, template, step)
-        reward = evaluate_params(cfg, net, state.params, vec_env, seed=seed, policy=policy)
+        if reward_fn is not None:
+            reward = reward_fn(net, state.params)
+        else:
+            reward = evaluate_params(cfg, net, state.params, vec_env, seed=seed, policy=policy)
         row = {
             "step": step,
             "env_steps": env_steps,

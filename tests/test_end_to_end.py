@@ -214,3 +214,25 @@ def test_impala_encoder_training(tmp_path):
     trainer = Trainer(cfg)
     trainer.run_inline(env_steps_per_update=4)
     assert trainer._step == 3
+
+
+def test_device_side_evaluation(trained):
+    """Device eval (one jitted chunk) returns a sane, deterministic score
+    and plugs into the series evaluator."""
+    from r2d2_tpu.envs.catch import CatchEnv
+    from r2d2_tpu.evaluate import evaluate_params_device, make_eval_collect_fn
+
+    cfg = trained.cfg
+    env = CatchEnv(height=cfg.obs_shape[0], width=cfg.obs_shape[1])
+    fn = make_eval_collect_fn(cfg, trained.net, env, num_envs=8)
+    r1 = evaluate_params_device(cfg, trained.net, trained.state.params, env,
+                                num_envs=8, seed=5, collect_fn=fn)
+    r2 = evaluate_params_device(cfg, trained.net, trained.state.params, env,
+                                num_envs=8, seed=5, collect_fn=fn)
+    assert -1.0 <= r1 <= 1.0 and r1 == r2
+
+    rows = evaluate_series(
+        cfg, None, reward_fn=lambda net, p: evaluate_params_device(
+            cfg, net, p, env, num_envs=8, seed=5, collect_fn=fn)
+    )
+    assert len(rows) == 2 and all(np.isfinite(r["mean_reward"]) for r in rows)
