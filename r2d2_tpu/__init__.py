@@ -17,12 +17,14 @@ Layout (mirrors SURVEY.md section 1's layer map, re-architected TPU-first):
     models/          L2  flax networks: encoders, LSTM scan, R2D2 heads
     replay/          L3  host data plane: sum tree, block store, accumulator
     ops/             --  pure functional math shared by L2-L4
-    learner.py       L4  jitted/pjit double-Q update
-    actor.py         L4  vectorized actor service
-    train.py         L5  orchestration
-    evaluate.py      L6  offline evaluation
-    parallel/        --  mesh/sharding utilities
-    utils/           --  checkpointing, metrics, profiling
+    learner.py       L4  jitted/pjit double-Q update (single/multi/sharded)
+    actor.py         L4  vectorized actor service (host envs)
+    collect.py       L4  fully on-device collector (pure-JAX envs)
+    train.py         L5  orchestration over four replay planes
+    evaluate.py      L6  offline evaluation (host or device-side)
+    sweep.py         L6  Atari-57 sweep driver
+    parallel/        --  mesh/sharding + multi-host (jax.distributed)
+    utils/           --  checkpointing, metrics, profiling, supervision
 """
 
 __version__ = "0.1.0"
