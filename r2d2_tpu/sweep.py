@@ -109,11 +109,14 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=None)
     p.add_argument("--mode", default="threaded", choices=["threaded", "inline"])
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--allow-any-env", action="store_true",
+                   help="accept env names outside the Atari-57 suite "
+                        "(e.g. 'catch' on images without ALE)")
     args = p.parse_args(argv)
     games = list(ATARI_57) if args.all else (args.games or ["MsPacman"])
     unknown = [g for g in games if g not in ATARI_57]
-    if unknown:
-        p.error(f"not in the Atari-57 suite: {unknown}")
+    if unknown and not args.allow_any_env:
+        p.error(f"not in the Atari-57 suite: {unknown} (--allow-any-env to override)")
     run_sweep(
         games,
         preset=args.preset,

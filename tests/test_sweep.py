@@ -57,3 +57,12 @@ def test_cli_rejects_unknown_game():
 
     with pytest.raises(SystemExit):
         main(["--games", "NotAGame"])
+
+
+def test_cli_allow_any_env_flag(tmp_path):
+    from r2d2_tpu.sweep import main
+
+    rows_path = tmp_path / "summary.jsonl"
+    main(["--games", "catch", "--preset", "tiny_test", "--root", str(tmp_path),
+          "--steps", "4", "--mode", "inline", "--allow-any-env"])
+    assert rows_path.exists()
