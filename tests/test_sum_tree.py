@@ -85,3 +85,66 @@ def test_zero_priority_leaf_gives_finite_weights():
     min_p = positive.min()
     w = np.power(np.maximum(priorities, min_p) / min_p, -tree.is_exponent)
     assert np.isfinite(w).all() and w[-1] == 1.0
+
+
+def test_control_plane_fuzz_against_bruteforce():
+    """Random interleavings of add / sample / stale-priority updates keep
+    the control plane's accounting and tree consistent with a brute-force
+    model (size, env steps, per-slot occupancy, leaf values, and the
+    pointer-window staleness rule)."""
+    import math
+
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.replay.control_plane import ReplayControlPlane
+
+    cfg = tiny_test().replace(buffer_capacity=96, learning_starts=16)  # 6 slots
+    cp = ReplayControlPlane(cfg)
+    rng = np.random.default_rng(0)
+    S, nb = cfg.seqs_per_block, cfg.num_blocks
+
+    # brute-force model
+    leaf = np.zeros(cfg.num_sequences)
+    learning = np.zeros(nb, np.int64)
+    ptr = 0
+    size = env = 0
+    pending = []  # (idxes, old_ptr)
+
+    for op in rng.integers(0, 3, size=400):
+        if op == 0:  # add a block with random sequence count
+            ns = int(rng.integers(1, S + 1))
+            steps = ns * cfg.learning_steps - int(rng.integers(0, cfg.learning_steps))
+            prios = np.zeros(S, np.float32)
+            prios[:ns] = rng.uniform(0.1, 2.0, ns)
+            with cp.lock:
+                cp._account_add(ns, steps, prios, None)
+            leaf[ptr * S : (ptr + 1) * S] = np.asarray(prios, np.float64) ** cfg.prio_exponent
+            size += steps - learning[ptr]
+            env += steps
+            learning[ptr] = steps
+            ptr = (ptr + 1) % nb
+        elif op == 1 and cp.tree.total > 0 and size >= cfg.learning_starts:
+            with cp.lock:
+                b, s, idxes, w = cp._draw(rng)
+            assert (idxes // S == b).all() and (w > 0).all()
+            # drawn slots must be within occupied range
+            assert (leaf[idxes] >= 0).all()
+            pending.append((idxes, cp.block_ptr))
+        elif op == 2 and pending:
+            idxes, old_ptr = pending.pop(int(rng.integers(len(pending))))
+            td = rng.uniform(0.1, 3.0, len(idxes))
+            cp.update_priorities(idxes, td, old_ptr)
+            # apply the same pointer-window mask to the model
+            p = cp.block_ptr
+            if p > old_ptr:
+                mask = (idxes < old_ptr * S) | (idxes >= p * S)
+            elif p < old_ptr:
+                mask = (idxes < old_ptr * S) & (idxes >= p * S)
+            else:
+                mask = np.ones(len(idxes), bool)
+            leaf[idxes[mask]] = td[mask] ** cfg.prio_exponent
+        # invariants after every op
+        assert len(cp) == size
+        assert cp.env_steps == env
+        assert cp.block_ptr == ptr
+        np.testing.assert_allclose(cp.tree.leaves(), leaf, rtol=1e-9)
+        np.testing.assert_allclose(cp.tree.total, leaf.sum(), rtol=1e-9)
