@@ -68,6 +68,13 @@ class R2D2Config:
 
     # --- actor fleet ------------------------------------------------------
     num_actors: int = 8  # reference config.py:21
+    # collection pacing (threaded mode): target ratio of learner-consumed
+    # transitions to collected transitions (the Acme/Reverb
+    # samples-per-insert knob). 0 = free-running actors (the reference's
+    # behavior). When the observed ratio falls below the target — data is
+    # plentiful relative to optimization — the actor thread yields,
+    # leaving the device to the learner; above it, collection resumes.
+    samples_per_insert: float = 0.0
     base_eps: float = 0.4  # reference config.py:22
     eps_alpha: float = 7.0  # reference config.py:23
     test_epsilon: float = 0.001  # reference config.py:37

@@ -565,7 +565,24 @@ class Trainer:
         batch_q: "queue.Queue" = queue.Queue(maxsize=8)
         sup = Supervisor(heartbeat_timeout=cfg.heartbeat_timeout)
 
+        spi = cfg.samples_per_insert
+        # THIS-RUN, THIS-HOST accounting: inserts baseline at the current
+        # counter (a restored replay snapshot's lifetime total must not
+        # starve collection), and a multi-process run divides the global
+        # batch by process count so the ratio compares host-local apples
+        consumed_per_update = cfg.batch_size * cfg.learning_steps / max(jax.process_count(), 1)
+        inserted0 = self.replay.env_steps
+
         def actor_body():
+            if spi > 0 and self.replay.can_sample():
+                consumed = (self._step - self._initial_step) * consumed_per_update
+                inserted = max(self.replay.env_steps - inserted0, 1)
+                if consumed / inserted < spi:
+                    # data is plentiful relative to optimization: yield the
+                    # device to the learner (bounded sleep keeps the
+                    # supervisor heartbeat fresh)
+                    time.sleep(0.05)
+                    return
             self.actor.step()
 
         # one sample + one bounded put attempt per call: a full queue (the

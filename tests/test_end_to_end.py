@@ -236,3 +236,26 @@ def test_device_side_evaluation(trained):
             cfg, net, p, env, num_envs=8, seed=5, collect_fn=fn)
     )
     assert len(rows) == 2 and all(np.isfinite(r["mean_reward"]) for r in rows)
+
+
+def test_samples_per_insert_throttles_collection(tmp_path):
+    """With a samples-per-insert target, free-running actors yield once
+    data outpaces optimization: the final consumed/inserted ratio stays
+    near the target instead of collapsing toward zero."""
+    cfg = tiny_test().replace(
+        env_name="catch",
+        replay_plane="device",
+        collector="device",
+        samples_per_insert=2.0,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        training_steps=30,
+        save_interval=1000,
+        learning_starts=48,
+        max_episode_steps=16,
+    )
+    trainer = Trainer(cfg)
+    trainer.run_threaded()
+    consumed = trainer._step * cfg.batch_size * cfg.learning_steps
+    ratio = consumed / trainer.replay.env_steps
+    # throttling keeps collection within ~2 chunks of the target band
+    assert ratio > 0.5, f"actors free-ran: ratio {ratio:.2f}"
