@@ -63,19 +63,11 @@ def synth_block(cfg, rng: np.random.Generator) -> Block:
     )
 
 
-def system_main():
-    """Full-system throughput: on-device collection (collect.py) and the
-    K-update learner dispatch sharing ONE chip concurrently — the complete
-    TPU-native R2D2 (actor + replay + learner) with no synthetic data.
-
-    Env: catch at Atari resolution (84x84, device-rendered; this image has
-    no ALE and one host core — SURVEY.md section 2.4), full-size network.
-    Prints one JSON line with learner env-frames/s (the BASELINE.md metric)
-    measured WHILE collection sustains its own rate on the same chip."""
-    from r2d2_tpu.train import Trainer
-
-    E = 256
-    cfg = default_atari().replace(
+def _system_cfg(E: int = 256):
+    """Shared full-system benchmark config: catch at Atari resolution
+    (84x84, device-rendered; this image has no ALE and one host core —
+    SURVEY.md section 2.4), full-size network."""
+    return default_atari().replace(
         env_name="catch",
         action_dim=3,
         compute_dtype="bfloat16",
@@ -92,6 +84,81 @@ def system_main():
         training_steps=1_000_000,
         save_interval=1_000_000,  # no checkpoint I/O inside the window
     )
+
+
+def fused_system_main(collect_every: int = 6):
+    """Full-system throughput via the fused megastep (megastep.py): ONE
+    dispatch = K updates + a collection chunk every collect_every'th
+    dispatch. No worker threads — the host only runs sum-tree bookkeeping
+    between dispatches. Default collect_every=6 matches the threaded
+    system benchmark's measured consumed:inserted ratio (~12:1) so the two
+    modes are comparable like for like."""
+    from r2d2_tpu.megastep import FusedSystemRunner
+    from r2d2_tpu.train import Trainer
+
+    cfg = _system_cfg()
+    trainer = Trainer(cfg)
+    print(f"warmup: filling {cfg.learning_starts} transitions...", file=sys.stderr)
+    t0 = time.time()
+    trainer.warmup()
+    trainer._start_time = time.time()
+    print(f"warmup done in {time.time()-t0:.1f}s", file=sys.stderr)
+
+    runner = FusedSystemRunner(
+        cfg, trainer.net, trainer.fn_env, trainer.replay,
+        trainer.actor.epsilons, trainer.actor.env_state, trainer.actor.key,
+        collect_every=collect_every, sample_rng=trainer.sample_rng,
+    )
+    state = trainer.state
+    # compile both dispatch variants (collect and update-only) outside the window
+    state, m, _ = runner.step(state)
+    if collect_every > 1:
+        state, m, _ = runner.step(state)
+    _ = int(np.asarray(state.step))
+
+    target_seconds = 30.0
+    n_updates = 0
+    env0 = runner.total_env_steps
+    t0 = time.time()
+    while time.time() - t0 < target_seconds:
+        state, m, _ = runner.step(state)
+        n_updates += cfg.updates_per_dispatch
+    _ = int(np.asarray(state.step))  # stream sync
+    elapsed = time.time() - t0
+    env = runner.total_env_steps - env0
+    runner.finish()
+    learner_fps = n_updates / elapsed * cfg.batch_size * cfg.learning_steps * 4
+    collect_fps = env / elapsed * 4
+    print(
+        f"{n_updates} updates + {env} env steps in {elapsed:.1f}s "
+        f"(loss {float(m['loss']):.4f}, collect_every={collect_every})",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "fused_system_learner_env_frames_per_sec_per_chip",
+                "value": round(learner_fps, 1),
+                "unit": "env_frames/s",
+                "vs_baseline": round(learner_fps / BASELINE_FRAMES_PER_SEC, 3),
+                "concurrent_collection_env_frames_per_sec": round(collect_fps, 1),
+            }
+        )
+    )
+
+
+def system_main():
+    """Full-system throughput: on-device collection (collect.py) and the
+    K-update learner dispatch sharing ONE chip concurrently — the complete
+    TPU-native R2D2 (actor + replay + learner) with no synthetic data.
+
+    Env: catch at Atari resolution (84x84, device-rendered; this image has
+    no ALE and one host core — SURVEY.md section 2.4), full-size network.
+    Prints one JSON line with learner env-frames/s (the BASELINE.md metric)
+    measured WHILE collection sustains its own rate on the same chip."""
+    from r2d2_tpu.train import Trainer
+
+    cfg = _system_cfg()
     trainer = Trainer(cfg)
     print(f"warmup: filling {cfg.learning_starts} transitions...", file=sys.stderr)
     t0 = time.time()
@@ -210,7 +277,7 @@ def main():
                 continue
             stacked = np.asarray(prios)
             for row, d in zip(stacked, draws):
-                replay.update_priorities(d.idxes, row, d.old_ptr)
+                replay.update_priorities(d.idxes, row, d.old_ptr, d.old_advances)
 
     threads = [
         threading.Thread(target=sampler, daemon=True),
@@ -292,13 +359,20 @@ if __name__ == "__main__":
 
     p = argparse.ArgumentParser(description="r2d2_tpu benchmarks")
     p.add_argument(
-        "--mode", default="learner", choices=["learner", "system"],
+        "--mode", default="learner", choices=["learner", "system", "fused"],
         help="learner: fused-update throughput on synthetic replay (the "
              "driver's default metric). system: concurrent on-device "
-             "collection + learning, end to end.",
+             "collection + learning via threads. fused: the same full "
+             "system as ONE megastep dispatch (megastep.py).",
+    )
+    p.add_argument(
+        "--collect-every", type=int, default=6,
+        help="fused mode: fold a collection chunk into every Nth dispatch",
     )
     args = p.parse_args()
     if args.mode == "system":
         system_main()
+    elif args.mode == "fused":
+        fused_system_main(args.collect_every)
     else:
         main()

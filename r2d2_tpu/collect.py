@@ -59,7 +59,16 @@ def default_chunk_len(cfg: R2D2Config) -> int:
 def make_collect_fn(
     cfg: R2D2Config, net: R2D2Network, fn_env, num_envs: int, chunk_len: int
 ):
-    """Build the jitted chunk collector.
+    """Jitted chunk collector (see make_collect_core for the contract)."""
+    return jax.jit(make_collect_core(cfg, net, fn_env, num_envs, chunk_len))
+
+
+def make_collect_core(
+    cfg: R2D2Config, net: R2D2Network, fn_env, num_envs: int, chunk_len: int
+):
+    """Build the (un-jitted) chunk collector — jit it directly
+    (make_collect_fn) or compose it into a larger dispatch
+    (megastep.make_megastep fuses it with K learner updates).
 
     fn_env protocol (all jit/vmap-safe): reset(key) -> state,
     step(state, action) -> (state', reward, done), render(state) -> uint8
@@ -247,7 +256,7 @@ def make_collect_fn(
         fresh_env = vreset(jax.random.split(keys[T + 1], E))
         return fields, priorities, num_seq, sizes, dones, ep_rewards, fresh_env, keys[T]
 
-    return jax.jit(collect)
+    return collect
 
 
 class DeviceCollector:

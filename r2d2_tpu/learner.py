@@ -266,6 +266,16 @@ def make_fused_multi_train_step(
 
     Signature: (state, stores, b, s, w) with b/s/w of shape (K, B);
     returns (state, metrics-of-last-step, priorities (K, B))."""
+    return jax.jit(
+        make_multi_update_core(cfg, net, num_steps),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_multi_update_core(cfg: R2D2Config, net: R2D2Network, num_steps: int):
+    """The un-jitted K-update scan body shared by
+    make_fused_multi_train_step and megastep.make_megastep — one
+    definition so the two dispatch paths cannot diverge."""
     raw = _raw_train_step(cfg, net)
     gather_batch = make_store_gather(cfg)
 
@@ -284,7 +294,7 @@ def make_fused_multi_train_step(
         state, (metrics, prios) = jax.lax.scan(body, state, (b, s, w))
         return state, jax.tree.map(lambda x: x[-1], metrics), prios
 
-    return jax.jit(multi, donate_argnums=(0,) if donate else ())
+    return multi
 
 
 def make_gather_step(cfg: R2D2Config):

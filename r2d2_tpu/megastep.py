@@ -1,0 +1,256 @@
+"""Fused actor-learner megastep: collection + K updates in ONE dispatch.
+
+The threaded full-system mode time-shares the chip between two dispatch
+streams (collector chunks and K-update learner chunks) driven by two host
+threads. On a single chip those dispatches serialize on the device anyway,
+so the threads buy no overlap — they only add dispatch gaps, lock handoffs,
+and GIL contention between the streams (measured: the concurrent system
+sustained ~29% of the isolated learner rate while collection used ~12% of
+the device).
+
+The TPU-native fix is to stop round-tripping the host between the two
+phases: ONE jitted dispatch runs
+
+    K prioritized double-Q updates   (gathered in-jit from the HBM replay)
+  + one full collection chunk        (policy + env dynamics + block packing,
+                                      collect.make_collect_core)
+  + the scatter of the E new blocks into the replay store
+
+and the host's only per-dispatch work is sum-tree bookkeeping over a few
+kilobytes of coordinates and priorities. XLA's SSA semantics give the
+ordering for free: the update gathers read the store argument's PRE-scatter
+contents (they were drawn against the host tree's current state), and the
+donated scatter reuses the same HBM afterwards.
+
+Semantics vs the threaded system mode (both reference-faithful):
+- The chunk is collected with the params at dispatch entry (pre-update).
+  The reference's actors run on weights up to publish_interval x
+  actor_update_interval steps stale (reference worker.py:744-751); here the
+  collection policy is at most K updates stale — strictly fresher — and no
+  param publish transfer is needed at all for collection.
+- New blocks enter the tree only after the dispatch returns, so updates
+  within a dispatch never sample the chunk being collected alongside them —
+  same one-chunk lag class as the threaded mode's queue depths (reference
+  worker.py:364-371 tolerates ~12 batches).
+- Priorities computed by the K updates land on the tree AFTER the chunk's
+  blocks are accounted, so the pointer-window staleness mask (reference
+  worker.py:290-307 invariant) rejects exactly the rows the scatter
+  overwrote.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.collect import default_chunk_len, make_collect_core
+from r2d2_tpu.learner import TrainState, make_multi_update_core
+from r2d2_tpu.models.r2d2 import R2D2Network
+
+
+def make_megastep(
+    cfg: R2D2Config,
+    net: R2D2Network,
+    fn_env,
+    num_envs: int,
+    chunk_len: int,
+    num_updates: int,
+    donate: bool = True,
+):
+    """Build the fused dispatch.
+
+    Signature:
+      mega(state, stores, env_state, epsilons, key, b, s, w, ptr0) ->
+        (state', stores', metrics, priorities (K, B),
+         (chunk_prios, num_seq, sizes, dones, ep_rewards), env_state', key')
+
+    b/s/w are (K, B) stacked sample coordinates drawn by the host against
+    the current tree; ptr0 is the first of the E CONTIGUOUS store slots the
+    host reserved for the chunk's blocks (ReplayControlPlane.
+    _reserve_contiguous — a contiguous slab write runs at memcpy speed
+    where a ring-crossing scatter costs seconds on TPU). Exactly
+    equivalent to running learner.make_fused_multi_train_step on the same
+    coordinates followed by collect + DeviceReplayBuffer.add_blocks_batch
+    with the same key (pinned by tests/test_megastep.py)."""
+    collect_core = make_collect_core(cfg, net, fn_env, num_envs, chunk_len)
+    multi_core = make_multi_update_core(cfg, net, num_updates)
+
+    def mega(state: TrainState, stores, env_state, epsilons, key, b, s, w, ptr0):
+        # collection uses the dispatch-entry params: the freshest policy any
+        # actor design could see without re-publishing mid-dispatch
+        act_params = state.params
+        state, metrics, priorities = multi_core(state, stores, b, s, w)
+
+        (fields, chunk_prios, num_seq, sizes, dones, ep_rewards, fresh_env, key2) = (
+            collect_core(act_params, env_state, epsilons, key)
+        )
+        new_stores = {
+            k: jax.lax.dynamic_update_slice_in_dim(arr, fields[k], ptr0, axis=0)
+            for k, arr in stores.items()
+        }
+        return (
+            state,
+            new_stores,
+            metrics,
+            priorities,
+            (chunk_prios, num_seq, sizes, dones, ep_rewards),
+            fresh_env,
+            key2,
+        )
+
+    return jax.jit(mega, donate_argnums=(0, 1) if donate else ())
+
+
+class FusedSystemRunner:
+    """Drives the megastep against a DeviceReplayBuffer + DeviceCollector.
+
+    Owns the per-dispatch protocol (the Trainer's fused mode and bench.py
+    both go through here):
+
+      1. under the replay lock: draw K x B coordinates, reserve the next E
+         ring slots, dispatch (donating the stores), install the returned
+         stores.
+      2. read back the chunk's host-side bookkeeping (a few kB) and account
+         the E new blocks — this advances the ring pointer past the
+         reserved slots.
+      3. apply the K update-priority rows under each draw's own staleness
+         window: rows targeting slots the chunk overwrote are rejected by
+         the pointer-window mask because accounting ran first.
+
+    The priority readback is DEFERRED one dispatch (same protocol as the
+    threaded device plane): reading this dispatch's priorities immediately
+    would stall the host for the dispatch's execution plus a device->host
+    round trip — on a tunneled backend the round trip alone rivals the
+    compute. Instead the transfer starts async and is collected while the
+    NEXT dispatch executes. Deferral is safe in either direction: pending
+    rows are applied only after any intervening chunk accounting has
+    advanced the ring pointer, so the pointer-window mask still rejects
+    exactly the rows whose slots were overwritten since their draw.
+    Collection dispatches DO block (on the chunk's few-kB bookkeeping
+    readback): the ring pointer must advance before the next draws.
+
+    `collect_every` dispatches include the collection chunk; the others run
+    the plain K-update dispatch (learner.make_fused_multi_train_step) so
+    the insert:consume ratio is tunable without recompilation (two compiled
+    programs, selected per dispatch)."""
+
+    def __init__(
+        self,
+        cfg: R2D2Config,
+        net: R2D2Network,
+        fn_env,
+        replay,
+        epsilons: jnp.ndarray,
+        env_state,
+        key: jax.Array,
+        collect_every: int = 1,
+        chunk_len: Optional[int] = None,
+        sample_rng: Optional[np.random.Generator] = None,
+        samples_per_insert: float = 0.0,
+    ):
+        from r2d2_tpu.learner import make_fused_multi_train_step
+
+        self.cfg = cfg
+        self.replay = replay
+        self.E = cfg.num_actors
+        self.K = cfg.updates_per_dispatch
+        self.chunk = int(chunk_len or default_chunk_len(cfg))
+        # deferred-drain aliasing bound: between a draw and its priority
+        # application (one dispatch later) at most two chunks can land,
+        # each advancing the ring by E plus a wrap skip of < E. The
+        # pointer-window mask is correct for any advancement < num_blocks;
+        # a FULL lap would alias ptr == old_ptr and apply stale priorities
+        # to fresh blocks, so reject configs where the bound can reach it.
+        chunks_between = 2 if collect_every == 1 or samples_per_insert > 0 else 1
+        max_advance = chunks_between * (2 * self.E - 1)
+        if max_advance >= cfg.num_blocks:
+            raise ValueError(
+                f"store too small for deferred priorities: {cfg.num_blocks} "
+                f"block slots but up to {max_advance} can be overwritten "
+                f"between a draw and its application (E={self.E}); grow "
+                "buffer_capacity or reduce num_actors"
+            )
+        if collect_every < 1:
+            raise ValueError("collect_every must be >= 1")
+        self.collect_every = collect_every
+        # samples_per_insert > 0: ignore the fixed modulo and decide per
+        # dispatch from ACTUAL counters (the threaded pacer's rule,
+        # train.py actor_body) — chunks are episode-aligned and record
+        # fewer than E*chunk_len transitions, so a ratio derived from the
+        # theoretical max insert rate would silently overshoot the target
+        self.samples_per_insert = samples_per_insert
+        self._consumed = 0
+        self.epsilons = epsilons
+        self.env_state = env_state
+        self.key = key
+        self._mega = make_megastep(cfg, net, fn_env, self.E, self.chunk, self.K)
+        self._multi = make_fused_multi_train_step(cfg, net, self.K)
+        self._dispatch_count = 0
+        self.total_env_steps = 0
+        self._pending = None  # deferred (priorities, draws) readback
+        self.replay_rng = sample_rng if sample_rng is not None else np.random.default_rng(0)
+
+    def step(self, state: TrainState):
+        """One dispatch (K updates, plus the chunk on collect_every'th
+        calls); returns (state', metrics, env_steps_recorded)."""
+        if self.samples_per_insert > 0:
+            inserted = max(self.total_env_steps, 1)
+            collect = self._consumed / inserted >= self.samples_per_insert
+        else:
+            collect = self._dispatch_count % self.collect_every == 0
+        self._dispatch_count += 1
+        self._consumed += self.K * self.cfg.batch_size * self.cfg.learning_steps
+        replay = self.replay
+        with replay.lock:
+            draws = [replay._draw_sample_idx(self.replay_rng) for _ in range(self.K)]
+            b = jnp.asarray(np.stack([d.b for d in draws]))
+            s = jnp.asarray(np.stack([d.s for d in draws]))
+            w = jnp.asarray(np.stack([d.is_weights for d in draws]))
+            if collect:
+                ptr0 = replay._reserve_contiguous(self.E)
+                (state, new_stores, m, prios, chunk_host, self.env_state, self.key) = (
+                    self._mega(
+                        state, replay.stores, self.env_state, self.epsilons,
+                        self.key, b, s, w, jnp.int32(ptr0),
+                    )
+                )
+                replay.stores = new_stores
+            else:
+                state, m, prios = self._multi(state, replay.stores, b, s, w)
+
+        recorded = 0
+        if collect:
+            # account the chunk FIRST (advances the ring pointer past the
+            # scatter's slots), so every later priority application rejects
+            # rows the chunk overwrote
+            chunk_prios, num_seq, sizes, dones, ep_rewards = map(np.asarray, chunk_host)
+            # chunks are episode-aligned: every recorded transition is a
+            # learning step (collect.py _pack), so learning totals == sizes
+            with replay.lock:
+                replay._account_blocks(num_seq, sizes, chunk_prios, ep_rewards, dones)
+            recorded = int(sizes.sum())
+            self.total_env_steps += recorded
+        try:
+            prios.copy_to_host_async()
+        except AttributeError:
+            pass
+        prev, self._pending = self._pending, (prios, draws)
+        if prev is not None:
+            self._drain(prev)
+        return state, m, recorded
+
+    def _drain(self, pending) -> None:
+        prios, draws = pending
+        for row, d in zip(np.asarray(prios), draws):
+            self.replay.update_priorities(d.idxes, row, d.old_ptr, d.old_advances)
+
+    def finish(self) -> None:
+        """Apply the final in-flight priority readback; call once when the
+        driving loop stops updating."""
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            self._drain(pending)

@@ -48,6 +48,12 @@ class ReplayControlPlane:
             cfg.num_sequences, cfg.prio_exponent, cfg.is_exponent, native=native
         )
         self.block_ptr = 0
+        # monotone count of ring-pointer advances (writes + retirement
+        # jumps): lap detection for the staleness mask. The wrapped pointer
+        # alone cannot distinguish "nothing happened" from "exactly one
+        # full lap" (ptr == old_ptr either way) — after a lap EVERY slot
+        # was overwritten and all in-flight priorities must be dropped.
+        self.ptr_advances = 0
         self.size = 0
         self.env_steps = 0
         self.num_episodes = 0
@@ -87,12 +93,62 @@ class ReplayControlPlane:
         self.size += learning_total
         self.env_steps += learning_total
         self.block_ptr = (ptr + 1) % self.cfg.num_blocks
+        self.ptr_advances += 1
         if episode_reward is not None:
             self.episode_reward_sum += episode_reward
             self.num_episodes += 1
             self.total_episodes += 1
             self.total_reward_sum += episode_reward
         return ptr
+
+    def _account_blocks(
+        self,
+        num_seq: np.ndarray,
+        learning_totals: np.ndarray,
+        priorities: np.ndarray,
+        episode_rewards: np.ndarray,
+        dones: np.ndarray,
+    ) -> None:
+        """Account a batch of blocks written at consecutive ring slots
+        (shared by every batched-write path: the one place that knows a
+        truncated chunk is not a finished episode). Caller holds the lock
+        and has already written the data plane."""
+        for i in range(len(num_seq)):
+            self._account_add(
+                int(num_seq[i]),
+                int(learning_totals[i]),
+                priorities[i],
+                float(episode_rewards[i]) if dones[i] else None,
+            )
+
+    def _reserve_contiguous(self, n: int) -> int:
+        """Wrap the ring pointer to 0 if fewer than n slots remain before
+        the end, and return the pointer: the caller writes slots
+        [ptr, ptr+n) as ONE contiguous slab (a dynamic_update_slice — a
+        ring-crossing scatter is ~20x slower on TPU). The skipped tail
+        slots are RETIRED: with a steady E-batch writer the pointer cycle
+        repeats every lap, so the tail would otherwise hold frozen,
+        never-evicted blocks — instead their priorities are zeroed and
+        their transitions leave the size accounting, shrinking effective
+        capacity to floor(num_blocks/n)*n for batch writers. The
+        pointer-window staleness mask treats the whole tail as overwritten
+        — over-rejection, never wrong. Caller holds the lock."""
+        nb = self.cfg.num_blocks
+        if self.block_ptr + n > nb:
+            S = self.cfg.seqs_per_block
+            tail = np.arange(self.block_ptr, nb)
+            occ = tail[self.occupied[tail]]
+            if occ.size:
+                idxes = (occ[:, None] * S + np.arange(S)[None, :]).ravel()
+                self.tree.update(idxes, np.zeros(idxes.size, np.float32))
+                self.size -= int(self.learning_sum[occ].sum())
+                self.learning_sum[occ] = 0
+                self.occupied[occ] = False
+                self.num_seq_store[occ] = 0
+            # the jump traverses the tail: it counts toward lap detection
+            self.ptr_advances += nb - self.block_ptr
+            self.block_ptr = 0
+        return self.block_ptr
 
     def _draw(self, rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Stratified draw of batch_size sequence coordinates (with the
@@ -106,11 +162,28 @@ class ReplayControlPlane:
 
     # --- priorities -------------------------------------------------------
 
-    def update_priorities(self, idxes: np.ndarray, td_errors: np.ndarray, old_ptr: int) -> None:
+    def update_priorities(
+        self,
+        idxes: np.ndarray,
+        td_errors: np.ndarray,
+        old_ptr: int,
+        old_advances: Optional[int] = None,
+    ) -> None:
         """Apply learner priorities, discarding any index overwritten during
-        the sample->train round trip (worker.py:290-307 invariant)."""
+        the sample->train round trip (worker.py:290-307 invariant).
+
+        old_advances: the draw-time ptr_advances stamp. When provided, a
+        FULL ring lap between draw and apply (every slot overwritten, the
+        wrapped pointer back at old_ptr — invisible to the window mask)
+        rejects the whole batch. Callers without the stamp keep the
+        window-mask-only behavior (the reference's own guarantee)."""
         S = self.cfg.seqs_per_block
         with self.lock:
+            if (
+                old_advances is not None
+                and self.ptr_advances - old_advances >= self.cfg.num_blocks
+            ):
+                return
             ptr = self.block_ptr
             if ptr > old_ptr:
                 mask = (idxes < old_ptr * S) | (idxes >= ptr * S)

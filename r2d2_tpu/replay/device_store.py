@@ -56,6 +56,9 @@ class SampleIdx:
     idxes: np.ndarray       # (B,) global sequence slots (priority updates)
     old_ptr: int
     env_steps: int
+    # draw-time ptr_advances stamp (lap detection); None = no lap check,
+    # matching the update_priorities contract
+    old_advances: Optional[int] = None
 
 
 class DeviceReplayBuffer(ReplayControlPlane):
@@ -76,12 +79,19 @@ class DeviceReplayBuffer(ReplayControlPlane):
 
         self._write = jax.jit(_write, donate_argnums=(0,))
 
-        # batched scatter write for the on-device collector: E slots land
-        # in one donated dispatch (vals stay in HBM end to end)
-        def _write_batch(stores, ptrs, vals):
-            return {k: arr.at[ptrs].set(vals[k]) for k, arr in stores.items()}
+        # batched slab write for the on-device collector: E CONTIGUOUS
+        # slots land in one donated dispatch (vals stay in HBM end to end).
+        # Contiguity is load-bearing: a dynamic_update_slice writes E slabs
+        # at memcpy speed, where a dynamic-index scatter over the multi-GB
+        # store costs seconds on TPU (measured 2.2s vs 0.03s at E=256) —
+        # the ring pointer wraps early (_reserve_contiguous) to guarantee it
+        def _write_slab(stores, start, vals):
+            return {
+                k: jax.lax.dynamic_update_slice_in_dim(arr, vals[k], start, axis=0)
+                for k, arr in stores.items()
+            }
 
-        self._write_batch = jax.jit(_write_batch, donate_argnums=(0,))
+        self._write_slab = jax.jit(_write_slab, donate_argnums=(0,))
 
     # ------------------------------------------------------------------ add
 
@@ -145,17 +155,13 @@ class DeviceReplayBuffer(ReplayControlPlane):
         if E > nb:
             raise ValueError(f"{E} blocks per batch exceeds store of {nb} slots")
         with self.lock:
-            ptrs = (self.block_ptr + np.arange(E)) % nb
-            self.stores = self._write_batch(
-                self.stores, jnp.asarray(ptrs, jnp.int32), fields
+            start = self._reserve_contiguous(E)
+            self.stores = self._write_slab(
+                self.stores, jnp.int32(start), fields
             )
-            for i in range(E):
-                self._account_add(
-                    int(num_seq[i]),
-                    int(learning_totals[i]),
-                    priorities[i],
-                    float(episode_rewards[i]) if dones[i] else None,
-                )
+            self._account_blocks(
+                num_seq, learning_totals, priorities, episode_rewards, dones
+            )
 
     # --------------------------------------------------------------- sample
 
@@ -169,6 +175,7 @@ class DeviceReplayBuffer(ReplayControlPlane):
             idxes=idxes,
             old_ptr=self.block_ptr,
             env_steps=self.env_steps,
+            old_advances=self.ptr_advances,
         )
 
     def sample_indices(self, rng: np.random.Generator) -> SampleIdx:

@@ -48,6 +48,8 @@ class SampledBatch:
     idxes: np.ndarray          # (B,) int64 — sequence slots, for priority updates
     old_ptr: int               # block pointer at sample time (staleness check)
     env_steps: int             # total env steps stored so far
+    # ptr_advances stamp (full-lap detection); None = no lap check
+    old_advances: Optional[int] = None
 
 
 class ReplayBuffer(ReplayControlPlane):
@@ -168,5 +170,6 @@ class ReplayBuffer(ReplayControlPlane):
                 idxes=idxes,
                 old_ptr=self.block_ptr,
                 env_steps=self.env_steps,
+                old_advances=self.ptr_advances,
             )
         return batch

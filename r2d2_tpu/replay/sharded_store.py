@@ -56,6 +56,7 @@ class ShardedSampleIdx:
     is_weights: np.ndarray  # (dp, B/dp) float32, batch-globally normalized
     idxes: np.ndarray       # (dp, B/dp) sequence slots LOCAL to each shard
     old_ptrs: List[int]     # per-shard block pointer at sample time
+    old_advances: List[int]  # per-shard ptr_advances stamp (lap detection)
     env_steps: int
 
 
@@ -95,14 +96,53 @@ class ShardedDeviceReplay:
             out_shardings={k: shd for k in self.stores},
         )
 
-        # batched scatter for the on-device collector: E global slots in
-        # one donated dispatch (XLA reshards the collector's output onto
-        # the owning shards)
-        def _write_batch(stores, ptrs, vals):
-            return {k: arr.at[ptrs].set(vals[k]) for k, arr in stores.items()}
+        # batched slab write for the on-device collector: the batch deals
+        # round-robin starting at shard 0, so shard sid receives blocks
+        # sid, sid+dp, ... as ONE contiguous slab in its own region. The
+        # write runs under shard_map: each device applies a plain
+        # dynamic_update_slice to its LOCAL (nb/dp, ...) store block at its
+        # own start offset — no collectives, no GSPMD partitioning of a
+        # sharded-axis update (which compiles/executes pathologically; a
+        # dynamic-index scatter is just as bad, see
+        # DeviceReplayBuffer._write_slab). vals must carry E % dp == 0
+        # blocks (add_blocks_batch routes remainders through the
+        # single-slot _write); starts: (dp,) LOCAL first slot per shard.
+        from jax import shard_map
 
-        self._write_batch = jax.jit(
-            _write_batch,
+        def _slab_body(stores, starts, vals):
+            # local views: stores (nb/dp, ...), starts (1,), vals (1, E/dp, ...)
+            return {
+                k: jax.lax.dynamic_update_slice_in_dim(
+                    arr, vals[k][0], starts[0], axis=0
+                )
+                for k, arr in stores.items()
+            }
+
+        def _write_slabs(stores, starts, rr, vals):
+            E = next(iter(vals.values())).shape[0]
+            # block i -> shard (rr + i) % dp at consecutive local slots:
+            # regroup (E, ...) as (dp, E/dp, ...) with [sid, j] = v[j*dp+sid]
+            # for rr == 0, then roll the shard axis by the round-robin
+            # cursor so the dealing continues where the last add stopped
+            grouped = {
+                k: jnp.roll(
+                    jnp.swapaxes(v.reshape(E // dp, dp, *v.shape[1:]), 0, 1),
+                    rr,
+                    axis=0,
+                )
+                for k, v in vals.items()
+            }
+            specs = {k: P("dp") for k in stores}
+            return shard_map(
+                _slab_body,
+                mesh=mesh,
+                in_specs=(specs, P("dp"), {k: P("dp") for k in grouped}),
+                out_specs=specs,
+                check_vma=False,
+            )(stores, starts, grouped)
+
+        self._write_slabs = jax.jit(
+            _write_slabs,
             donate_argnums=(0,),
             out_shardings={k: shd for k in self.stores},
         )
@@ -170,43 +210,70 @@ class ShardedDeviceReplay:
         episode_rewards: np.ndarray,
         dones: np.ndarray,
     ) -> None:
-        """Write E collector-packed blocks round-robin across shards in one
-        scatter (collect.DeviceCollector contract, mirroring
-        DeviceReplayBuffer.add_blocks_batch). Fields stay on device end to
-        end; only the per-block accounting scalars are host-side."""
+        """Write E collector-packed blocks round-robin across shards
+        (collect.DeviceCollector contract, mirroring
+        DeviceReplayBuffer.add_blocks_batch). The first floor(E/dp)*dp
+        blocks land as one shard_map slab write — each device updates its
+        local store region, no collectives; the remainder goes through the
+        single-slot write. Fields stay on device end to end; only the
+        per-block accounting scalars are host-side. Dealing continues the
+        round-robin cursor from the previous add, exactly like E
+        sequential add_block calls (pinned by test)."""
         E = len(num_seq)
         bps = self.blocks_per_shard
-        if E > self.dp * bps:
-            raise ValueError(f"{E} blocks per batch exceeds {self.dp * bps} slots")
+        dp = self.dp
+        if E > dp * bps:
+            raise ValueError(f"{E} blocks per batch exceeds {dp * bps} slots")
+        per = E // dp
+        Em = per * dp  # slab-written prefix; blocks Em..E-1 write singly
         with self.lock:
-            shard_ids = [(self._rr + i) % self.dp for i in range(E)]
-            # hold EVERY affected shard's lock across write + account
-            # (ascending order; other paths only ever hold one at a time):
-            # a sampler draw between the scatter and the accounting would
-            # pair new slot data with the evicted blocks' tree state —
-            # add_block's single-shard lock gives the same guarantee
-            locks = [self.shards[sid].lock for sid in sorted(set(shard_ids))]
+            rr = self._rr  # block i -> shard (rr + i) % dp
+            # hold EVERY shard's lock across write + account (ascending
+            # order; other paths only ever hold one at a time): a sampler
+            # draw between the slab write and the accounting would pair new
+            # slot data with the evicted blocks' tree state — add_block's
+            # single-shard lock gives the same guarantee
+            locks = [sh.lock for sh in self.shards]
             for lk in locks:
                 lk.acquire()
             try:
-                # destination slots BEFORE accounting mutates the pointers
-                # (write first, account last — same contract as add_block)
-                sim = {sid: self.shards[sid].block_ptr for sid in set(shard_ids)}
-                ptrs = np.empty(E, np.int64)
-                for i, sid in enumerate(shard_ids):
-                    ptrs[i] = sid * bps + sim[sid]
-                    sim[sid] = (sim[sid] + 1) % bps
-                self.stores = self._write_batch(
-                    self.stores, jnp.asarray(ptrs, jnp.int32), fields
-                )
-                for i, sid in enumerate(shard_ids):
-                    self.shards[sid]._account_add(
+                if Em:
+                    # destination slots BEFORE accounting mutates the
+                    # pointers (write first, account last — same contract
+                    # as add_block)
+                    starts = np.asarray(
+                        [sh._reserve_contiguous(per) for sh in self.shards],
+                        np.int64,
+                    )
+                    slab_fields = {k: v[:Em] for k, v in fields.items()}
+                    self.stores = self._write_slabs(
+                        self.stores, jnp.asarray(starts, jnp.int32),
+                        jnp.int32(rr), slab_fields,
+                    )
+                    # block i lands at local slot starts[(rr+i)%dp] + i//dp;
+                    # accounting in ascending i matches that order per shard
+                    for i in range(Em):
+                        self.shards[(rr + i) % dp]._account_add(
+                            int(num_seq[i]),
+                            int(learning_totals[i]),
+                            priorities[i],
+                            float(episode_rewards[i]) if dones[i] else None,
+                        )
+                for j in range(E - Em):
+                    i = Em + j
+                    sid = (rr + j) % dp  # Em is a multiple of dp
+                    shard = self.shards[sid]
+                    gptr = sid * bps + shard.block_ptr
+                    self.stores = self._write(
+                        self.stores, gptr, {k: v[i] for k, v in fields.items()}
+                    )
+                    shard._account_add(
                         int(num_seq[i]),
                         int(learning_totals[i]),
                         priorities[i],
                         float(episode_rewards[i]) if dones[i] else None,
                     )
-                self._rr = (self._rr + E) % self.dp
+                self._rr = (rr + E) % dp
             finally:
                 for lk in reversed(locks):
                     lk.release()
@@ -218,11 +285,12 @@ class ShardedDeviceReplay:
         batch-global minimum priority so the sharded draw matches the
         single-tree semantics."""
         bs, ss, idxs, prios = [], [], [], []
-        old_ptrs = []
+        old_ptrs, old_advances = [], []
         for shard in self.shards:
             with shard.lock:
                 b, s, idxes, _w = shard._draw(rng)
                 old_ptrs.append(shard.block_ptr)
+                old_advances.append(shard.ptr_advances)
                 # read priorities under the SAME lock as the draw — an
                 # interleaved add_block would rewrite these leaves and the
                 # weights would no longer describe the drawn sample
@@ -241,19 +309,25 @@ class ShardedDeviceReplay:
             is_weights=w.astype(np.float32),
             idxes=np.stack(idxs),
             old_ptrs=old_ptrs,
+            old_advances=old_advances,
             env_steps=self.env_steps,
         )
 
     # ------------------------------------------------------------ round trip
 
     def update_priorities(
-        self, idxes: np.ndarray, td_errors: np.ndarray, old_ptrs: List[int]
+        self,
+        idxes: np.ndarray,
+        td_errors: np.ndarray,
+        old_ptrs: List[int],
+        old_advances: Optional[List[int]] = None,
     ) -> None:
         """idxes/td_errors: (dp, B/dp) as returned by sample/train."""
-        for shard, idx_row, td_row, old_ptr in zip(
-            self.shards, idxes, np.asarray(td_errors), old_ptrs
+        advances = old_advances if old_advances is not None else [None] * self.dp
+        for shard, idx_row, td_row, old_ptr, old_adv in zip(
+            self.shards, idxes, np.asarray(td_errors), old_ptrs, advances
         ):
-            shard.update_priorities(idx_row, td_row, old_ptr)
+            shard.update_priorities(idx_row, td_row, old_ptr, old_adv)
 
     # ------------------------------------------------------------- dispatch
 

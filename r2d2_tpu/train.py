@@ -105,12 +105,12 @@ class _HostPlane:
             dev = DeviceBatch.from_sampled(b)
             if self.tr.mesh is not None:
                 dev = DeviceBatch(*shard_batch(self.tr.mesh, tuple(dev)))
-            return "batch", dev, b.idxes, b.old_ptr
+            return "batch", dev, b.idxes, (b.old_ptr, b.old_advances)
 
     def update(self, state, item):
-        _, dev, idxes, old_ptr = item
+        _, dev, idxes, (old_ptr, old_adv) = item
         state, m, priorities = self.step_fn(state, dev)
-        self.replay.update_priorities(idxes, np.asarray(priorities), old_ptr)
+        self.replay.update_priorities(idxes, np.asarray(priorities), old_ptr, old_adv)
         return state, m
 
 
@@ -145,10 +145,11 @@ class _DevicePlane:
         with span("replay/sample"):
             si = self.replay.sample_indices(self.tr.sample_rng)
             coords = (jax.device_put(si.b), jax.device_put(si.s), jax.device_put(si.is_weights))
+            stamp = (si.old_ptr, si.old_advances)
             if pipelined:
                 batch = self.replay.run_with_stores(lambda stores: self.gather_fn(stores, *coords))
-                return "batch", batch, si.idxes, si.old_ptr
-            return "coords", coords, si.idxes, si.old_ptr
+                return "batch", batch, si.idxes, stamp
+            return "coords", coords, si.idxes, stamp
 
     def _multi_update(self, state):
         """K updates in one dispatch: draw + dispatch under one lock hold
@@ -191,10 +192,13 @@ class _DevicePlane:
             return
         prios, draws = pending
         for row, d in zip(np.asarray(prios), draws):
-            self.replay.update_priorities(d.idxes, row, d.old_ptr)
+            # old_advances: a free-running collector could lap the whole
+            # ring while this chunk's readback was deferred — the stamp
+            # drops the batch instead of mis-applying it (control_plane)
+            self.replay.update_priorities(d.idxes, row, d.old_ptr, d.old_advances)
 
     def update(self, state, item):
-        kind, payload, idxes, old_ptr = item
+        kind, payload, idxes, stamp = item
         if kind == "multi":
             return self._multi_update(state)
         if kind == "batch":
@@ -203,7 +207,8 @@ class _DevicePlane:
             state, m, priorities = self.replay.run_with_stores(
                 lambda stores: self.step_fn(state, stores, *payload)
             )
-        self.replay.update_priorities(idxes, np.asarray(priorities), old_ptr)
+        old_ptr, old_adv = stamp
+        self.replay.update_priorities(idxes, np.asarray(priorities), old_ptr, old_adv)
         return state, m
 
 
@@ -228,13 +233,14 @@ class _ShardedPlane:
         with span("replay/sample"):
             si = self.replay.sample_indices(self.tr.sample_rng)
             coords = (jnp.asarray(si.b), jnp.asarray(si.s), jnp.asarray(si.is_weights))
+            stamp = (si.old_ptrs, si.old_advances)
             if pipelined:
                 batch = self.replay.run_with_stores(lambda stores: self.gather_fn(stores, *coords))
-                return "batch", batch, si.idxes, si.old_ptrs
-            return "coords", coords, si.idxes, si.old_ptrs
+                return "batch", batch, si.idxes, stamp
+            return "coords", coords, si.idxes, stamp
 
     def update(self, state, item):
-        kind, payload, idxes, old_ptrs = item
+        kind, payload, idxes, (old_ptrs, old_adv) = item
         if kind == "batch":
             # gathered batch is dp-sharded; plain jit inserts the grad psum
             state, m, priorities = self.batch_step_fn(state, payload)
@@ -244,7 +250,7 @@ class _ShardedPlane:
                 lambda stores: self.step_fn(state, stores, *payload)
             )
             priorities = np.asarray(priorities)
-        self.replay.update_priorities(idxes, priorities, old_ptrs)
+        self.replay.update_priorities(idxes, priorities, old_ptrs, old_adv)
         return state, m
 
 
@@ -411,10 +417,10 @@ class Trainer:
 
     # ------------------------------------------------------------- plumbing
 
-    def _one_update(self, item):
-        # start the trace AFTER the first update: update 1 compiles the
-        # jitted step, and a trace dominated by XLA compile time defeats
-        # the point (steady-state pipeline shape)
+    def _profile_gate(self) -> None:
+        """Start the trace AFTER the first update: update 1 compiles the
+        jitted step, and a trace dominated by XLA compile time defeats the
+        point (steady-state pipeline shape)."""
         if (
             self._profile_remaining > 0
             and not self._profile_active
@@ -422,17 +428,27 @@ class Trainer:
         ):
             jax.profiler.start_trace(self.profile_dir)
             self._profile_active = True
+
+    def _profile_tick(self, n: int) -> None:
+        if self._profile_active:
+            self._profile_remaining -= n
+            if self._profile_remaining <= 0:
+                self._stop_profile()
+
+    def _one_update(self, item):
+        self._profile_gate()
         prev = self._step
         with step_span("learner_update", prev):
             self.state, m = self.plane.update(self.state, item)
         self._step += self.plane.steps_per_update
         step = self._step
-        if self._profile_active:
-            self._profile_remaining -= self.plane.steps_per_update
-            if self._profile_remaining <= 0:
-                self._stop_profile()
-        # interval CROSSINGS, not equality: a K-update dispatch may jump
-        # past the exact multiple
+        self._profile_tick(self.plane.steps_per_update)
+        self._cadences(prev, step)
+        return m, step
+
+    def _cadences(self, prev: int, step: int) -> None:
+        """Publish/checkpoint interval CROSSINGS, not equality: a K-update
+        dispatch may jump past the exact multiple."""
         if step // self.cfg.publish_interval > prev // self.cfg.publish_interval:
             self.param_store.publish(self.state.params)
         if step // self.cfg.save_interval > prev // self.cfg.save_interval:
@@ -445,7 +461,6 @@ class Trainer:
                 self._global_env_steps(),
                 self.wall_minutes_offset + (time.time() - self._start_time) / 60.0,
             )
-        return m, step
 
     def _global_env_steps(self) -> int:
         """Run-total env steps. replay.env_steps is host-local on the
@@ -523,13 +538,31 @@ class Trainer:
     # ---------------------------------------------------------------- modes
 
     def warmup(self, max_steps: Optional[int] = None) -> None:
-        """Collect until sampling opens (reference worker.py:150)."""
+        """Collect until sampling opens (reference worker.py:150).
+
+        Stall guard: batched ring writes shrink effective capacity to
+        floor(num_blocks/E)*E slots (ReplayControlPlane._reserve_contiguous
+        retires the tail), and episode-aligned chunks store fewer than
+        block_length transitions per slot — so a learning_starts that
+        exceeds what the ring can actually hold would loop here forever.
+        Once enough transitions to fill the ring twice over have been
+        inserted without sampling opening, the replay has provably
+        saturated below learning_starts: raise instead of spinning."""
         steps = 0
+        saturation = 2 * self.cfg.buffer_capacity + self.cfg.learning_starts
         while not self.replay.can_sample():
             self.actor.step()
             steps += self.actor.steps_per_call
             if max_steps is not None and steps >= max_steps:
                 raise RuntimeError("warmup exceeded max_steps without filling replay")
+            if steps >= saturation:
+                raise RuntimeError(
+                    f"replay saturated at {len(self.replay)} transitions, below "
+                    f"learning_starts={self.cfg.learning_starts}: the ring's "
+                    "effective capacity (tail retirement for batched writes, "
+                    "short-episode blocks) cannot reach the sampling gate — "
+                    "lower learning_starts or grow buffer_capacity"
+                )
 
     def run_inline(self, env_steps_per_update: Optional[int] = None) -> None:
         """Strict alternation: k env steps, one update (SURVEY.md 7.2)."""
@@ -633,13 +666,73 @@ class Trainer:
             if cfg.snapshot_replay:
                 self._snapshot_on_exit()
 
+    def run_fused(self, collect_every: Optional[int] = None) -> None:
+        """Fused actor-learner loop: ONE dispatch per iteration runs K
+        updates plus (every collect_every'th dispatch) a full collection
+        chunk and its store scatter (megastep.py). No worker threads: the
+        host only does sum-tree bookkeeping between dispatches.
+
+        collect_every=None paces collection from cfg.samples_per_insert on
+        ACTUAL consumed/inserted counters (the threaded pacer's rule);
+        samples_per_insert == 0 collects every dispatch. An explicit
+        collect_every overrides both."""
+        cfg = self.cfg
+        if cfg.collector != "device" or cfg.replay_plane != "device":
+            raise ValueError(
+                "run_fused needs collector='device' and replay_plane='device' "
+                f"(got {cfg.collector!r}, {cfg.replay_plane!r})"
+            )
+        from r2d2_tpu.megastep import FusedSystemRunner
+
+        self._start_time = time.time()
+        self.warmup()
+        runner = FusedSystemRunner(
+            cfg,
+            self.net,
+            self.fn_env,
+            self.replay,
+            self.actor.epsilons,
+            self.actor.env_state,
+            self.actor.key,
+            collect_every=1 if collect_every is None else collect_every,
+            chunk_len=self.actor.chunk,
+            sample_rng=self.sample_rng,
+            samples_per_insert=cfg.samples_per_insert if collect_every is None else 0.0,
+        )
+        try:
+            while self._step < cfg.training_steps:
+                self._profile_gate()
+                prev = self._step
+                with step_span("fused_megastep", prev):
+                    self.state, m, recorded = runner.step(self.state)
+                self._step += cfg.updates_per_dispatch
+                self._profile_tick(cfg.updates_per_dispatch)
+                self._cadences(prev, self._step)
+                # log on collect dispatches only: reading the metrics floats
+                # syncs on the dispatch just issued, and collect dispatches
+                # already block on the chunk bookkeeping readback — the
+                # update-only dispatches stay fire-and-forget
+                if recorded:
+                    self._log(m, self._step)
+        finally:
+            self._stop_profile()
+            runner.finish()
+            # hand the collector loop state back so a later warmup/eval on
+            # this Trainer continues from consistent episodes
+            self.actor.env_state, self.actor.key = runner.env_state, runner.key
+            self.actor.total_steps += runner.total_env_steps
+            if cfg.snapshot_replay:
+                self._snapshot_on_exit()
+
 
 def main(argv=None):
     p = argparse.ArgumentParser(description="r2d2_tpu trainer")
     p.add_argument("--preset", default="atari", choices=sorted(PRESETS))
     p.add_argument("--env", default=None, help="override env name (e.g. catch)")
     p.add_argument("--steps", type=int, default=None)
-    p.add_argument("--mode", default="threaded", choices=["threaded", "inline"])
+    p.add_argument("--mode", default="threaded", choices=["threaded", "inline", "fused"],
+                   help="fused: one dispatch = K updates + collection chunk "
+                        "(collector='device' + replay 'device' only)")
     p.add_argument("--replay", default=None,
                    choices=["host", "device", "sharded", "multihost"],
                    help="replay data plane (default: preset's replay_plane)")
@@ -685,6 +778,8 @@ def main(argv=None):
         overrides["metrics_path"] = args.metrics
     if args.replay:
         overrides["replay_plane"] = args.replay
+    if args.mode == "fused" and args.collector is None:
+        args.collector = "device"  # the only collector run_fused supports
     if args.collector:
         overrides["collector"] = args.collector
         if args.collector == "device" and args.replay is None:
@@ -720,6 +815,8 @@ def main(argv=None):
     )
     if args.mode == "inline":
         trainer.run_inline()
+    elif args.mode == "fused":
+        trainer.run_fused()
     else:
         trainer.run_threaded()
 

@@ -1,0 +1,214 @@
+"""megastep.py: the fused collect+learn dispatch.
+
+The load-bearing claim is EXACT equivalence with the separate-dispatch
+path: a megastep must produce bit-identical train state, update
+priorities, store contents, and chunk bookkeeping as (a) K fused updates
+on the same coordinates followed by (b) a collection chunk with the same
+key appended via add_blocks_batch. On CPU both paths are deterministic,
+so the comparison is exact.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2_tpu.collect import DeviceCollector, make_collect_fn
+from r2d2_tpu.config import tiny_test
+from r2d2_tpu.envs.catch import CatchEnv
+from r2d2_tpu.learner import init_train_state, make_fused_multi_train_step
+from r2d2_tpu.megastep import FusedSystemRunner, make_megastep
+from r2d2_tpu.ops.epsilon import epsilon_ladder
+from r2d2_tpu.replay.device_store import DeviceReplayBuffer
+
+
+K = 3
+
+
+def _cfg():
+    return tiny_test().replace(
+        env_name="catch",
+        obs_shape=(10, 8, 1),
+        action_dim=3,
+        num_actors=4,
+        max_episode_steps=8,
+        block_length=16,
+        buffer_capacity=640,
+        learning_starts=32,
+        collector="device",
+        replay_plane="device",
+        updates_per_dispatch=K,
+        training_steps=4 * K,
+        target_net_update_interval=2,  # exercise in-jit sync inside the scan
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = _cfg()
+    fn_env = CatchEnv(height=cfg.obs_shape[0], width=cfg.obs_shape[1])
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    return cfg, fn_env, net, state
+
+
+def _filled_replay(cfg, net, state, fn_env, seed=7):
+    """A replay pre-filled by the real device collector."""
+    replay = DeviceReplayBuffer(cfg)
+
+    class _Params:
+        def latest(self):
+            return state.params, 0
+
+    col = DeviceCollector(cfg, net, _Params(), fn_env, replay, seed=seed)
+    while not replay.can_sample():
+        col.step()
+    return replay, col
+
+
+def test_megastep_equals_separate_dispatches(setup):
+    cfg, fn_env, net, state = setup
+    E, chunk = cfg.num_actors, min(cfg.block_length, cfg.max_episode_steps)
+
+    # identical starting replay contents for both paths
+    replay_a, col_a = _filled_replay(cfg, net, state, fn_env)
+    replay_b, col_b = _filled_replay(cfg, net, state, fn_env)
+    np.testing.assert_array_equal(
+        np.asarray(replay_a.stores["obs"]), np.asarray(replay_b.stores["obs"])
+    )
+    assert replay_a.block_ptr == replay_b.block_ptr
+
+    # same coordinate draws for both paths
+    draws = [replay_a._draw_sample_idx(np.random.default_rng(11)) for _ in range(K)]
+    b = jnp.asarray(np.stack([d.b for d in draws]))
+    s = jnp.asarray(np.stack([d.s for d in draws]))
+    w = jnp.asarray(np.stack([d.is_weights for d in draws]))
+    key = jax.random.PRNGKey(99)
+    env_state = col_a.env_state
+    eps = col_a.epsilons
+
+    # path A: one fused megastep (no donation: inputs are reused below)
+    mega = make_megastep(cfg, net, fn_env, E, chunk, K, donate=False)
+    with replay_a.lock:
+        ptr0 = replay_a._reserve_contiguous(E)
+    (st_a, stores_a, m_a, prios_a, chunk_host_a, env_a, key_a) = mega(
+        state, replay_a.stores, env_state, eps, key, b, s, w, jnp.int32(ptr0)
+    )
+
+    # path B: K-update dispatch, then collect, then scatter via the store
+    multi = make_fused_multi_train_step(cfg, net, K, donate=False)
+    st_b, m_b, prios_b = multi(state, replay_b.stores, b, s, w)
+    collect = make_collect_fn(cfg, net, fn_env, E, chunk)
+    (fields, c_prios, num_seq, sizes, dones, ep_rew, env_b, key_b) = collect(
+        state.params, env_state, eps, key
+    )
+    replay_b.add_blocks_batch(
+        fields, np.asarray(num_seq), np.asarray(sizes), np.asarray(c_prios),
+        np.asarray(ep_rew), np.asarray(dones),
+    )
+
+    jax.tree.map(
+        np.testing.assert_array_equal, jax.tree.map(np.asarray, st_a.params),
+        jax.tree.map(np.asarray, st_b.params),
+    )
+    np.testing.assert_array_equal(np.asarray(prios_a), np.asarray(prios_b))
+    np.testing.assert_array_equal(np.asarray(m_a["loss"]), np.asarray(m_b["loss"]))
+    for k in replay_b.stores:
+        np.testing.assert_array_equal(np.asarray(stores_a[k]), np.asarray(replay_b.stores[k]))
+    np.testing.assert_array_equal(np.asarray(chunk_host_a[0]), np.asarray(c_prios))
+    np.testing.assert_array_equal(np.asarray(chunk_host_a[2]), np.asarray(sizes))
+    np.testing.assert_array_equal(np.asarray(key_a), np.asarray(key_b))
+    jax.tree.map(
+        np.testing.assert_array_equal, jax.tree.map(np.asarray, env_a),
+        jax.tree.map(np.asarray, env_b),
+    )
+
+
+def test_runner_accounts_and_masks_staleness(setup):
+    cfg, fn_env, net, state = setup
+    replay, col = _filled_replay(cfg, net, state, fn_env)
+    ptr0, size0 = replay.block_ptr, len(replay)
+    step0 = int(state.step)
+    state = jax.tree.map(jnp.copy, state)  # runner donates its input state
+    runner = FusedSystemRunner(
+        cfg, net, fn_env, replay, col.epsilons, col.env_state, col.key,
+        collect_every=2, sample_rng=np.random.default_rng(5),
+    )
+    state2, m, recorded = runner.step(state)  # dispatch 0: collects
+    assert recorded > 0
+    assert replay.block_ptr == (ptr0 + cfg.num_actors) % cfg.num_blocks
+    assert replay.env_steps == size0 + recorded  # accounting landed
+    state3, m2, recorded2 = runner.step(state2)  # dispatch 1: updates only
+    assert recorded2 == 0
+    assert replay.block_ptr == (ptr0 + cfg.num_actors) % cfg.num_blocks
+    assert int(state3.step) == step0 + 2 * K
+    assert np.isfinite(float(m2["loss"]))
+
+
+def test_reserve_contiguous_retires_tail_slots():
+    """An E-batch writer's pointer cycle repeats every lap, so the ring
+    tail (num_blocks % E slots) would hold frozen never-evicted blocks —
+    _reserve_contiguous must retire them: priorities zeroed, transitions
+    out of the size accounting, slots marked free."""
+    from r2d2_tpu.replay.control_plane import ReplayControlPlane
+
+    cfg = _cfg()  # 40 block slots
+    nb, S = cfg.num_blocks, cfg.seqs_per_block
+    plane = ReplayControlPlane(cfg)
+    prios = np.ones(S, np.float32)
+    for _ in range(nb):  # fill the whole ring
+        plane._account_add(S, 10, prios, None)
+    assert plane.size == nb * 10
+    full_total = plane.tree.total
+
+    E = 16  # nb % E == 8: slots [32, 40) are the stranded tail
+    with plane.lock:
+        start = plane._reserve_contiguous(E)  # ptr 0: no wrap
+    assert start == 0
+    plane.block_ptr = 2 * E  # as after two batch writes
+    with plane.lock:
+        start = plane._reserve_contiguous(E)  # 32 + 16 > 40: wrap + retire
+    assert start == 0
+    tail = np.arange(2 * E, nb)
+    assert not plane.occupied[tail].any()
+    assert plane.size == (nb - len(tail)) * 10
+    # the tail's tree leaves are zero: it can never be sampled again
+    leaf = plane.tree.priorities_of((tail[:, None] * S + np.arange(S)).ravel())
+    np.testing.assert_array_equal(leaf, 0.0)
+    assert plane.tree.total < full_total
+
+
+def test_warmup_raises_on_saturated_replay():
+    """learning_starts beyond the ring's effective capacity (short-episode
+    blocks, batched-write tail retirement) must raise, not spin forever."""
+    from r2d2_tpu.train import Trainer
+
+    cfg = _cfg().replace(
+        # 40 slots x at most 8-step catch episodes = 320 effective
+        # transitions; the gate can never open
+        learning_starts=400,
+    )
+    tr = Trainer(cfg)
+    with pytest.raises(RuntimeError, match="saturated"):
+        tr.warmup()
+
+
+def test_trainer_run_fused_end_to_end(tmp_path):
+    cfg = _cfg().replace(
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        metrics_path=str(tmp_path / "metrics.jsonl"),
+        collector="device",
+        replay_plane="device",
+        save_interval=K,
+    )
+    from r2d2_tpu.train import Trainer
+
+    tr = Trainer(cfg)
+    tr.run_fused()
+    assert tr._step >= cfg.training_steps
+    assert int(np.asarray(tr.state.step)) == tr._step
+    # checkpoint cadence crossed at least once
+    from r2d2_tpu.utils.checkpoint import latest_checkpoint_step
+
+    assert latest_checkpoint_step(cfg.checkpoint_dir) is not None
+    # the collector hand-back leaves a consistent actor
+    assert tr.actor.total_steps > 0
