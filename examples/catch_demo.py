@@ -6,11 +6,13 @@ catch, IMPALA encoder, 128-hidden LSTM, bf16, on-device collection (E=64
 envs in one jitted scan), HBM replay, K=8 fused learner dispatches.
 
 --full switches to the flagship Atari-scale system (84x84, Nature trunk,
-512-hidden LSTM — the bench.py configuration). That scale learns too, but
-value propagation across 82-step episodes from a terminal-only reward
-needs tens of thousands of updates (the reference budgets 100k,
-config.py:15), far past a minutes-scale demo; run it with --steps 50000+
-and --resume across sessions.
+512-hidden LSTM — the bench.py configuration). Value propagation across
+82-step episodes from a terminal-only reward needs tens of thousands of
+updates (the reference budgets 100k, config.py:15); `--full
+--steps 100000 --mode fused` runs that complete budget in ~1 h on one v5e chip and
+converges to a perfect eval score (1.0 held from 75k updates on —
+runs/catch_full2/). Use --resume to continue across sessions and
+--mode fused for the single-dispatch-stream loop.
 
     python examples/catch_demo.py --out runs/catch_demo
 
@@ -86,6 +88,12 @@ def main():
                    help="flagship Atari-scale config (needs --steps 50000+)")
     p.add_argument("--resume", action="store_true",
                    help="continue from the checkpoints under --out")
+    p.add_argument("--mode", default="threaded", choices=["threaded", "fused"],
+                   help="fused: single-threaded megastep loop (one dispatch "
+                        "= K updates + collection chunk) — no concurrent "
+                        "dispatch streams, which also sidesteps tunnel-"
+                        "backend transfer wedges observed under the "
+                        "threaded mode's three streams")
     args = p.parse_args()
     os.makedirs(args.out, exist_ok=True)
 
@@ -94,8 +102,15 @@ def main():
     from r2d2_tpu.train import Trainer
 
     cfg = demo_config(args.out, args.steps, args.actors, args.full)
+    if args.mode == "fused":
+        # pace collection to the threaded run's observed consumed:inserted
+        # ratio instead of collecting every dispatch
+        cfg = cfg.replace(samples_per_insert=15.0)
     trainer = Trainer(cfg, resume=args.resume)
-    trainer.run_threaded()
+    if args.mode == "fused":
+        trainer.run_fused()
+    else:
+        trainer.run_threaded()
 
     h = cfg.obs_shape[0]
     reward_fn = None
