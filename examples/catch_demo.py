@@ -100,6 +100,7 @@ def main():
     from r2d2_tpu.envs.catch import CatchVecEnv
     from r2d2_tpu.evaluate import evaluate_series, plot_series
     from r2d2_tpu.train import Trainer
+    from r2d2_tpu.utils.supervision import WorkerStalledError, exit_for_stall
 
     cfg = demo_config(args.out, args.steps, args.actors, args.full)
     if args.mode == "fused":
@@ -107,10 +108,15 @@ def main():
         # ratio instead of collecting every dispatch
         cfg = cfg.replace(samples_per_insert=15.0)
     trainer = Trainer(cfg, resume=args.resume)
-    if args.mode == "fused":
-        trainer.run_fused()
-    else:
-        trainer.run_threaded()
+    try:
+        if args.mode == "fused":
+            trainer.run_fused()
+        else:
+            trainer.run_threaded()
+    except WorkerStalledError as e:
+        # wedged runtime: exit promptly with the restart-with---resume code
+        # (same CLI contract as r2d2_tpu.train.main)
+        exit_for_stall(e)
 
     h = cfg.obs_shape[0]
     reward_fn = None

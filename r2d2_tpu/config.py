@@ -110,9 +110,12 @@ class R2D2Config:
     # --- infra ------------------------------------------------------------
     seed: int = 0
     # supervision (utils/supervision.py): restart budget per worker thread
-    # and seconds of silent heartbeat before a stall is reported
+    # and seconds of silent heartbeat before a stall is reported; a stall
+    # beyond stall_fatal_timeout fails the run loudly (a wedged thread
+    # cannot be recovered in-process — restart with --resume; 0 disables)
     worker_max_restarts: int = 3
     heartbeat_timeout: float = 120.0
+    stall_fatal_timeout: float = 900.0
     checkpoint_dir: str = "checkpoints"
     # persist replay contents (replay/snapshot.py) at end of run and
     # restore them on --resume: a resumed run continues from the SAME

@@ -19,10 +19,12 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
 from typing import List, Optional, Sequence
 
 from r2d2_tpu.config import PRESETS, R2D2Config
+from r2d2_tpu.utils.supervision import WorkerStalledError
 
 # The canonical 57-game ALE suite (Bellemare et al. ALE benchmark set, as
 # used by the R2D2 paper's Atari-57 evaluation).
@@ -117,14 +119,22 @@ def main(argv=None):
     unknown = [g for g in games if g not in ATARI_57]
     if unknown and not args.allow_any_env:
         p.error(f"not in the Atari-57 suite: {unknown} (--allow-any-env to override)")
-    run_sweep(
-        games,
-        preset=args.preset,
-        root=args.root,
-        steps=args.steps,
-        mode=args.mode,
-        resume=args.resume,
-    )
+    try:
+        run_sweep(
+            games,
+            preset=args.preset,
+            root=args.root,
+            steps=args.steps,
+            mode=args.mode,
+            resume=args.resume,
+        )
+    except WorkerStalledError as e:
+        # same CLI contract as train.main: a wedged runtime exits with
+        # STALL_EXIT_CODE so an external supervisor restarts the sweep
+        # with --resume instead of treating it as an ordinary crash
+        from r2d2_tpu.utils.supervision import exit_for_stall
+
+        exit_for_stall(e)
 
 
 if __name__ == "__main__":

@@ -26,6 +26,7 @@ from __future__ import annotations
 import argparse
 import os
 import queue
+import sys
 import time
 from typing import Callable, Optional
 
@@ -56,7 +57,7 @@ from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
 from r2d2_tpu.utils.checkpoint import latest_checkpoint_step, restore_checkpoint, save_checkpoint
 from r2d2_tpu.utils.metrics import MetricsLogger
 from r2d2_tpu.utils.profiling import span, start_profiler_server, step_span
-from r2d2_tpu.utils.supervision import Supervisor
+from r2d2_tpu.utils.supervision import Supervisor, WorkerStalledError
 
 
 def build_vec_env(cfg: R2D2Config, seed: int = 0):
@@ -537,8 +538,12 @@ class Trainer:
 
     # ---------------------------------------------------------------- modes
 
-    def warmup(self, max_steps: Optional[int] = None) -> None:
+    def warmup(
+        self, max_steps: Optional[int] = None, beat: Optional[Callable[[], None]] = None
+    ) -> None:
         """Collect until sampling opens (reference worker.py:150).
+        `beat` (e.g. Supervisor.main_beat) is stamped between collection
+        steps so an armed watchdog covers the warmup phase too.
 
         Stall guard: batched ring writes shrink effective capacity to
         floor(num_blocks/E)*E slots (ReplayControlPlane._reserve_contiguous
@@ -552,6 +557,8 @@ class Trainer:
         saturation = 2 * self.cfg.buffer_capacity + self.cfg.learning_starts
         while not self.replay.can_sample():
             self.actor.step()
+            if beat is not None:
+                beat()
             steps += self.actor.steps_per_call
             if max_steps is not None and steps >= max_steps:
                 raise RuntimeError("warmup exceeded max_steps without filling replay")
@@ -572,18 +579,26 @@ class Trainer:
         # one dispatch is steps_per_update learner updates: scale collection
         # so the env-step : update ratio the caller asked for is preserved
         k *= self.plane.steps_per_update
-        self.warmup()
-        try:
-            while self._step < cfg.training_steps:
-                for _ in range(max(k // self.actor.steps_per_call, 1)):
-                    self.actor.step()
-                m, step = self._one_update(self.plane.sample())
-                self._log(m, step)
-        finally:
-            self._stop_profile()
-            self.finish_updates()
-            if cfg.snapshot_replay:
-                self._snapshot_on_exit()
+        # single-threaded loop: the main-thread watchdog is the only stall
+        # protection (utils/supervision.py — hard-exits a wedged process)
+        sup = self._sup = self._make_supervisor()
+        with sup.armed_watchdog():
+            self.warmup(beat=sup.main_beat)
+            try:
+                while self._step < cfg.training_steps:
+                    sup.main_beat()
+                    for _ in range(max(k // self.actor.steps_per_call, 1)):
+                        self.actor.step()
+                    m, step = self._one_update(self.plane.sample())
+                    self._log(m, step)
+            finally:
+                # watchdog off before the drain: cleanup must not count as
+                # a stall
+                sup.stop.set()
+                self._stop_profile()
+                self.finish_updates()
+                if cfg.snapshot_replay:
+                    self._snapshot_on_exit()
 
     def run_threaded(self) -> None:
         """Actor thread + prefetch thread + learner loop (reference
@@ -593,10 +608,31 @@ class Trainer:
         instead of silently starving the learner (SURVEY.md section 5.3)."""
         cfg = self.cfg
         self._start_time = time.time()
-        self.warmup()
-
         batch_q: "queue.Queue" = queue.Queue(maxsize=8)
-        sup = Supervisor(heartbeat_timeout=cfg.heartbeat_timeout)
+        sup = self._sup = self._make_supervisor()
+        with sup.armed_watchdog():
+            self._run_threaded_body(sup, batch_q)
+
+    def _make_supervisor(self) -> Supervisor:
+        return Supervisor(
+            heartbeat_timeout=self.cfg.heartbeat_timeout,
+            stall_fatal_timeout=self.cfg.stall_fatal_timeout,
+        )
+
+    def disarm_watchdog(self) -> None:
+        """For library callers that catch WorkerStalledError and keep the
+        process alive: the watchdog deliberately survives that unwind (it
+        guards against atexit hangs on the wedged backend), so it must be
+        disarmed explicitly before doing anything long-running."""
+        if getattr(self, "_sup", None) is not None:
+            self._sup.disarm()
+
+    def _run_threaded_body(self, sup: Supervisor, batch_q: "queue.Queue") -> None:
+        cfg = self.cfg
+        # armed BEFORE warmup (caller holds armed_watchdog): the warmup
+        # collection loop runs on the main thread against the same backend
+        # the watchdog guards
+        self.warmup(beat=sup.main_beat)
 
         spi = cfg.samples_per_insert
         # THIS-RUN, THIS-HOST accounting: inserts baseline at the current
@@ -642,8 +678,20 @@ class Trainer:
         sup.spawn("sampler", sampler_body, max_restarts=cfg.worker_max_restarts,
                   on_restart=sampler_recover)
         last_health: Optional[dict] = None
+
+        def cleanup():
+            # shutdown FIRST: it stops the main-thread watchdog, whose
+            # timeout must not count the (possibly minutes-long) priority
+            # drain and replay snapshot below as a "stall"
+            sup.shutdown()
+            self._stop_profile()
+            self.finish_updates()
+            if cfg.snapshot_replay:
+                self._snapshot_on_exit()
+
         try:
             while self._step < cfg.training_steps:
+                sup.main_beat()
                 try:
                     item = batch_q.get(timeout=2.0)
                 except queue.Empty:
@@ -659,12 +707,21 @@ class Trainer:
                 health = sup.check()
                 last_health = health
                 self._log(m, step, extra=health)
-        finally:
-            self._stop_profile()
-            sup.shutdown()
-            self.finish_updates()
-            if cfg.snapshot_replay:
-                self._snapshot_on_exit()
+        except WorkerStalledError:
+            # a wedged worker means the backend itself is suspect: any
+            # cleanup that blocks on device work (priority drain, profile
+            # sync, replay snapshot) would hang the very exit this error
+            # exists to force — skip it ALL, including Supervisor.shutdown
+            # (which would stop the main-thread watchdog: it must stay
+            # armed so a hang in interpreter-shutdown atexit hooks still
+            # gets hard-exited). Worker threads are daemons; the process
+            # is going down either way.
+            raise
+        except BaseException:
+            cleanup()
+            raise
+        else:
+            cleanup()
 
     def run_fused(self, collect_every: Optional[int] = None) -> None:
         """Fused actor-learner loop: ONE dispatch per iteration runs K
@@ -682,10 +739,20 @@ class Trainer:
                 "run_fused needs collector='device' and replay_plane='device' "
                 f"(got {cfg.collector!r}, {cfg.replay_plane!r})"
             )
+        self._start_time = time.time()
+        # main-thread watchdog: this loop has no worker threads, so a
+        # wedged device readback would hang it silently forever — the
+        # watchdog hard-exits (utils/supervision.STALL_EXIT_CODE) instead.
+        # Armed before warmup so the warmup collection is covered too.
+        sup = self._sup = self._make_supervisor()
+        with sup.armed_watchdog():
+            self._run_fused_body(sup, collect_every)
+
+    def _run_fused_body(self, sup: Supervisor, collect_every: Optional[int]) -> None:
+        cfg = self.cfg
         from r2d2_tpu.megastep import FusedSystemRunner
 
-        self._start_time = time.time()
-        self.warmup()
+        self.warmup(beat=sup.main_beat)
         runner = FusedSystemRunner(
             cfg,
             self.net,
@@ -701,6 +768,7 @@ class Trainer:
         )
         try:
             while self._step < cfg.training_steps:
+                sup.main_beat()
                 self._profile_gate()
                 prev = self._step
                 with step_span("fused_megastep", prev):
@@ -715,6 +783,8 @@ class Trainer:
                 if recorded:
                     self._log(m, self._step)
         finally:
+            # watchdog off before the drain: cleanup must not count as a stall
+            sup.stop.set()
             self._stop_profile()
             runner.finish()
             # hand the collector loop state back so a later warmup/eval on
@@ -813,12 +883,22 @@ def main(argv=None):
         profile_dir=args.profile_dir,
         profile_steps=args.profile_steps,
     )
-    if args.mode == "inline":
-        trainer.run_inline()
-    elif args.mode == "fused":
-        trainer.run_fused()
-    else:
-        trainer.run_threaded()
+    try:
+        if args.mode == "inline":
+            trainer.run_inline()
+        elif args.mode == "fused":
+            trainer.run_fused()
+        else:
+            trainer.run_threaded()
+    except WorkerStalledError as e:
+        # CLI contract: a wedged runtime exits with STALL_EXIT_CODE so an
+        # external supervisor can distinguish "restart with --resume" from
+        # an ordinary crash. (Library callers instead receive the
+        # exception; if they keep the process alive they must disarm via
+        # Trainer.disarm_watchdog or e.supervisor.disarm().)
+        from r2d2_tpu.utils.supervision import exit_for_stall
+
+        exit_for_stall(e)
 
 
 if __name__ == "__main__":

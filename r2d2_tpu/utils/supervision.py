@@ -7,7 +7,12 @@ buffer process. Here every host-side worker loop runs under a Supervisor:
 
 - each loop iteration stamps a heartbeat; a worker whose heartbeat goes
   stale past `heartbeat_timeout` is reported as stalled (Python threads
-  cannot be preempted, so stalls are surfaced, not killed);
+  cannot be preempted, so stalls are surfaced, not killed); a stall
+  beyond `stall_fatal_timeout` escalates to WorkerFatalError — observed
+  in practice when a tunneled-backend transfer wedges a thread inside a
+  device readback: the run would otherwise limp at a fraction of its
+  rate forever, where failing loudly lets an external restart with
+  --resume recover in minutes;
 - a worker that raises has its traceback printed and recorded, its
   `on_restart` recovery hook run (e.g. VectorizedActor.resync, which
   discards in-flight state that a mid-iteration fault may have left
@@ -24,11 +29,18 @@ full queue, a compiling learner — are retried across calls, not inside one.
 
 from __future__ import annotations
 
+import contextlib
+import os
 import sys
 import threading
 import time
 import traceback
 from typing import Callable, Dict, List, Optional
+
+# process exit code used by the main-thread watchdog: distinguishable from
+# crashes (1) and signals (>128) so external supervisors can map it to
+# "wedged runtime — restart with --resume"
+STALL_EXIT_CODE = 86
 
 
 class SupervisedWorker:
@@ -109,12 +121,119 @@ class WorkerFatalError(RuntimeError):
     pass
 
 
+class WorkerStalledError(WorkerFatalError):
+    """A worker thread is WEDGED (e.g. inside a device readback that never
+    returns). Distinct from a plain fatal crash because the device/backend
+    must be presumed unusable: exit paths should skip any cleanup that
+    would block on device work.
+
+    Carries `.supervisor` (set by Supervisor.check) so a catcher at ANY
+    layer can reach the still-armed watchdog: CLIs call exit_for_stall(e);
+    a library caller keeping the process alive calls e.supervisor.disarm().
+    """
+
+    supervisor: "Optional[Supervisor]" = None
+
+
+def exit_for_stall(e: WorkerStalledError) -> None:
+    """The CLI exit contract for a wedged runtime, in one place: print the
+    error and os._exit(STALL_EXIT_CODE) — skipping atexit hooks, whose
+    backend teardown would block on the same wedged device — so an
+    external supervisor maps the code to 'restart with --resume'."""
+    print(e, file=sys.stderr, flush=True)
+    os._exit(STALL_EXIT_CODE)
+
+
 class Supervisor:
-    def __init__(self, heartbeat_timeout: float = 120.0):
+    def __init__(
+        self,
+        heartbeat_timeout: float = 120.0,
+        stall_fatal_timeout: float = 900.0,
+        main_stall_headroom: float = 120.0,
+    ):
+        """stall_fatal_timeout: a worker stalled this long (stuck thread —
+        unkillable from Python) fails the run via check(); 0 disables.
+
+        main_stall_headroom: extra slack added to the MAIN-thread watchdog
+        threshold on top of stall_fatal_timeout — one main-loop beat
+        interval legitimately spans an entire XLA compile or checkpoint
+        write, which a worker heartbeat never does."""
         self.heartbeat_timeout = heartbeat_timeout
+        self.stall_fatal_timeout = stall_fatal_timeout
+        self.main_stall_headroom = main_stall_headroom
         self.workers: List[SupervisedWorker] = []
         self.stop = threading.Event()
         self._stall_reported: Dict[str, bool] = {}
+        self._main_beat = time.monotonic()
+
+    # --- main-thread watchdog -------------------------------------------
+    #
+    # check() escalates WORKER stalls, but it only runs from the main
+    # loop — which can itself wedge inside a device call (the observed
+    # tunnel fault can hit the learner's own readback just as easily as
+    # the actor's). The watchdog is a tiny daemon thread that hard-exits
+    # the process (os._exit, STALL_EXIT_CODE) when the main loop stops
+    # stamping main_beat() for stall_fatal_timeout: the wedged thread
+    # cannot be interrupted from Python, so a clean unwind is impossible
+    # by construction, and a loud fast death (restart with --resume) beats
+    # a run that silently hangs forever. Stopped by shutdown()/stop.
+
+    def main_beat(self) -> None:
+        self._main_beat = time.monotonic()
+
+    def disarm(self) -> None:
+        """Public disarm for the main-thread watchdog. A WorkerStalledError
+        unwind leaves the watchdog armed on purpose (to hard-exit a hang in
+        atexit teardown); a library caller that catches the error and
+        intends to keep the process alive MUST call this (via
+        Trainer.disarm_watchdog) — otherwise the watchdog will os._exit
+        the process once the timeout elapses."""
+        self.stop.set()
+
+    @contextlib.contextmanager
+    def armed_watchdog(self):
+        """Arm the main-thread watchdog for the enclosed block and disarm
+        it on every exit EXCEPT a WorkerStalledError unwind — there the
+        backend is presumed wedged and the watchdog must stay armed to
+        hard-exit a hang in interpreter-shutdown atexit hooks. The single
+        place that owns the arm/disarm lifecycle: run modes wrap their
+        warmup + loop + cleanup in this so an exception anywhere inside
+        (warmup saturation, a crashed worker, KeyboardInterrupt) cannot
+        leak an armed watchdog into a caller that catches it and lives on."""
+        self.start_main_watchdog()
+        try:
+            yield self
+        except WorkerStalledError:
+            raise
+        except BaseException:
+            self.stop.set()
+            raise
+        else:
+            self.stop.set()
+
+    def start_main_watchdog(self) -> None:
+        if self.stall_fatal_timeout <= 0:
+            return
+        self._main_beat = time.monotonic()
+        threading.Thread(
+            target=self._watchdog_loop, name="supervisor-watchdog", daemon=True
+        ).start()
+
+    def _watchdog_loop(self) -> None:
+        limit = self.stall_fatal_timeout + self.main_stall_headroom
+        poll = min(1.0, limit / 4)
+        while not self.stop.wait(poll):
+            stale = time.monotonic() - self._main_beat
+            if stale > limit:
+                print(
+                    f"[supervisor] MAIN thread stalled for {stale:.0f}s "
+                    f"(> {limit:.0f}s) — wedged inside a device call; "
+                    f"hard-exiting (code {STALL_EXIT_CODE}). Restart with "
+                    "--resume.",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                os._exit(STALL_EXIT_CODE)
 
     def spawn(
         self,
@@ -143,7 +262,27 @@ class Supervisor:
                     f"last error:\n{w.last_error}"
                 )
             restarts += w.restarts
-            if not self.stop.is_set() and w.stalled_for() > self.heartbeat_timeout:
+            stalled = w.stalled_for()
+            if (
+                not self.stop.is_set()
+                and self.stall_fatal_timeout > 0
+                and stalled > self.stall_fatal_timeout
+            ):
+                # deliberately does NOT set self.stop: the main-thread
+                # watchdog must stay armed through the exception unwind —
+                # interpreter-shutdown atexit hooks (backend teardown) can
+                # block on the same wedged device, and the watchdog is then
+                # the only thing left that can kill the process
+                err = WorkerStalledError(
+                    f"worker {w.name!r} stalled for {stalled:.0f}s "
+                    f"(> stall_fatal_timeout={self.stall_fatal_timeout:.0f}s) "
+                    "— likely wedged inside a device call; the thread "
+                    "cannot be recovered in-process. Restart the run "
+                    "with --resume."
+                )
+                err.supervisor = self
+                raise err
+            if not self.stop.is_set() and stalled > self.heartbeat_timeout:
                 stalls += 1
                 if not self._stall_reported.get(w.name):
                     self._stall_reported[w.name] = True

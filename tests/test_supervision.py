@@ -101,3 +101,103 @@ def test_fault_injected_actor_recovers():
     trainer.run_threaded()
     assert int(trainer.state.step) == cfg.training_steps
     assert vec_env._fired  # the fault actually triggered mid-run
+
+
+def test_stalled_worker_escalates_to_fatal():
+    """A thread wedged inside an unkillable call (observed: a tunneled-
+    backend device readback) must fail the run loudly past
+    stall_fatal_timeout instead of letting it limp forever."""
+    sup = Supervisor(heartbeat_timeout=0.2, stall_fatal_timeout=3.0)
+    release = threading.Event()
+    sup.spawn("wedged", release.wait)  # blocks indefinitely, no heartbeat
+    time.sleep(0.5)
+    stats = sup.check()  # stale but below fatal: surfaced, not raised
+    assert stats["worker_stalls"] == 1
+    time.sleep(3.0)
+    with pytest.raises(WorkerFatalError, match="stalled"):
+        sup.check()
+    release.set()
+    sup.shutdown()
+
+
+def test_stall_escalation_disabled_with_zero_timeout():
+    sup = Supervisor(heartbeat_timeout=0.05, stall_fatal_timeout=0.0)
+    release = threading.Event()
+    sup.spawn("wedged", release.wait)
+    time.sleep(0.4)
+    stats = sup.check()  # never escalates, only reports
+    assert stats["worker_stalls"] == 1
+    release.set()
+    sup.shutdown()
+
+
+class WedgingCatchVecEnv(CatchVecEnv):
+    """Blocks forever inside step() once `wedge_now` is set — models a
+    thread stuck in a device readback that never returns."""
+
+    wedge_now = False
+
+    def step(self, actions):
+        if self.wedge_now:
+            threading.Event().wait()  # never set: unkillable from Python
+        return super().step(actions)
+
+
+def test_run_threaded_exits_on_wedged_actor(tmp_path):
+    from r2d2_tpu.utils.supervision import WorkerStalledError
+
+    cfg = tiny_test().replace(
+        env_name="catch",
+        training_steps=10_000,  # far more than the wedge allows
+        learning_starts=48,
+        heartbeat_timeout=0.2,
+        stall_fatal_timeout=1.5,
+        save_interval=100_000,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    vec_env = WedgingCatchVecEnv(num_envs=cfg.num_actors, height=12, width=12, seed=0)
+    trainer = Trainer(cfg, vec_env=vec_env)
+    trainer.warmup()  # wedge only after sampling opens
+    vec_env.wedge_now = True
+    t0 = time.time()
+    try:
+        with pytest.raises(WorkerStalledError, match="stalled"):
+            trainer.run_threaded()
+        # exit skipped device-blocking cleanup: it must be prompt, not hung
+        assert time.time() - t0 < 30.0
+    finally:
+        # the watchdog deliberately stays armed through the unwind (it
+        # guards against atexit hangs); a caller keeping the process alive
+        # must disarm — else it would hard-exit pytest minutes later
+        trainer.disarm_watchdog()
+
+
+def test_main_watchdog_hard_exits_wedged_process(tmp_path):
+    """A wedge on the MAIN thread (e.g. the learner's own device readback)
+    can't reach sup.check() — the watchdog must hard-exit the process with
+    STALL_EXIT_CODE so an external restart can recover."""
+    import subprocess
+    import sys as _sys
+
+    from r2d2_tpu.utils.supervision import STALL_EXIT_CODE
+
+    script = """
+import threading, time
+from r2d2_tpu.utils.supervision import Supervisor
+sup = Supervisor(heartbeat_timeout=0.2, stall_fatal_timeout=1.0,
+                 main_stall_headroom=0.0)
+sup.start_main_watchdog()
+sup.main_beat()
+threading.Event().wait()  # main thread wedges: no further beats
+"""
+    t0 = time.time()
+    proc = subprocess.run(
+        [_sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == STALL_EXIT_CODE, proc.stderr
+    assert "MAIN thread stalled" in proc.stderr
+    assert time.time() - t0 < 60
