@@ -213,8 +213,17 @@ def system_main():
     )
 
 
-def main():
-    cfg = default_atari().replace(
+def main(
+    cfg=None,
+    K: int = 16,
+    metric: str = "learner_env_frames_per_sec_per_chip",
+    frame_multiplier: int = 4,
+    baseline: float = BASELINE_FRAMES_PER_SEC,
+):
+    """frame_multiplier: env frames per env step — 4 for Atari (frameskip,
+    reference test.py:28,36), 1 for envs without frameskip. baseline: the
+    denominator for vs_baseline."""
+    cfg = cfg or default_atari().replace(
         compute_dtype="bfloat16",
         buffer_capacity=100_000,  # 250 block slots ~= 0.77 GB HBM obs store
     )
@@ -242,7 +251,6 @@ def main():
     # ~milliseconds of tunnel latency, so per-update overhead is amortized
     # K-fold by scanning K updates inside one call
     # (learner.make_fused_multi_train_step; exact-equivalence tested).
-    K = 16
     multi_step = make_fused_multi_train_step(cfg, net, K)
     sample_rng = np.random.default_rng(1)
 
@@ -332,7 +340,9 @@ def main():
     final_loss = float(m["loss"])
 
     updates_per_sec = n_updates / elapsed
-    frames_per_sec = updates_per_sec * cfg.batch_size * cfg.learning_steps * 4
+    frames_per_sec = (
+        updates_per_sec * cfg.batch_size * cfg.learning_steps * frame_multiplier
+    )
     print(
         f"{n_updates} updates in {elapsed:.1f}s = {updates_per_sec:.2f} updates/s "
         f"(final loss {final_loss:.4f})",
@@ -345,12 +355,39 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "learner_env_frames_per_sec_per_chip",
+                "metric": metric,
                 "value": round(frames_per_sec, 1),
                 "unit": "env_frames/s",
-                "vs_baseline": round(frames_per_sec / BASELINE_FRAMES_PER_SEC, 3),
+                "vs_baseline": round(frames_per_sec / baseline, 3),
             }
         )
+    )
+
+
+def long_context_main():
+    """Stretch configuration (BASELINE.json config 5): seq_len = 64 burn-in
+    + 512 learning + 5 forward = 581 per sequence — at batch 32, ~3.4x the
+    frame volume per update of the reference shape (32 x 581 vs 64 x 85).
+    Same fused K-update pipeline over HBM-resident replay; remat-chunked
+    scan handles the long recurrence (config long_context preset,
+    SURVEY.md section 5.7).
+
+    Frames count 1:1 (Craftax/NetHack-class envs have no frameskip), and
+    vs_baseline is against the BASELINE.json >=100k env-frames/s/chip
+    north star — the reference cannot run this sequence shape at all."""
+    from r2d2_tpu.config import long_context
+
+    cfg = long_context().replace(
+        compute_dtype="bfloat16",
+        batch_size=32,  # 32 x 581 frames/update fits HBM alongside the store
+        buffer_capacity=102_400,  # 200 slots x 512 ~= 0.8 GB obs store
+    )
+    main(
+        cfg,
+        K=4,
+        metric="long_context_learner_env_frames_per_sec_per_chip",
+        frame_multiplier=1,
+        baseline=100_000.0,
     )
 
 
@@ -359,11 +396,13 @@ if __name__ == "__main__":
 
     p = argparse.ArgumentParser(description="r2d2_tpu benchmarks")
     p.add_argument(
-        "--mode", default="learner", choices=["learner", "system", "fused"],
+        "--mode", default="learner",
+        choices=["learner", "system", "fused", "long_context"],
         help="learner: fused-update throughput on synthetic replay (the "
              "driver's default metric). system: concurrent on-device "
              "collection + learning via threads. fused: the same full "
-             "system as ONE megastep dispatch (megastep.py).",
+             "system as ONE megastep dispatch (megastep.py). long_context: "
+             "learner throughput on the seq-581 stretch preset.",
     )
     p.add_argument(
         "--collect-every", type=int, default=6,
@@ -374,5 +413,7 @@ if __name__ == "__main__":
         system_main()
     elif args.mode == "fused":
         fused_system_main(args.collect_every)
+    elif args.mode == "long_context":
+        long_context_main()
     else:
         main()
