@@ -148,3 +148,84 @@ def test_control_plane_fuzz_against_bruteforce():
         assert cp.block_ptr == ptr
         np.testing.assert_allclose(cp.tree.leaves(), leaf, rtol=1e-9)
         np.testing.assert_allclose(cp.tree.total, leaf.sum(), rtol=1e-9)
+
+
+def test_control_plane_fuzz_contiguous_reservations_and_lap_stamps():
+    """Random interleavings of contiguous batch reservations (with tail
+    retirement), draws, and stamped priority applications keep the control
+    plane consistent with a brute-force model — including the two newest
+    rules: _reserve_contiguous retires the skipped tail (priorities zeroed,
+    size decremented) and update_priorities drops a whole batch when a full
+    ring lap elapsed between draw and application (ptr_advances stamp)."""
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.replay.control_plane import ReplayControlPlane
+
+    cfg = tiny_test().replace(buffer_capacity=96, learning_starts=16)  # 6 slots
+    cp = ReplayControlPlane(cfg)
+    rng = np.random.default_rng(7)
+    S, nb, L = cfg.seqs_per_block, cfg.num_blocks, cfg.learning_steps
+
+    leaf = np.zeros(cfg.num_sequences)
+    learning = np.zeros(nb, np.int64)
+    occupied = np.zeros(nb, bool)
+    ptr = 0
+    advances = 0
+    size = env = 0
+    pending = []  # (idxes, old_ptr, old_advances)
+
+    for op in rng.integers(0, 3, size=600):
+        if op == 0:  # contiguous batch add of n blocks
+            n = int(rng.integers(1, 5))
+            with cp.lock:
+                start = cp._reserve_contiguous(n)
+            if ptr + n > nb:  # model the tail retirement + wrap
+                tail = np.arange(ptr, nb)
+                occ = tail[occupied[tail]]
+                leaf[(occ[:, None] * S + np.arange(S)).ravel()] = 0.0
+                size -= int(learning[occ].sum())
+                learning[occ] = 0
+                occupied[occ] = False
+                advances += nb - ptr
+                ptr = 0
+            assert start == ptr
+            for _ in range(n):
+                ns = int(rng.integers(1, S + 1))
+                steps = ns * L - int(rng.integers(0, L))
+                prios = np.zeros(S, np.float32)
+                prios[:ns] = rng.uniform(0.1, 2.0, ns)
+                with cp.lock:
+                    cp._account_add(ns, steps, prios, None)
+                leaf[ptr * S : (ptr + 1) * S] = (
+                    np.asarray(prios, np.float64) ** cfg.prio_exponent
+                )
+                size += steps - learning[ptr]
+                env += steps
+                learning[ptr] = steps
+                occupied[ptr] = True
+                ptr = (ptr + 1) % nb
+                advances += 1
+        elif op == 1 and cp.tree.total > 0:
+            with cp.lock:
+                b, s, idxes, w = cp._draw(rng)
+            pending.append((idxes, cp.block_ptr, cp.ptr_advances))
+        elif op == 2 and pending:
+            idxes, old_ptr, old_adv = pending.pop(int(rng.integers(len(pending))))
+            td = rng.uniform(0.1, 3.0, len(idxes))
+            cp.update_priorities(idxes, td, old_ptr, old_adv)
+            if advances - old_adv < nb:  # a full lap drops the whole batch
+                p = cp.block_ptr
+                if p > old_ptr:
+                    mask = (idxes < old_ptr * S) | (idxes >= p * S)
+                elif p < old_ptr:
+                    mask = (idxes < old_ptr * S) & (idxes >= p * S)
+                else:
+                    mask = np.ones(len(idxes), bool)
+                leaf[idxes[mask]] = td[mask] ** cfg.prio_exponent
+        # invariants after every op
+        assert len(cp) == size
+        assert cp.env_steps == env
+        assert cp.block_ptr == ptr
+        assert cp.ptr_advances == advances
+        np.testing.assert_array_equal(cp.occupied, occupied)
+        np.testing.assert_allclose(cp.tree.leaves(), leaf, rtol=1e-9)
+        np.testing.assert_allclose(cp.tree.total, leaf.sum(), rtol=1e-9)
