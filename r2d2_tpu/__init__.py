@@ -20,6 +20,7 @@ Layout (mirrors SURVEY.md section 1's layer map, re-architected TPU-first):
     learner.py       L4  jitted/pjit double-Q update (single/multi/sharded)
     actor.py         L4  vectorized actor service (host envs)
     collect.py       L4  fully on-device collector (pure-JAX envs)
+    megastep.py      L4  fused actor-learner dispatch (K updates + chunk)
     train.py         L5  orchestration over four replay planes
     evaluate.py      L6  offline evaluation (host or device-side)
     sweep.py         L6  Atari-57 sweep driver

@@ -338,3 +338,43 @@ PRESETS = {
     "long_context": long_context,
     "tiny_test": tiny_test,
 }
+
+
+def parse_overrides(pairs) -> dict:
+    """Parse CLI `--set key=value` pairs into typed replace() kwargs —
+    the reference's edit-config.py workflow without editing files. Values
+    are coerced by the dataclass field's type: int/float/bool/str scalars
+    and comma-separated int tuples (e.g. obs_shape=64,64,3). Unknown keys
+    raise with the full field list."""
+    fields = {f.name: f for f in dataclasses.fields(R2D2Config)}
+    out = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise ValueError(f"--set expects key=value, got {pair!r}")
+        key, _, raw = pair.partition("=")
+        key = key.strip()
+        if key not in fields:
+            raise ValueError(
+                f"unknown config field {key!r}; valid: {sorted(fields)}"
+            )
+        ftype = fields[key].type
+        # unwrap Optional[...] (string annotations under future-import):
+        # the inner type drives coercion; "none" selects None itself
+        if isinstance(ftype, str) and ftype.startswith("Optional["):
+            if raw.lower() == "none":
+                out[key] = None
+                continue
+            ftype = ftype[len("Optional[") : -1]
+        if ftype in ("int", int):
+            out[key] = int(raw)
+        elif ftype in ("float", float):
+            out[key] = float(raw)
+        elif ftype in ("bool", bool):
+            if raw.lower() not in ("true", "false", "1", "0"):
+                raise ValueError(f"{key} expects a bool, got {raw!r}")
+            out[key] = raw.lower() in ("true", "1")
+        elif "Tuple" in str(ftype):
+            out[key] = tuple(int(v) for v in raw.split(","))
+        else:  # str (and Optional[str]: pass through)
+            out[key] = raw
+    return out

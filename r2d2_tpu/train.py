@@ -36,7 +36,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from r2d2_tpu.actor import HostEnvPool, ParamStore, VectorizedActor
-from r2d2_tpu.config import PRESETS, R2D2Config, tiny_test
+from r2d2_tpu.config import PRESETS, R2D2Config, parse_overrides, tiny_test
 from r2d2_tpu.envs import make_env
 from r2d2_tpu.envs.catch import CatchVecEnv
 from r2d2_tpu.learner import (
@@ -826,6 +826,10 @@ def main(argv=None):
                    help="save full replay contents at end of run and restore "
                         "them on --resume (replay/snapshot.py)")
     p.add_argument("--metrics", default=None)
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   help="override any R2D2Config field, typed by the field "
+                        "(repeatable; e.g. --set gamma=0.99 --set "
+                        "batch_size=32 --set obs_shape=64,64,3)")
     p.add_argument("--profile-dir", default=None,
                    help="record a jax.profiler trace of the first post-warmup updates")
     p.add_argument("--profile-steps", type=int, default=20)
@@ -872,6 +876,9 @@ def main(argv=None):
             and cfg.replay_plane == "host"
         ):
             overrides["replay_plane"] = "device"
+    if args.set:
+        # applied LAST: --set is the explicit word on any field
+        overrides.update(parse_overrides(args.set))
     if overrides:
         cfg = cfg.replace(**overrides)
 

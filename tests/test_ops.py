@@ -101,3 +101,58 @@ class TestMixedTDPriorities:
         np.testing.assert_allclose(
             np.asarray(mixed_td_priorities(td, mask)), mixed_td_priorities_np(td, mask), rtol=1e-5
         )
+
+
+class TestConfigOverrides:
+    """--set key=value parsing: typed by the dataclass field (config.parse_overrides)."""
+
+    def test_typed_coercion(self):
+        from r2d2_tpu.config import parse_overrides, tiny_test
+
+        out = parse_overrides(
+            ["gamma=0.99", "batch_size=32", "obs_shape=64,64,3",
+             "env_name=catch", "snapshot_replay=true"]
+        )
+        assert out == {
+            "gamma": 0.99, "batch_size": 32, "obs_shape": (64, 64, 3),
+            "env_name": "catch", "snapshot_replay": True,
+        }
+        cfg = tiny_test().replace(
+            **parse_overrides(["stall_fatal_timeout=0", "learning_starts=32"])
+        )
+        assert cfg.stall_fatal_timeout == 0.0 and cfg.learning_starts == 32
+
+    def test_rejects_unknown_and_malformed(self):
+        import pytest
+
+        from r2d2_tpu.config import parse_overrides
+
+        with pytest.raises(ValueError, match="unknown config field"):
+            parse_overrides(["not_a_field=1"])
+        with pytest.raises(ValueError, match="key=value"):
+            parse_overrides(["gamma"])
+        with pytest.raises(ValueError, match="bool"):
+            parse_overrides(["snapshot_replay=maybe"])
+
+    def test_cli_applies_overrides(self, tmp_path):
+        from r2d2_tpu.train import main
+
+        main([
+            "--preset", "tiny_test", "--env", "catch", "--mode", "inline",
+            "--steps", "4",
+            "--set", f"checkpoint_dir={tmp_path}/ckpt",
+            "--set", "publish_interval=2",
+            "--set", "save_interval=1000",
+            "--metrics", f"{tmp_path}/m.jsonl",
+        ])
+        import json
+
+        rows = [json.loads(l) for l in open(f"{tmp_path}/m.jsonl")]
+        assert rows[-1]["step"] == 4
+
+    def test_optional_fields_coerce_by_inner_type(self):
+        from r2d2_tpu.config import parse_overrides
+
+        out = parse_overrides(["scan_chunk=32", "metrics_path=/tmp/x.jsonl"])
+        assert out == {"scan_chunk": 32, "metrics_path": "/tmp/x.jsonl"}
+        assert parse_overrides(["scan_chunk=none"]) == {"scan_chunk": None}
