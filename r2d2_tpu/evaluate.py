@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from r2d2_tpu.config import PRESETS, R2D2Config
+from r2d2_tpu.config import PRESETS, R2D2Config, parse_overrides
 from r2d2_tpu.learner import init_train_state
 from r2d2_tpu.utils.checkpoint import list_checkpoint_steps, restore_checkpoint
 
@@ -195,10 +195,16 @@ def main(argv=None):
     p.add_argument("--plot", default=None,
                    help="save the two-panel learning curve (reward vs "
                         "frames / vs hours) to this image path")
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   help="override any R2D2Config field (repeatable, typed "
+                        "by the field — must match the training run, e.g. "
+                        "--set checkpoint_dir=runs/x/ckpt)")
     args = p.parse_args(argv)
     cfg = PRESETS[args.preset]()
     if args.env:
         cfg = cfg.replace(env_name=args.env)
+    if args.set:
+        cfg = cfg.replace(**parse_overrides(args.set))
     vec_env = build_vec_env(cfg, seed=123)
     cfg = cfg.replace(action_dim=vec_env.action_dim)
     rows = evaluate_series(cfg, vec_env, out_path=args.out)

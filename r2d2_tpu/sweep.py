@@ -23,7 +23,7 @@ import sys
 import time
 from typing import List, Optional, Sequence
 
-from r2d2_tpu.config import PRESETS, R2D2Config
+from r2d2_tpu.config import PRESETS, R2D2Config, parse_overrides
 from r2d2_tpu.utils.supervision import WorkerStalledError
 
 # The canonical 57-game ALE suite (Bellemare et al. ALE benchmark set, as
@@ -44,14 +44,18 @@ ATARI_57: tuple = (
 
 
 def sweep_config(game: str, preset: str = "atari", root: str = "sweep", **overrides) -> R2D2Config:
-    """Per-game config: the preset with game-scoped checkpoint/metrics paths."""
+    """Per-game config: the preset with game-scoped checkpoint/metrics
+    paths. Explicit overrides win over the per-game defaults (so --set
+    can redirect e.g. checkpoint_dir — at the caller's own risk of
+    colliding games)."""
     cfg = PRESETS[preset]()
-    return cfg.replace(
+    kw = dict(
         env_name=game,
         checkpoint_dir=os.path.join(root, game, "checkpoints"),
         metrics_path=os.path.join(root, game, "metrics.jsonl"),
-        **overrides,
     )
+    kw.update(overrides)
+    return cfg.replace(**kw)
 
 
 def run_sweep(
@@ -62,6 +66,7 @@ def run_sweep(
     mode: str = "threaded",
     resume: bool = False,
     trainer_factory=None,
+    cfg_overrides: Optional[dict] = None,
 ) -> List[dict]:
     """Train each game in sequence; returns (and writes) one summary row
     per game: final step, run-lifetime mean episode return (every episode
@@ -76,6 +81,7 @@ def run_sweep(
     factory = trainer_factory or (lambda cfg: Trainer(cfg, resume=resume))
     for game in games:
         overrides = {"training_steps": steps} if steps else {}
+        overrides.update(cfg_overrides or {})
         cfg = sweep_config(game, preset=preset, root=root, **overrides)
         os.makedirs(os.path.dirname(cfg.metrics_path), exist_ok=True)
         t0 = time.time()
@@ -114,6 +120,9 @@ def main(argv=None):
     p.add_argument("--allow-any-env", action="store_true",
                    help="accept env names outside the Atari-57 suite "
                         "(e.g. 'catch' on images without ALE)")
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   help="override any R2D2Config field for every game "
+                        "(repeatable, typed by the field)")
     args = p.parse_args(argv)
     games = list(ATARI_57) if args.all else (args.games or ["MsPacman"])
     unknown = [g for g in games if g not in ATARI_57]
@@ -127,6 +136,7 @@ def main(argv=None):
             steps=args.steps,
             mode=args.mode,
             resume=args.resume,
+            cfg_overrides=parse_overrides(args.set) if args.set else None,
         )
     except WorkerStalledError as e:
         # same CLI contract as train.main: a wedged runtime exits with
