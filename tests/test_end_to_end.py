@@ -259,3 +259,41 @@ def test_samples_per_insert_throttles_collection(tmp_path):
     ratio = consumed / trainer.replay.env_steps
     # throttling keeps collection within ~2 chunks of the target band
     assert ratio > 0.5, f"actors free-ran: ratio {ratio:.2f}"
+
+
+def test_evaluate_cli_walks_series(trained, tmp_path):
+    """python -m r2d2_tpu.evaluate end to end: preset + --set overrides
+    reach the checkpoint series and emit rows + plot."""
+    from r2d2_tpu.evaluate import main as eval_main
+
+    out = tmp_path / "rows.jsonl"
+    plot = tmp_path / "curve.jpg"
+    eval_main([
+        "--preset", "tiny_test", "--env", "catch",
+        "--set", f"checkpoint_dir={trained.cfg.checkpoint_dir}",
+        "--out", str(out), "--plot", str(plot),
+    ])
+    import json
+
+    rows = [json.loads(l) for l in open(out)]
+    assert [r["step"] for r in rows] == [15, 30]
+    assert all(np.isfinite(r["mean_reward"]) for r in rows)
+    assert plot.exists() and plot.stat().st_size > 0
+
+
+def test_train_cli_fused_mode(tmp_path):
+    """python -m r2d2_tpu.train --mode fused end to end (CLI dispatch,
+    collector defaulting, metrics)."""
+    from r2d2_tpu.train import main as train_main
+
+    train_main([
+        "--preset", "tiny_test", "--env", "catch", "--mode", "fused",
+        "--steps", "6", "--updates-per-dispatch", "3",
+        "--set", f"checkpoint_dir={tmp_path}/ckpt",
+        "--set", "save_interval=1000",
+        "--metrics", f"{tmp_path}/m.jsonl",
+    ])
+    import json
+
+    rows = [json.loads(l) for l in open(f"{tmp_path}/m.jsonl")]
+    assert rows[-1]["step"] == 6
