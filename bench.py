@@ -125,8 +125,10 @@ def fused_system_main(collect_every: int = 6):
         n_updates += cfg.updates_per_dispatch
     _ = int(np.asarray(state.step))  # stream sync
     elapsed = time.time() - t0
-    env = runner.total_env_steps - env0
+    # finish() drains the final in-flight chunk's accounting (its dispatch
+    # time is inside `elapsed`, so its steps belong in `env`)
     runner.finish()
+    env = runner.total_env_steps - env0
     learner_fps = n_updates / elapsed * cfg.batch_size * cfg.learning_steps * 4
     collect_fps = env / elapsed * 4
     print(

@@ -53,6 +53,13 @@ class R2D2Config:
     burn_in_steps: int = 40  # reference config.py:27
     learning_steps: int = 40  # reference config.py:28
     forward_steps: int = 5  # n-step, reference config.py:29
+    # ABLATION knob (R2D2 paper section 3's zero-state baseline): replayed
+    # sequences start from ZERO recurrent state instead of the stored one.
+    # Pair with burn_in_steps=0 to reproduce the paper's zero-state
+    # training strategy; the memory_catch learning runs use it to prove
+    # the stored-state + burn-in machinery is load-bearing. Acting is
+    # unaffected (the actor always carries true episode state).
+    zero_state_replay: bool = False
 
     # --- schedule / cadences (reference worker.py:440-452, config.py:9-15)
     training_steps: int = 100_000
@@ -265,8 +272,14 @@ class R2D2Config:
 # --------------------------------------------------------------------------
 
 def default_atari(game: str = "MsPacman") -> R2D2Config:
-    """Reference defaults: single learner, 8 actors (BASELINE.json config 1)."""
-    return R2D2Config(env_name=game).validate()
+    """Reference defaults: single learner, 8 actors (BASELINE.json config 1).
+
+    compute_dtype is bfloat16, NOT the reference's float32: conv/LSTM
+    matmuls feed the MXU at double rate while loss/target math stays f32
+    (models/r2d2.py head-math contract; pinned by tests/test_model.py and
+    the bf16-vs-f32 learning parity of the bench suite). Override with
+    --set compute_dtype=float32 to reproduce reference numerics bit-class."""
+    return R2D2Config(env_name=game, compute_dtype="bfloat16").validate()
 
 
 def atari_v4_8(game: str = "MsPacman") -> R2D2Config:

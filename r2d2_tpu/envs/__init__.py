@@ -12,7 +12,13 @@ Three env families, one host-facing protocol (reset/step over numpy):
 """
 
 from r2d2_tpu.envs.fake import ScriptedEnv
-from r2d2_tpu.envs.catch import CatchEnv, CatchHostEnv, CatchVecEnv
+from r2d2_tpu.envs.catch import (
+    CatchEnv,
+    CatchHostEnv,
+    CatchVecEnv,
+    catch_cue_steps,
+    is_catch_name,
+)
 
 __all__ = ["ScriptedEnv", "CatchEnv", "CatchHostEnv", "CatchVecEnv", "make_env"]
 
@@ -23,8 +29,11 @@ def make_env(cfg, seed: int = 0):
     For vectorized on-device Catch use envs.catch.CatchVecEnv directly
     (train.build_vec_env does)."""
     name = cfg.env_name.lower()
-    if name == "catch":
-        return CatchHostEnv(height=cfg.obs_shape[0], width=cfg.obs_shape[1], seed=seed)
+    if is_catch_name(name):
+        return CatchHostEnv(
+            height=cfg.obs_shape[0], width=cfg.obs_shape[1], seed=seed,
+            cue_steps=catch_cue_steps(name),
+        )
     if name == "scripted":
         return ScriptedEnv(obs_shape=cfg.obs_shape, action_dim=cfg.action_dim)
     from r2d2_tpu.envs.atari import create_atari_env  # gated import

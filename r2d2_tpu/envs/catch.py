@@ -11,16 +11,56 @@ DEVICE, so the full Nature-CNN + LSTM acting path runs at TPU speed and the
 whole actor loop is vmappable/jittable. The functional core
 (reset/step/render) is exposed for fully on-device rollout pipelines; the
 CatchVecEnv adapter speaks the host numpy protocol for the generic actor.
+
+MEMORY VARIANT — flashing-cue catch ("memory_catch", cue_steps set): the
+ball is rendered ONLY while ball_y < cue_steps (the first few frames of
+its ~82-step fall), then flies invisibly. A memoryless policy sees nothing
+but the paddle for the rest of the episode and cannot beat chance; solving
+it requires carrying the ball column in recurrent state for ~70+ steps.
+This is the capability the reference demonstrates on MsPacman with the
+R2D2 recipe (stored recurrent states + burn-in replay, reference
+model.py:99-158, worker.py:574) distilled into a pure-JAX env: the
+full-machinery agent must beat the zero-state/no-burn-in ablation
+(config.zero_state_replay) for the recurrent replay plumbing to be doing
+its job. Same dynamics, geometry, and reward as plain catch — only
+observability changes.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# ball visible for the first 8 frames of the fall unless "memory_catch:K"
+# asks otherwise — short enough that a 40-step learning window starting
+# mid-episode cannot see it, long enough for the conv trunk to register it
+MEMORY_CATCH_DEFAULT_CUE = 8
+
+
+def catch_cue_steps(name: str) -> Optional[int]:
+    """Cue length encoded in an env name: None for plain 'catch', the cue
+    frame count for 'memory_catch' / 'memory_catch:K'. Raises on other
+    names (callers gate on is_catch_name)."""
+    n = name.lower()
+    if n == "catch":
+        return None
+    if n == "memory_catch":
+        return MEMORY_CATCH_DEFAULT_CUE
+    if n.startswith("memory_catch:"):
+        cue = int(n.split(":", 1)[1])
+        if cue < 1:
+            raise ValueError(f"memory_catch cue must be >= 1, got {cue}")
+        return cue
+    raise ValueError(f"not a catch family env name: {name!r}")
+
+
+def is_catch_name(name: str) -> bool:
+    n = name.lower()
+    return n == "catch" or n == "memory_catch" or n.startswith("memory_catch:")
 
 
 class CatchState(NamedTuple):
@@ -35,10 +75,19 @@ class CatchEnv:
 
     NUM_ACTIONS = 3  # 0 = NOOP, 1 = left, 2 = right
 
-    def __init__(self, height: int = 84, width: int = 84, paddle_width: int = 7, ball_size: int = 3):
+    def __init__(
+        self,
+        height: int = 84,
+        width: int = 84,
+        paddle_width: int = 7,
+        ball_size: int = 3,
+        cue_steps: Optional[int] = None,
+    ):
         self.h, self.w = height, width
         self.pw = paddle_width
         self.bs = ball_size
+        # memory variant: ball rendered only while ball_y < cue_steps
+        self.cue = cue_steps
 
     def reset(self, key: jax.Array) -> CatchState:
         key, kx, kp = jax.random.split(key, 3)
@@ -47,10 +96,15 @@ class CatchEnv:
         return CatchState(ball_x, jnp.zeros((), jnp.int32), paddle_x, key)
 
     def render(self, s: CatchState) -> jnp.ndarray:
-        """(H, W, 1) uint8 frame: ball block + paddle strip at 255."""
+        """(H, W, 1) uint8 frame: ball block + paddle strip at 255. With
+        cue_steps set, the ball disappears after its first cue_steps rows
+        of fall (the memory variant — the static Python branch keeps the
+        plain env's compiled program identical to before)."""
         ys = jnp.arange(self.h)[:, None]
         xs = jnp.arange(self.w)[None, :]
         ball = (jnp.abs(ys - s.ball_y) < self.bs) & (jnp.abs(xs - s.ball_x) < self.bs)
+        if self.cue is not None:
+            ball = ball & (s.ball_y < self.cue)
         paddle = (ys >= self.h - 2) & (jnp.abs(xs - s.paddle_x) <= self.pw // 2)
         frame = jnp.where(ball | paddle, 255, 0).astype(jnp.uint8)
         return frame[:, :, None]
@@ -67,11 +121,11 @@ class CatchEnv:
 
 
 @functools.lru_cache(maxsize=None)
-def _host_fns(height: int, width: int):
+def _host_fns(height: int, width: int, cue_steps: Optional[int]):
     """Jitted reset/step/render shared by every CatchHostEnv of the same
     geometry — a pool of N envs compiles each computation once, not N
     times."""
-    env = CatchEnv(height, width)
+    env = CatchEnv(height, width, cue_steps=cue_steps)
     return jax.jit(env.reset), jax.jit(env.step), jax.jit(env.render)
 
 
@@ -80,12 +134,15 @@ class CatchHostEnv:
     core — what make_env returns so Catch composes with HostEnvPool like
     any other host env."""
 
-    def __init__(self, height: int = 84, width: int = 84, seed: int = 0):
-        self.env = CatchEnv(height, width)
+    def __init__(
+        self, height: int = 84, width: int = 84, seed: int = 0,
+        cue_steps: Optional[int] = None,
+    ):
+        self.env = CatchEnv(height, width, cue_steps=cue_steps)
         self.action_dim = CatchEnv.NUM_ACTIONS
         self.obs_shape = (height, width, 1)
         self._key = jax.random.PRNGKey(seed)
-        self._reset, self._step, self._render = _host_fns(height, width)
+        self._reset, self._step, self._render = _host_fns(height, width, cue_steps)
         self._state = None
 
     def reset(self) -> np.ndarray:
@@ -104,8 +161,11 @@ class CatchVecEnv:
     (for replay parity with the reference) plus the fresh-episode frame to
     seed the next accumulator window."""
 
-    def __init__(self, num_envs: int = 1, height: int = 84, width: int = 84, seed: int = 0):
-        self.env = CatchEnv(height, width)
+    def __init__(
+        self, num_envs: int = 1, height: int = 84, width: int = 84, seed: int = 0,
+        cue_steps: Optional[int] = None,
+    ):
+        self.env = CatchEnv(height, width, cue_steps=cue_steps)
         self.num_envs = num_envs
         self.action_dim = CatchEnv.NUM_ACTIONS
         self.obs_shape = (height, width, 1)

@@ -152,6 +152,11 @@ def _raw_train_step(cfg: R2D2Config, net: R2D2Network, axis_name: Optional[str] 
         return loss, (priorities, aux)
 
     def train_step(state: TrainState, b: DeviceBatch):
+        if cfg.zero_state_replay:
+            # zero-state ablation (R2D2 paper's baseline replay strategy):
+            # discard the stored recurrent state; one site covers every
+            # plane because all step builders share this body
+            b = b._replace(hidden=jnp.zeros_like(b.hidden))
         # valid learning steps: mask row i has exactly learning_steps[i] ones
         denom = jnp.sum(b.learning_steps).astype(jnp.float32)
         if axis_name is not None:

@@ -121,17 +121,24 @@ class FusedSystemRunner:
          window: rows targeting slots the chunk overwrote are rejected by
          the pointer-window mask because accounting ran first.
 
-    The priority readback is DEFERRED one dispatch (same protocol as the
-    threaded device plane): reading this dispatch's priorities immediately
-    would stall the host for the dispatch's execution plus a device->host
-    round trip — on a tunneled backend the round trip alone rivals the
-    compute. Instead the transfer starts async and is collected while the
-    NEXT dispatch executes. Deferral is safe in either direction: pending
-    rows are applied only after any intervening chunk accounting has
-    advanced the ring pointer, so the pointer-window mask still rejects
-    exactly the rows whose slots were overwritten since their draw.
-    Collection dispatches DO block (on the chunk's few-kB bookkeeping
-    readback): the ring pointer must advance before the next draws.
+    BOTH readbacks are DEFERRED one dispatch: reading this dispatch's
+    priorities or chunk bookkeeping immediately would stall the host for
+    the dispatch's execution plus a device->host round trip — on a
+    tunneled backend the round trip alone rivals the compute. Instead both
+    transfers start async and are collected while the NEXT dispatch
+    executes, so the host never blocks on the dispatch it just issued.
+
+    What makes chunk deferral safe is reserve-time pointer advancement
+    (ReplayControlPlane._reserve_advance): the reserved slots' old blocks
+    are retired (leaves zeroed, size deducted) and the ring pointer moves
+    past them BEFORE the dispatch and BEFORE any draw — so (a) no draw can
+    target a slot whose contents are in flight, and (b) the pointer-window
+    staleness mask already rejects any stale priority row aimed at those
+    slots. The deferred accounting (_account_blocks_at) then only has to
+    install the new blocks' tree priorities and counters; ordering against
+    the priority drain no longer matters. Replay availability of a chunk
+    lags one extra dispatch — the same lag class as the threaded mode's
+    queue depths (reference worker.py:364-371 tolerates ~12 batches).
 
     `collect_every` dispatches include the collection chunk; the others run
     the plain K-update dispatch (learner.make_fused_multi_train_step) so
@@ -165,6 +172,11 @@ class FusedSystemRunner:
         # pointer-window mask is correct for any advancement < num_blocks;
         # a FULL lap would alias ptr == old_ptr and apply stale priorities
         # to fresh blocks, so reject configs where the bound can reach it.
+        # The same guard covers the chunk-accounting deferral: a pending
+        # chunk's slots could only be re-reserved by the next chunk when
+        # num_blocks < 3E (reserve advances at most 2E-1 past the pending
+        # slab), and consecutive collects require chunks_between=2 below,
+        # i.e. num_blocks >= 4E-1 — strictly stronger.
         chunks_between = 2 if collect_every == 1 or samples_per_insert > 0 else 1
         max_advance = chunks_between * (2 * self.E - 1)
         if max_advance >= cfg.num_blocks:
@@ -184,6 +196,12 @@ class FusedSystemRunner:
         # theoretical max insert rate would silently overshoot the target
         self.samples_per_insert = samples_per_insert
         self._consumed = 0
+        # pacing baseline: THIS-RUN insertions only, measured off the
+        # replay's own recorded counter (the threaded pacer's rule,
+        # train.py actor_body) — warmup/snapshot totals must not skew the
+        # consumed:inserted ratio, and attempted-step proxies undercount
+        # episode-aligned chunks
+        self._inserted0 = replay.env_steps
         self.epsilons = epsilons
         self.env_state = env_state
         self.key = key
@@ -192,26 +210,37 @@ class FusedSystemRunner:
         self._dispatch_count = 0
         self.total_env_steps = 0
         self._pending = None  # deferred (priorities, draws) readback
+        self._pending_chunk = None  # deferred (ptr0, chunk bookkeeping) readback
         self.replay_rng = sample_rng if sample_rng is not None else np.random.default_rng(0)
 
     def step(self, state: TrainState):
         """One dispatch (K updates, plus the chunk on collect_every'th
-        calls); returns (state', metrics, env_steps_recorded)."""
+        calls); returns (state', metrics, env_steps_recorded). With both
+        readbacks deferred, `recorded` reports the PREVIOUS dispatch's
+        chunk as its accounting lands (zero on the first collect)."""
+        # consumption counted BEFORE the decision: this dispatch's K
+        # updates are committed either way, and an understated consumed
+        # would skip the first collect for no reason
+        self._consumed += self.K * self.cfg.batch_size * self.cfg.learning_steps
         if self.samples_per_insert > 0:
-            inserted = max(self.total_env_steps, 1)
+            inserted = max(self.replay.env_steps - self._inserted0, 1)
             collect = self._consumed / inserted >= self.samples_per_insert
         else:
             collect = self._dispatch_count % self.collect_every == 0
         self._dispatch_count += 1
-        self._consumed += self.K * self.cfg.batch_size * self.cfg.learning_steps
         replay = self.replay
         with replay.lock:
+            if collect:
+                # reserve BEFORE drawing: retires the slots' old blocks and
+                # advances the ring pointer, so the draws below can neither
+                # target the in-flight chunk's slots nor produce priority
+                # rows the staleness mask would miss
+                ptr0 = replay._reserve_advance(self.E)
             draws = [replay._draw_sample_idx(self.replay_rng) for _ in range(self.K)]
             b = jnp.asarray(np.stack([d.b for d in draws]))
             s = jnp.asarray(np.stack([d.s for d in draws]))
             w = jnp.asarray(np.stack([d.is_weights for d in draws]))
             if collect:
-                ptr0 = replay._reserve_contiguous(self.E)
                 (state, new_stores, m, prios, chunk_host, self.env_state, self.key) = (
                     self._mega(
                         state, replay.stores, self.env_state, self.epsilons,
@@ -222,35 +251,51 @@ class FusedSystemRunner:
             else:
                 state, m, prios = self._multi(state, replay.stores, b, s, w)
 
+        # start this dispatch's readbacks async; collect them next call
+        for arr in (prios, *(chunk_host if collect else ())):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
         recorded = 0
-        if collect:
-            # account the chunk FIRST (advances the ring pointer past the
-            # scatter's slots), so every later priority application rejects
-            # rows the chunk overwrote
-            chunk_prios, num_seq, sizes, dones, ep_rewards = map(np.asarray, chunk_host)
-            # chunks are episode-aligned: every recorded transition is a
-            # learning step (collect.py _pack), so learning totals == sizes
-            with replay.lock:
-                replay._account_blocks(num_seq, sizes, chunk_prios, ep_rewards, dones)
-            recorded = int(sizes.sum())
-            self.total_env_steps += recorded
-        try:
-            prios.copy_to_host_async()
-        except AttributeError:
-            pass
+        prev_chunk = self._pending_chunk
+        self._pending_chunk = (ptr0, chunk_host) if collect else None
+        if prev_chunk is not None:
+            recorded = self._drain_chunk(prev_chunk)
         prev, self._pending = self._pending, (prios, draws)
         if prev is not None:
             self._drain(prev)
         return state, m, recorded
+
+    def _drain_chunk(self, pending) -> int:
+        """Install a deferred chunk's accounting (tree priorities, sizes,
+        episode stats) at its reserved slots; returns recorded steps."""
+        ptr0, chunk_host = pending
+        chunk_prios, num_seq, sizes, dones, ep_rewards = map(np.asarray, chunk_host)
+        # chunks are episode-aligned: every recorded transition is a
+        # learning step (collect.py _pack), so learning totals == sizes
+        with self.replay.lock:
+            self.replay._account_blocks_at(
+                ptr0, num_seq, sizes, chunk_prios, ep_rewards, dones
+            )
+        recorded = int(sizes.sum())
+        self.total_env_steps += recorded
+        return recorded
 
     def _drain(self, pending) -> None:
         prios, draws = pending
         for row, d in zip(np.asarray(prios), draws):
             self.replay.update_priorities(d.idxes, row, d.old_ptr, d.old_advances)
 
-    def finish(self) -> None:
-        """Apply the final in-flight priority readback; call once when the
-        driving loop stops updating."""
+    def finish(self) -> int:
+        """Apply the final in-flight readbacks (chunk accounting first,
+        then priorities); call once when the driving loop stops updating.
+        Returns the env steps recorded by the final chunk drain."""
+        recorded = 0
+        pending_chunk, self._pending_chunk = self._pending_chunk, None
+        if pending_chunk is not None:
+            recorded = self._drain_chunk(pending_chunk)
         pending, self._pending = self._pending, None
         if pending is not None:
             self._drain(pending)
+        return recorded

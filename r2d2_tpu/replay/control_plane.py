@@ -74,6 +74,30 @@ class ReplayControlPlane:
 
     # --- accounting (call with self.lock held) ----------------------------
 
+    def _account_block_at(
+        self, slot: int, num_sequences: int, learning_total: int,
+        priorities: np.ndarray, episode_reward: Optional[float],
+    ) -> None:
+        """Tree + counter bookkeeping for a block at an explicit slot; does
+        NOT move the ring pointer (the caller owns pointer protocol — either
+        _account_add's advance-after or _reserve_advance's advance-before).
+        Caller holds the lock."""
+        S = self.cfg.seqs_per_block
+        idxes = np.arange(slot * S, (slot + 1) * S, dtype=np.int64)
+        self.tree.update(idxes, priorities)
+        if self.occupied[slot]:
+            self.size -= int(self.learning_sum[slot])
+        self.learning_sum[slot] = learning_total
+        self.occupied[slot] = True
+        self.num_seq_store[slot] = num_sequences
+        self.size += learning_total
+        self.env_steps += learning_total
+        if episode_reward is not None:
+            self.episode_reward_sum += episode_reward
+            self.num_episodes += 1
+            self.total_episodes += 1
+            self.total_reward_sum += episode_reward
+
     def _account_add(
         self, num_sequences: int, learning_total: int, priorities: np.ndarray,
         episode_reward: Optional[float],
@@ -82,23 +106,11 @@ class ReplayControlPlane:
         the slot index written. Caller holds the lock and writes the data
         plane for the same slot."""
         ptr = self.block_ptr
-        S = self.cfg.seqs_per_block
-        idxes = np.arange(ptr * S, (ptr + 1) * S, dtype=np.int64)
-        self.tree.update(idxes, priorities)
-        if self.occupied[ptr]:
-            self.size -= int(self.learning_sum[ptr])
-        self.learning_sum[ptr] = learning_total
-        self.occupied[ptr] = True
-        self.num_seq_store[ptr] = num_sequences
-        self.size += learning_total
-        self.env_steps += learning_total
+        self._account_block_at(
+            ptr, num_sequences, learning_total, priorities, episode_reward
+        )
         self.block_ptr = (ptr + 1) % self.cfg.num_blocks
         self.ptr_advances += 1
-        if episode_reward is not None:
-            self.episode_reward_sum += episode_reward
-            self.num_episodes += 1
-            self.total_episodes += 1
-            self.total_reward_sum += episode_reward
         return ptr
 
     def _account_blocks(
@@ -121,6 +133,20 @@ class ReplayControlPlane:
                 float(episode_rewards[i]) if dones[i] else None,
             )
 
+    def _retire_slots(self, slots: np.ndarray) -> None:
+        """Evict the blocks at `slots` from the tree and the size
+        accounting (priorities zeroed: they can never be sampled again).
+        Caller holds the lock."""
+        occ = slots[self.occupied[slots]]
+        if occ.size:
+            S = self.cfg.seqs_per_block
+            idxes = (occ[:, None] * S + np.arange(S)[None, :]).ravel()
+            self.tree.update(idxes, np.zeros(idxes.size, np.float32))
+            self.size -= int(self.learning_sum[occ].sum())
+            self.learning_sum[occ] = 0
+            self.occupied[occ] = False
+            self.num_seq_store[occ] = 0
+
     def _reserve_contiguous(self, n: int) -> int:
         """Wrap the ring pointer to 0 if fewer than n slots remain before
         the end, and return the pointer: the caller writes slots
@@ -135,20 +161,48 @@ class ReplayControlPlane:
         — over-rejection, never wrong. Caller holds the lock."""
         nb = self.cfg.num_blocks
         if self.block_ptr + n > nb:
-            S = self.cfg.seqs_per_block
-            tail = np.arange(self.block_ptr, nb)
-            occ = tail[self.occupied[tail]]
-            if occ.size:
-                idxes = (occ[:, None] * S + np.arange(S)[None, :]).ravel()
-                self.tree.update(idxes, np.zeros(idxes.size, np.float32))
-                self.size -= int(self.learning_sum[occ].sum())
-                self.learning_sum[occ] = 0
-                self.occupied[occ] = False
-                self.num_seq_store[occ] = 0
+            self._retire_slots(np.arange(self.block_ptr, nb))
             # the jump traverses the tail: it counts toward lap detection
             self.ptr_advances += nb - self.block_ptr
             self.block_ptr = 0
         return self.block_ptr
+
+    def _reserve_advance(self, n: int) -> int:
+        """Reserve n contiguous slots AND advance the ring pointer past
+        them, retiring the slots' previous blocks immediately. For writers
+        that defer the new blocks' accounting (FusedSystemRunner's
+        one-dispatch-lag chunk readback): after this returns, (a) draws
+        cannot target the reserved slots (leaves are zero), and (b) the
+        pointer-window staleness mask already treats them as overwritten —
+        so priority rows and the chunk's own accounting can land in any
+        order later, via _account_blocks_at. Caller holds the lock."""
+        ptr0 = self._reserve_contiguous(n)
+        self._retire_slots(np.arange(ptr0, ptr0 + n))
+        self.block_ptr = (ptr0 + n) % self.cfg.num_blocks
+        self.ptr_advances += n
+        return ptr0
+
+    def _account_blocks_at(
+        self,
+        ptr0: int,
+        num_seq: np.ndarray,
+        learning_totals: np.ndarray,
+        priorities: np.ndarray,
+        episode_rewards: np.ndarray,
+        dones: np.ndarray,
+    ) -> None:
+        """Deferred accounting for blocks written at slots [ptr0, ptr0+E)
+        previously reserved via _reserve_advance (pointer already past
+        them). Caller holds the lock; the data plane was written by the
+        dispatch that the reservation preceded."""
+        for i in range(len(num_seq)):
+            self._account_block_at(
+                ptr0 + i,
+                int(num_seq[i]),
+                int(learning_totals[i]),
+                priorities[i],
+                float(episode_rewards[i]) if dones[i] else None,
+            )
 
     def _draw(self, rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Stratified draw of batch_size sequence coordinates (with the

@@ -217,8 +217,12 @@ class ShardedDeviceReplay:
         local store region, no collectives; the remainder goes through the
         single-slot write. Fields stay on device end to end; only the
         per-block accounting scalars are host-side. Dealing continues the
-        round-robin cursor from the previous add, exactly like E
-        sequential add_block calls (pinned by test)."""
+        round-robin cursor from the previous add, like E sequential
+        add_block calls (pinned by test) — UNTIL a shard's local ring
+        wraps: from then on the batched path retires tail slots via
+        _reserve_contiguous to keep each slab contiguous, so slot
+        placement (and the retired blocks' tree state) deliberately
+        diverges from the sequential path, which never retires."""
         E = len(num_seq)
         bps = self.blocks_per_shard
         dp = self.dp

@@ -62,21 +62,27 @@ from r2d2_tpu.utils.supervision import Supervisor, WorkerStalledError
 
 def build_vec_env(cfg: R2D2Config, seed: int = 0):
     """One vectorized env spanning cfg.num_actors slots."""
+    from r2d2_tpu.envs.catch import catch_cue_steps, is_catch_name
+
     name = cfg.env_name.lower()
-    if name == "catch":
+    if is_catch_name(name):
         return CatchVecEnv(
-            num_envs=cfg.num_actors, height=cfg.obs_shape[0], width=cfg.obs_shape[1], seed=seed
+            num_envs=cfg.num_actors, height=cfg.obs_shape[0], width=cfg.obs_shape[1],
+            seed=seed, cue_steps=catch_cue_steps(name),
         )
     return HostEnvPool([make_env(cfg, seed=seed + i) for i in range(cfg.num_actors)])
 
 
 def build_fn_env(cfg: R2D2Config):
     """Functional (jit/vmap-safe) env core for the on-device collector."""
-    name = cfg.env_name.lower()
-    if name == "catch":
-        from r2d2_tpu.envs.catch import CatchEnv
+    from r2d2_tpu.envs.catch import CatchEnv, catch_cue_steps, is_catch_name
 
-        return CatchEnv(height=cfg.obs_shape[0], width=cfg.obs_shape[1])
+    name = cfg.env_name.lower()
+    if is_catch_name(name):
+        return CatchEnv(
+            height=cfg.obs_shape[0], width=cfg.obs_shape[1],
+            cue_steps=catch_cue_steps(name),
+        )
     if name == "scripted":
         from r2d2_tpu.envs.fake import ScriptedFnEnv
 
@@ -550,10 +556,15 @@ class Trainer:
         retires the tail), and episode-aligned chunks store fewer than
         block_length transitions per slot — so a learning_starts that
         exceeds what the ring can actually hold would loop here forever.
-        Once enough transitions to fill the ring twice over have been
-        inserted without sampling opening, the replay has provably
-        saturated below learning_starts: raise instead of spinning."""
+        The guard counts RECORDED insertions (replay.env_steps delta, not
+        attempted env steps — episode-aligned chunks record only a
+        fraction of attempts): once enough transitions to fill the ring
+        twice over have been inserted without sampling opening, the replay
+        has provably saturated below learning_starts — raise instead of
+        spinning."""
         steps = 0
+        inserted0 = last_inserted = self.replay.env_steps
+        progress_mark = 0  # attempted steps at the last recorded insertion
         saturation = 2 * self.cfg.buffer_capacity + self.cfg.learning_starts
         while not self.replay.can_sample():
             self.actor.step()
@@ -562,13 +573,28 @@ class Trainer:
             steps += self.actor.steps_per_call
             if max_steps is not None and steps >= max_steps:
                 raise RuntimeError("warmup exceeded max_steps without filling replay")
-            if steps >= saturation:
+            if self.replay.env_steps != last_inserted:
+                last_inserted = self.replay.env_steps
+                progress_mark = steps
+            if self.replay.env_steps - inserted0 >= saturation:
                 raise RuntimeError(
                     f"replay saturated at {len(self.replay)} transitions, below "
                     f"learning_starts={self.cfg.learning_starts}: the ring's "
                     "effective capacity (tail retirement for batched writes, "
                     "short-episode blocks) cannot reach the sampling gate — "
                     "lower learning_starts or grow buffer_capacity"
+                )
+            if steps - progress_mark >= saturation:
+                # termination backstop: recording has STALLED (a whole
+                # saturation-window of attempted env steps with zero
+                # insertions, e.g. an env whose episodes never complete a
+                # chunk) — the recorded-insertion guard above would never
+                # fire, so raise here instead of spinning forever
+                raise RuntimeError(
+                    f"warmup recorded no insertions over {saturation} attempted "
+                    f"env steps (replay stuck at {len(self.replay)} transitions): "
+                    "episodes may never complete within the collector's chunks — "
+                    "check max_episode_steps vs chunk/block length"
                 )
 
     def run_inline(self, env_steps_per_update: Optional[int] = None) -> None:
@@ -767,6 +793,11 @@ class Trainer:
             samples_per_insert=cfg.samples_per_insert if collect_every is None else 0.0,
         )
         try:
+            # metrics log lags ONE dispatch: reading a dispatch's loss
+            # floats immediately would sync on it, re-serializing the very
+            # readback the runner's deferred-drain protocol pipelines away
+            # — a previous dispatch's floats have already landed
+            pending_log = None
             while self._step < cfg.training_steps:
                 sup.main_beat()
                 self._profile_gate()
@@ -776,17 +807,19 @@ class Trainer:
                 self._step += cfg.updates_per_dispatch
                 self._profile_tick(cfg.updates_per_dispatch)
                 self._cadences(prev, self._step)
-                # log on collect dispatches only: reading the metrics floats
-                # syncs on the dispatch just issued, and collect dispatches
-                # already block on the chunk bookkeeping readback — the
-                # update-only dispatches stay fire-and-forget
-                if recorded:
-                    self._log(m, self._step)
+                # log on drain dispatches (a chunk's accounting landed):
+                # same cadence class as the old collect-dispatch logging
+                if recorded and pending_log is not None:
+                    self._log(*pending_log)
+                pending_log = (m, self._step)
         finally:
             # watchdog off before the drain: cleanup must not count as a stall
             sup.stop.set()
             self._stop_profile()
             runner.finish()
+            # the deferred metrics of the final dispatch have landed by now
+            if pending_log is not None:
+                self._log(*pending_log)
             # hand the collector loop state back so a later warmup/eval on
             # this Trainer continues from consistent episodes
             self.actor.env_state, self.actor.key = runner.env_state, runner.key

@@ -111,3 +111,55 @@ def test_catch_host_env_protocol():
     assert obs.shape == (2, 12, 12, 1)
     o, r, d, nxt = pool.step(np.zeros(2, np.int64))
     assert o.shape == (2, 12, 12, 1) and len(r) == 2
+
+
+def test_memory_catch_cue_visibility():
+    """Flashing-cue variant: ball rendered only while ball_y < cue_steps;
+    dynamics/reward identical to plain catch."""
+    from r2d2_tpu.envs.catch import catch_cue_steps, is_catch_name
+
+    assert catch_cue_steps("catch") is None
+    assert catch_cue_steps("memory_catch") == 8
+    assert catch_cue_steps("memory_catch:3") == 3
+    assert is_catch_name("MEMORY_CATCH") and not is_catch_name("pacman")
+
+    env = CatchEnv(height=20, width=20, paddle_width=3, cue_steps=3)
+    plain = CatchEnv(height=20, width=20, paddle_width=3)
+    s = env.reset(jax.random.PRNGKey(0))
+
+    def ball_pixels(e, st):
+        # mask out the paddle rows: anything lit above them is the ball
+        f = np.asarray(e.render(st))[:, :, 0]
+        return f[: e.h - 2].sum()
+
+    # cue frames: ball visible, frame identical to the plain env's
+    assert ball_pixels(env, s) > 0
+    np.testing.assert_array_equal(np.asarray(env.render(s)), np.asarray(plain.render(s)))
+    done = False
+    total = 0.0
+    while not done:
+        a = jnp.where(s.ball_x < s.paddle_x, 1, jnp.where(s.ball_x > s.paddle_x, 2, 0))
+        s, r, done = env.step(s, a)
+        total += float(r)
+        if not done and int(s.ball_y) >= 3:
+            assert ball_pixels(env, s) == 0  # ball flies invisibly
+    assert total == 1.0  # same reward structure as plain catch
+
+
+def test_memory_catch_vec_and_host_wiring():
+    """Factory wiring: 'memory_catch' reaches CatchVecEnv / CatchHostEnv /
+    the device-collector fn_env with the cue threaded through."""
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.train import build_fn_env, build_vec_env
+
+    cfg = tiny_test().replace(env_name="memory_catch:2", obs_shape=(12, 12, 1), action_dim=3)
+    vec = build_vec_env(cfg, seed=0)
+    assert vec.env.cue == 2
+    fn_env = build_fn_env(cfg)
+    assert fn_env.cue == 2
+    from r2d2_tpu.envs import make_env
+
+    host = make_env(cfg, seed=0)
+    assert host.env.cue == 2
+    obs = host.reset()
+    assert obs.shape == (12, 12, 1)

@@ -176,3 +176,21 @@ def test_tensor_parallel_matches_single_device():
     np.testing.assert_allclose(np.asarray(tp_p), np.asarray(ref_p), atol=1e-5)
     for a, b in zip(jax.tree.leaves(tp_s.params), jax.tree.leaves(ref_state.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_zero_state_replay_ablation_matches_manual_zeroing(cfg):
+    """cfg.zero_state_replay must equal running the normal step on a batch
+    whose stored hidden was zeroed by hand — one flag, same math."""
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    b = random_batch(cfg, seed=13)
+    zeroed = b._replace(hidden=jnp.zeros_like(b.hidden))
+
+    cfg_abl = cfg.replace(zero_state_replay=True)
+    net_a, state_a = init_train_state(cfg_abl, jax.random.PRNGKey(0))
+    s1, m1, p1 = make_train_step(cfg_abl, net_a, donate=False)(state_a, b)
+    s2, m2, p2 = make_train_step(cfg, net, donate=False)(state, zeroed)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+    np.testing.assert_array_equal(np.asarray(m1["loss"]), np.asarray(m2["loss"]))
+    # and it differs from the stored-state step (the flag is load-bearing)
+    _, m3, _ = make_train_step(cfg, net, donate=False)(state, b)
+    assert float(m3["loss"]) != float(m1["loss"])

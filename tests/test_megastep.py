@@ -124,9 +124,14 @@ def test_megastep_equals_separate_dispatches(setup):
 
 
 def test_runner_accounts_and_masks_staleness(setup):
+    """The deferred-drain protocol: a collect dispatch advances the ring
+    pointer at RESERVE time (so draws can never target the in-flight
+    chunk's slots) but its accounting — sizes, env_steps, tree priorities
+    — lands one dispatch later, when the async readback has arrived."""
     cfg, fn_env, net, state = setup
     replay, col = _filled_replay(cfg, net, state, fn_env)
     ptr0, size0 = replay.block_ptr, len(replay)
+    env0 = replay.env_steps
     step0 = int(state.step)
     state = jax.tree.map(jnp.copy, state)  # runner donates its input state
     runner = FusedSystemRunner(
@@ -134,14 +139,24 @@ def test_runner_accounts_and_masks_staleness(setup):
         collect_every=2, sample_rng=np.random.default_rng(5),
     )
     state2, m, recorded = runner.step(state)  # dispatch 0: collects
-    assert recorded > 0
+    # pointer already past the reserved slots, accounting still in flight
+    assert recorded == 0
     assert replay.block_ptr == (ptr0 + cfg.num_actors) % cfg.num_blocks
-    assert replay.env_steps == size0 + recorded  # accounting landed
-    state3, m2, recorded2 = runner.step(state2)  # dispatch 1: updates only
-    assert recorded2 == 0
+    assert replay.env_steps == env0
+    # the reserved slots were retired at reserve time: zero priority mass
+    S = cfg.seqs_per_block
+    reserved = (np.arange(ptr0, ptr0 + cfg.num_actors)[:, None] * S + np.arange(S)).ravel()
+    np.testing.assert_array_equal(replay.tree.priorities_of(reserved), 0.0)
+    state3, m2, recorded2 = runner.step(state2)  # dispatch 1: drains chunk 0
+    assert recorded2 > 0
+    assert replay.env_steps == env0 + recorded2  # accounting landed
+    assert runner.total_env_steps == recorded2
     assert replay.block_ptr == (ptr0 + cfg.num_actors) % cfg.num_blocks
+    # chunk 0's blocks are sampleable now: their leaves carry priority mass
+    assert (replay.tree.priorities_of(reserved) > 0).any()
     assert int(state3.step) == step0 + 2 * K
     assert np.isfinite(float(m2["loss"]))
+    assert runner.finish() == 0  # no chunk in flight after an update-only step
 
 
 def test_reserve_contiguous_retires_tail_slots():
