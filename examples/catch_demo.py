@@ -29,13 +29,13 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def demo_config(out: str, steps: int, actors: int, full: bool):
+def demo_config(out: str, steps: int, actors: int, full: bool, env: str = "catch"):
     from r2d2_tpu.config import R2D2Config, default_atari
 
     K = 16 if full else 8
     steps = max(steps // K, 1) * K  # round to the dispatch multiple
     common = dict(
-        env_name="catch",
+        env_name=env,
         action_dim=3,
         compute_dtype="bfloat16",
         collector="device",
@@ -86,6 +86,16 @@ def main():
     p.add_argument("--actors", type=int, default=64)
     p.add_argument("--full", action="store_true",
                    help="flagship Atari-scale config (needs --steps 50000+)")
+    p.add_argument("--env", default="catch",
+                   help="catch | memory_catch[:K] — the flashing-cue memory "
+                        "variant (ball visible only for the first K frames; "
+                        "envs/catch.py)")
+    p.add_argument("--ablate-zero-state", action="store_true",
+                   help="R2D2 paper zero-state ablation: burn_in=0 and "
+                        "replayed sequences start from zero recurrent state "
+                        "(config.zero_state_replay). Running memory_catch "
+                        "with and without this flag is the stored-state "
+                        "machinery's proof of life")
     p.add_argument("--resume", action="store_true",
                    help="continue from the checkpoints under --out")
     p.add_argument("--mode", default="threaded", choices=["threaded", "fused"],
@@ -95,18 +105,27 @@ def main():
                         "backend transfer wedges observed under the "
                         "threaded mode's three streams")
     args = p.parse_args()
+
+    from r2d2_tpu.envs.catch import is_catch_name
+
+    if not is_catch_name(args.env):
+        # the demo's action_dim/obs geometry are catch-specific; fail at
+        # parse time, not with a shape mismatch mid-run
+        p.error(f"--env must be catch or memory_catch[:K], got {args.env!r}")
     os.makedirs(args.out, exist_ok=True)
 
-    from r2d2_tpu.envs.catch import CatchVecEnv
+    from r2d2_tpu.envs.catch import CatchVecEnv, catch_cue_steps
     from r2d2_tpu.evaluate import evaluate_series, plot_series
     from r2d2_tpu.train import Trainer
     from r2d2_tpu.utils.supervision import WorkerStalledError, exit_for_stall
 
-    cfg = demo_config(args.out, args.steps, args.actors, args.full)
+    cfg = demo_config(args.out, args.steps, args.actors, args.full, env=args.env)
     if args.mode == "fused":
         # pace collection to the threaded run's observed consumed:inserted
         # ratio instead of collecting every dispatch
         cfg = cfg.replace(samples_per_insert=15.0)
+    if args.ablate_zero_state:
+        cfg = cfg.replace(burn_in_steps=0, zero_state_replay=True)
     trainer = Trainer(cfg, resume=args.resume)
     try:
         if args.mode == "fused":
@@ -119,6 +138,7 @@ def main():
         exit_for_stall(e)
 
     h = cfg.obs_shape[0]
+    cue = catch_cue_steps(cfg.env_name)
     reward_fn = None
     if args.full:
         # host-driven eval pays a device round trip per step; at 82-step
@@ -126,12 +146,14 @@ def main():
         from r2d2_tpu.envs.catch import CatchEnv
         from r2d2_tpu.evaluate import evaluate_params_device, make_eval_collect_fn
 
-        fn_env = CatchEnv(height=h, width=h)
+        fn_env = CatchEnv(height=h, width=h, cue_steps=cue)
         collect_fn = make_eval_collect_fn(cfg, trainer.net, fn_env, num_envs=16)
         reward_fn = lambda net, p: evaluate_params_device(
             cfg, net, p, fn_env, num_envs=16, seed=1234, collect_fn=collect_fn
         )
-    vec = None if reward_fn else CatchVecEnv(num_envs=16, height=h, width=h, seed=1234)
+    vec = None if reward_fn else CatchVecEnv(
+        num_envs=16, height=h, width=h, seed=1234, cue_steps=cue
+    )
     rows = evaluate_series(
         cfg, vec, out_path=os.path.join(args.out, "eval.jsonl"), reward_fn=reward_fn
     )

@@ -238,10 +238,12 @@ class R2D2Config:
             raise ValueError(f"unknown collector {self.collector!r}")
         if self.updates_per_dispatch < 1:
             raise ValueError("updates_per_dispatch must be >= 1")
-        if self.updates_per_dispatch > 1 and self.replay_plane != "device":
+        if self.updates_per_dispatch > 1 and self.replay_plane not in (
+            "device", "sharded"
+        ):
             raise ValueError(
-                "updates_per_dispatch > 1 is implemented for the device "
-                "replay plane (fused in-jit gathers)"
+                "updates_per_dispatch > 1 is implemented for the device and "
+                "sharded replay planes (fused in-jit gathers)"
             )
         if self.training_steps % self.updates_per_dispatch != 0:
             raise ValueError(
@@ -295,13 +297,22 @@ def atari_v4_8(game: str = "MsPacman") -> R2D2Config:
     ).validate()
 
 
-def procgen_impala(game: str = "coinrun") -> R2D2Config:
-    """IMPALA-ResNet encoder variant (BASELINE.json config 4)."""
+def procgen_impala(game: str = "procmaze") -> R2D2Config:
+    """IMPALA-ResNet encoder variant (BASELINE.json config 4). The default
+    env is the pure-JAX procedurally-generated maze (envs/procmaze.py) —
+    per-episode layout keys reproduce procgen's level-diversity property
+    on-device; pass an ALE/procgen name to point at an emulator env
+    instead where one is installed."""
+    # geometry knobs are procmaze-specific; an emulator game keeps the
+    # generic defaults (action_dim auto-corrects from the env at Trainer
+    # construction, max_episode_steps stays the Atari-style cap)
+    kw = dict(action_dim=5, max_episode_steps=96) if game.lower() == "procmaze" else {}
     return R2D2Config(
         env_name=game,
         obs_shape=(64, 64, 3),
         encoder="impala",
         compute_dtype="bfloat16",
+        **kw,
     ).validate()
 
 

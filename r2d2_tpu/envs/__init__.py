@@ -34,6 +34,11 @@ def make_env(cfg, seed: int = 0):
             height=cfg.obs_shape[0], width=cfg.obs_shape[1], seed=seed,
             cue_steps=catch_cue_steps(name),
         )
+    if name == "procmaze":
+        from r2d2_tpu.envs.functional import FnHostEnv
+        from r2d2_tpu.envs.procmaze import ProcMazeEnv
+
+        return FnHostEnv(ProcMazeEnv, (), seed=seed)
     if name == "scripted":
         return ScriptedEnv(obs_shape=cfg.obs_shape, action_dim=cfg.action_dim)
     from r2d2_tpu.envs.atari import create_atari_env  # gated import

@@ -1,0 +1,115 @@
+"""Generic host-protocol adapters over any functional (jit/vmap-safe) env.
+
+A functional env exposes reset(key) -> state, step(state, action) ->
+(state', reward, done), render(state) -> uint8 obs, plus NUM_ACTIONS
+(envs/catch.py, envs/procmaze.py). These adapters lift that core into the
+two host-facing protocols the framework speaks, so a new pure-JAX env gets
+the whole stack — HostEnvPool actor, vectorized actor, evaluator — by
+writing only the core. (The on-device collector consumes the core
+directly; no adapter needed.)
+
+The adapters mirror envs/catch.py's CatchHostEnv/CatchVecEnv shape; the
+jitted functions are cached per core-config so a pool of N envs compiles
+once, not N times.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_fns(make_env: Callable, env_args: tuple):
+    env = make_env(*env_args)
+    return jax.jit(env.reset), jax.jit(env.step), jax.jit(env.render)
+
+
+class FnHostEnv:
+    """Single-env host protocol (reset()/step(int)) over a functional core.
+    `make_env(*env_args)` must be hashable/cacheable (a class + scalar
+    args) so jitted functions are shared across instances."""
+
+    def __init__(self, make_env: Callable, env_args: tuple = (), seed: int = 0):
+        self.env = make_env(*env_args)
+        self.action_dim = self.env.NUM_ACTIONS
+        self._key = jax.random.PRNGKey(seed)
+        self._reset, self._step, self._render = _jitted_fns(make_env, env_args)
+        self._state = None
+        self.obs_shape = tuple(
+            jax.eval_shape(
+                self._render, jax.eval_shape(self._reset, jax.random.PRNGKey(0))
+            ).shape
+        )
+
+    def reset(self) -> np.ndarray:
+        self._key, sub = jax.random.split(self._key)
+        self._state = self._reset(sub)
+        return np.asarray(self._render(self._state))
+
+    def step(self, action: int):
+        self._state, reward, done = self._step(self._state, jnp.int32(action))
+        return np.asarray(self._render(self._state)), float(reward), bool(done), {}
+
+
+class FnVecEnv:
+    """Vectorized host-protocol adapter: E functional envs stepped in one
+    jitted call with device-side auto-reset. step() returns the terminal
+    frame (for replay parity with the reference) plus the fresh-episode
+    frame to seed the next accumulator window — the same contract as
+    envs/catch.CatchVecEnv / actor.HostEnvPool."""
+
+    def __init__(self, fn_env, num_envs: int = 1, seed: int = 0):
+        self.env = fn_env
+        self.num_envs = num_envs
+        self.action_dim = fn_env.NUM_ACTIONS
+        self._seed = seed
+        self._reset_count = 0
+        self._vreset = jax.jit(jax.vmap(fn_env.reset))
+        self._state = self._vreset(jax.random.split(jax.random.PRNGKey(seed), num_envs))
+        self.obs_shape = tuple(
+            jax.eval_shape(
+                fn_env.render, jax.tree.map(lambda x: x[0], self._state)
+            ).shape
+        )
+
+        @jax.jit
+        def _vstep(state, actions: jnp.ndarray):
+            def one(s, a):
+                s2, reward, done = fn_env.step(s, a)
+                term_obs = fn_env.render(s2)
+                key, sub = jax.random.split(s2.key)
+                fresh = fn_env.reset(sub)
+                fresh = fresh._replace(key=key)
+                nxt = jax.tree.map(lambda f, o: jnp.where(done, f, o), fresh, s2)
+                return nxt, term_obs, reward, done, fn_env.render(nxt)
+
+            return jax.vmap(one)(state, actions)
+
+        self._vstep = _vstep
+        self._vrender = jax.jit(jax.vmap(fn_env.render))
+
+    def reset_all(self) -> np.ndarray:
+        """Start fresh episodes in every slot (mid-episode state is
+        discarded — HostEnvPool.reset_all contract)."""
+        self._reset_count += 1
+        keys = jax.random.split(
+            jax.random.PRNGKey(self._seed + self._reset_count * 1_000_003), self.num_envs
+        )
+        self._state = self._vreset(keys)
+        return np.asarray(self._vrender(self._state))
+
+    def step(self, actions: np.ndarray):
+        self._state, term_obs, reward, done, next_obs = self._vstep(
+            self._state, jnp.asarray(actions, jnp.int32)
+        )
+        return (
+            np.asarray(term_obs),
+            np.asarray(reward, np.float64),
+            np.asarray(done),
+            np.asarray(next_obs),
+        )

@@ -1,0 +1,134 @@
+"""ProcMaze — a procedurally-generated pure-JAX env for the IMPALA config.
+
+The blueprint's config 4 (BASELINE.json / config.procgen_impala) names the
+procgen benchmark: procedurally-generated 64x64x3 episodes where every
+episode is a NEW level drawn from a seed, so policies must generalize over
+layouts instead of memorizing one (the property the IMPALA-ResNet encoder
+exists to handle). Procgen itself is a C++ emulator this image cannot run
+(and an emulator on this one-core host could not feed a TPU anyway — same
+argument as envs/catch.py), so ProcMaze reproduces the procedural-diversity
+property as a functional jit/vmap-safe env:
+
+- per-episode PRNG key -> a fresh 16x16 maze layout: random walls at
+  `wall_density`, then an L-shaped corridor carved start->goal so every
+  level is solvable by construction (procgen levels are solvable by
+  generator design too);
+- the agent (red) walks 4-connected (action 0 NOOP — the reference's
+  NOOP-is-0 convention, reference environment.py:17); walls block;
+- the goal (green) pays +1 and ends the episode; a step budget (`horizon`)
+  truncates unsolved episodes with reward 0 — termination information
+  travels as gamma_n = 0 in the data path exactly like every other env
+  (no done flags stored, reference worker.py:554);
+- rendered 64x64x3 uint8 on device: 4px cells, gray walls, red agent,
+  green goal — the IMPALA encoder's native input shape.
+
+Same functional protocol as envs/catch.py (reset/step/render + NUM_ACTIONS),
+so it composes with the host actor, the vectorized adapter, the fully
+on-device collector (collect.py), and the fused megastep unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ProcMazeState(NamedTuple):
+    walls: jnp.ndarray   # (G, G) bool
+    agent: jnp.ndarray   # (2,) int32 row, col
+    goal: jnp.ndarray    # (2,) int32
+    t: jnp.ndarray       # int32 step counter
+    key: jnp.ndarray     # PRNG key
+
+
+class ProcMazeEnv:
+    """Functional single-env core; every method is jit/vmap-safe."""
+
+    NUM_ACTIONS = 5  # 0 = NOOP, 1 = up, 2 = down, 3 = left, 4 = right
+
+    def __init__(
+        self,
+        grid: int = 16,
+        cell: int = 4,
+        horizon: int = 96,
+        wall_density: float = 0.3,
+    ):
+        self.g = grid
+        self.cell = cell
+        self.horizon = horizon
+        self.density = wall_density
+
+    # ------------------------------------------------------------ layout
+
+    def _layout(self, key: jax.Array):
+        """Per-episode level: random walls + a carved L-corridor start->goal
+        (solvable by construction), start != goal."""
+        g = self.g
+        kw, ks, kg, kbend = jax.random.split(key, 4)
+        walls = jax.random.uniform(kw, (g, g)) < self.density
+        start = jax.random.randint(ks, (2,), 0, g)
+        goal = jax.random.randint(kg, (2,), 0, g)
+        # force goal off the start cell (shift diagonally with wraparound)
+        goal = jnp.where(jnp.all(goal == start), (goal + g // 2) % g, goal)
+        rows = jnp.arange(g)
+        # L-corridor: along start's row from start col to goal col, then
+        # along goal's column from start row to goal row (bend order is
+        # itself randomized so corridors don't share a fixed chirality)
+        row_first = jax.random.bernoulli(kbend)
+        r0, c0 = start[0], start[1]
+        r1, c1 = goal[0], goal[1]
+
+        def carve(walls, fixed_row, ca, cb, axis):
+            lo, hi = jnp.minimum(ca, cb), jnp.maximum(ca, cb)
+            span = (rows >= lo) & (rows <= hi)
+            if axis == 1:  # clear cells (fixed_row, lo..hi)
+                mask = (rows[:, None] == fixed_row) & span[None, :]
+            else:  # clear cells (lo..hi, fixed_row)
+                mask = span[:, None] & (rows[None, :] == fixed_row)
+            return walls & ~mask
+
+        # path A: row r0 across cols, then col c1 across rows
+        wa = carve(carve(walls, r0, c0, c1, axis=1), c1, r0, r1, axis=0)
+        # path B: col c0 across rows, then row r1 across cols
+        wb = carve(carve(walls, c0, r0, r1, axis=0), r1, c0, c1, axis=1)
+        walls = jnp.where(row_first, wa, wb)
+        return walls, start, goal
+
+    def reset(self, key: jax.Array) -> ProcMazeState:
+        key, klevel = jax.random.split(key)
+        walls, start, goal = self._layout(klevel)
+        return ProcMazeState(walls, start, goal, jnp.zeros((), jnp.int32), key)
+
+    # ------------------------------------------------------------- step
+
+    def step(self, s: ProcMazeState, action: jnp.ndarray):
+        dr = jnp.where(action == 1, -1, jnp.where(action == 2, 1, 0))
+        dc = jnp.where(action == 3, -1, jnp.where(action == 4, 1, 0))
+        nxt = jnp.clip(
+            s.agent + jnp.stack([dr, dc]), 0, self.g - 1
+        ).astype(jnp.int32)
+        blocked = s.walls[nxt[0], nxt[1]]
+        agent = jnp.where(blocked, s.agent, nxt)
+        t = s.t + 1
+        reached = jnp.all(agent == s.goal)
+        done = reached | (t >= self.horizon)
+        reward = jnp.where(reached, 1.0, 0.0)
+        return ProcMazeState(s.walls, agent, s.goal, t, s.key), reward, done
+
+    # ------------------------------------------------------------ render
+
+    def render(self, s: ProcMazeState) -> jnp.ndarray:
+        """(G*cell, G*cell, 3) uint8: gray walls, red agent, green goal."""
+        g = self.g
+        rows = jnp.arange(g)
+        agent_m = (rows[:, None] == s.agent[0]) & (rows[None, :] == s.agent[1])
+        goal_m = (rows[:, None] == s.goal[0]) & (rows[None, :] == s.goal[1])
+        wall = jnp.where(s.walls, 96, 0).astype(jnp.uint8)
+        r = jnp.where(agent_m, 255, wall).astype(jnp.uint8)
+        gch = jnp.where(goal_m, 255, wall).astype(jnp.uint8)
+        b = wall
+        img = jnp.stack([r, gch, b], axis=-1)  # (G, G, 3)
+        img = jnp.repeat(jnp.repeat(img, self.cell, axis=0), self.cell, axis=1)
+        return img

@@ -277,11 +277,19 @@ def make_fused_multi_train_step(
     )
 
 
-def make_multi_update_core(cfg: R2D2Config, net: R2D2Network, num_steps: int):
+def make_multi_update_core(
+    cfg: R2D2Config, net: R2D2Network, num_steps: int,
+    axis_name: Optional[str] = None,
+):
     """The un-jitted K-update scan body shared by
     make_fused_multi_train_step and megastep.make_megastep — one
-    definition so the two dispatch paths cannot diverge."""
-    raw = _raw_train_step(cfg, net)
+    definition so the two dispatch paths cannot diverge.
+
+    axis_name="dp": the body runs per-shard under shard_map — gathers hit
+    the LOCAL store shard and gradients/denominators psum over the axis
+    (same contract as make_sharded_fused_train_step); b/s/w are then the
+    local (K, B/dp) coordinate stacks."""
+    raw = _raw_train_step(cfg, net, axis_name=axis_name)
     gather_batch = make_store_gather(cfg)
 
     def multi(state: TrainState, stores, b, s, w):
@@ -300,6 +308,39 @@ def make_multi_update_core(cfg: R2D2Config, net: R2D2Network, num_steps: int):
         return state, jax.tree.map(lambda x: x[-1], metrics), prios
 
     return multi
+
+
+def make_sharded_fused_multi_train_step(
+    cfg: R2D2Config, net: R2D2Network, mesh, num_steps: int, donate: bool = True
+):
+    """K updates in ONE shard_map dispatch over a dp-SHARDED replay store:
+    the multi-chip form of make_fused_multi_train_step. Each device scans
+    K updates gathering its (B/dp) sub-batches from its LOCAL store shard
+    and psums gradients over dp per update (ICI).
+
+    Signature: (state, stores, b, s, w) with b/s/w of shape (K, dp, B/dp)
+    and b LOCAL to each shard; returns (state, metrics-of-last-step,
+    priorities (K, dp, B/dp))."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    multi = make_multi_update_core(cfg, net, num_steps, axis_name="dp")
+
+    def body(state: TrainState, stores, b, s, w):
+        # local views: stores (nb/dp, ...), b/s/w (K, 1, B/dp)
+        state, metrics, prios = multi(state, stores, b[:, 0], s[:, 0], w[:, 0])
+        return state, metrics, prios[:, None]
+
+    # P("dp") is a PREFIX spec for the stores dict: it applies to every
+    # field array (same idiom as make_sharded_fused_train_step)
+    sharded = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P("dp"), P(None, "dp"), P(None, "dp"), P(None, "dp")),
+        out_specs=(P(), P(), P(None, "dp")),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
 def make_gather_step(cfg: R2D2Config):

@@ -52,6 +52,16 @@ from r2d2_tpu.learner import TrainState, make_multi_update_core
 from r2d2_tpu.models.r2d2 import R2D2Network
 
 
+def _start_async_copy(arrs) -> None:
+    """Kick off device->host transfers for a pytree of arrays; collected
+    later while subsequent dispatches execute."""
+    for arr in jax.tree.leaves(arrs):
+        try:
+            arr.copy_to_host_async()
+        except AttributeError:
+            pass
+
+
 def make_megastep(
     cfg: R2D2Config,
     net: R2D2Network,
@@ -105,7 +115,143 @@ def make_megastep(
     return jax.jit(mega, donate_argnums=(0, 1) if donate else ())
 
 
-class FusedSystemRunner:
+class _DeferredDrainRunner:
+    """The deferred-drain dispatch protocol, defined ONCE for both the
+    single-chip and multi-chip fused runners (subclasses supply the
+    plane-specific pieces): samples_per_insert pacing on actual
+    consumed:inserted counters, the pending-readback rotation (priorities
+    AND chunk bookkeeping collected one dispatch late), the aliasing
+    guard, and finish(). Subclasses implement
+
+      _dispatch(state, collect) -> (state', metrics, priorities, draws,
+                                    token, chunk_host)
+        reservation + draws + the jitted call, under the plane's locks
+        (token identifies the reserved slots; chunk_host the bookkeeping
+        arrays, both None when collect is False);
+      _account_chunk(token, arrays) -> recorded
+        install a drained chunk's accounting into the tree(s);
+      _apply_priorities(draw, row)
+        one K-row priority application under the draw's staleness stamp.
+    """
+
+    def _init_protocol(
+        self,
+        cfg: R2D2Config,
+        replay,
+        collect_every: int,
+        samples_per_insert: float,
+        sample_rng,
+        chunk_len,
+        ring_slots: int,
+        ring_envs: int,
+    ) -> None:
+        """ring_slots/ring_envs: ONE ring's slot count and writer batch
+        (the whole store single-chip; one shard's slice multi-chip)."""
+        self.cfg = cfg
+        self.replay = replay
+        self.K = cfg.updates_per_dispatch
+        self.chunk = int(chunk_len or default_chunk_len(cfg))
+        # deferred-drain aliasing bound: between a draw and its priority
+        # application (one dispatch later) at most two chunks can land,
+        # each advancing the ring by its E plus a wrap skip of < E. The
+        # pointer-window mask is correct for any advancement < ring_slots;
+        # a FULL lap would alias ptr == old_ptr and apply stale priorities
+        # to fresh blocks, so reject configs where the bound can reach it.
+        # The same guard covers the chunk-accounting deferral: a pending
+        # chunk's slots could only be re-reserved by the next chunk when
+        # ring_slots < 3E (reserve advances at most 2E-1 past the pending
+        # slab), and consecutive collects require chunks_between=2 below,
+        # i.e. ring_slots >= 4E-1 — strictly stronger.
+        chunks_between = 2 if collect_every == 1 or samples_per_insert > 0 else 1
+        max_advance = chunks_between * (2 * ring_envs - 1)
+        if max_advance >= ring_slots:
+            raise ValueError(
+                f"store too small for deferred priorities: {ring_slots} "
+                f"block slots per ring but up to {max_advance} can be "
+                f"overwritten between a draw and its application "
+                f"(ring E={ring_envs}); grow buffer_capacity or reduce "
+                "num_actors"
+            )
+        if collect_every < 1:
+            raise ValueError("collect_every must be >= 1")
+        self.collect_every = collect_every
+        # samples_per_insert > 0: ignore the fixed modulo and decide per
+        # dispatch from ACTUAL counters (the threaded pacer's rule,
+        # train.py actor_body) — chunks are episode-aligned and record
+        # fewer than E*chunk_len transitions, so a ratio derived from the
+        # theoretical max insert rate would silently overshoot the target.
+        # Baseline: THIS-RUN insertions only, off the replay's recorded
+        # counter (warmup/snapshot totals must not skew the ratio).
+        self.samples_per_insert = samples_per_insert
+        self._consumed = 0
+        self._inserted0 = replay.env_steps
+        self._dispatch_count = 0
+        self.total_env_steps = 0
+        self._pending = None        # deferred (priorities, draws) readback
+        self._pending_chunk = None  # deferred (token, chunk bookkeeping)
+        self.replay_rng = (
+            sample_rng if sample_rng is not None else np.random.default_rng(0)
+        )
+
+    def step(self, state: TrainState):
+        """One dispatch (K updates, plus the chunk on collect dispatches);
+        returns (state', metrics, env_steps_recorded). With both readbacks
+        deferred, `recorded` reports the PREVIOUS dispatch's chunk as its
+        accounting lands (zero on the first collect)."""
+        # consumption counted BEFORE the decision: this dispatch's K
+        # updates are committed either way, and an understated consumed
+        # would skip the first collect for no reason
+        self._consumed += self.K * self.cfg.batch_size * self.cfg.learning_steps
+        if self.samples_per_insert > 0:
+            inserted = max(self.replay.env_steps - self._inserted0, 1)
+            collect = self._consumed / inserted >= self.samples_per_insert
+        else:
+            collect = self._dispatch_count % self.collect_every == 0
+        self._dispatch_count += 1
+
+        state, m, prios, draws, token, chunk_host = self._dispatch(state, collect)
+
+        # start this dispatch's readbacks async; collect them next call
+        _start_async_copy((prios, chunk_host) if collect else prios)
+        recorded = 0
+        prev_chunk = self._pending_chunk
+        self._pending_chunk = (token, chunk_host) if collect else None
+        if prev_chunk is not None:
+            recorded = self._drain_chunk(prev_chunk)
+        prev, self._pending = self._pending, (prios, draws)
+        if prev is not None:
+            self._drain(prev)
+        return state, m, recorded
+
+    def _drain_chunk(self, pending) -> int:
+        """Install a deferred chunk's accounting (tree priorities, sizes,
+        episode stats) at its reserved slots; returns recorded steps."""
+        token, chunk_host = pending
+        arrays = tuple(map(np.asarray, chunk_host))
+        recorded = self._account_chunk(token, arrays)
+        self.total_env_steps += recorded
+        return recorded
+
+    def _drain(self, pending) -> None:
+        prios, draws = pending
+        for row, d in zip(np.asarray(prios), draws):
+            self._apply_priorities(d, row)
+
+    def finish(self) -> int:
+        """Apply the final in-flight readbacks (chunk accounting first,
+        then priorities); call once when the driving loop stops updating.
+        Returns the env steps recorded by the final chunk drain."""
+        recorded = 0
+        pending_chunk, self._pending_chunk = self._pending_chunk, None
+        if pending_chunk is not None:
+            recorded = self._drain_chunk(pending_chunk)
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            self._drain(pending)
+        return recorded
+
+
+class FusedSystemRunner(_DeferredDrainRunner):
     """Drives the megastep against a DeviceReplayBuffer + DeviceCollector.
 
     Owns the per-dispatch protocol (the Trainer's fused mode and bench.py
@@ -161,74 +307,20 @@ class FusedSystemRunner:
     ):
         from r2d2_tpu.learner import make_fused_multi_train_step
 
-        self.cfg = cfg
-        self.replay = replay
         self.E = cfg.num_actors
-        self.K = cfg.updates_per_dispatch
-        self.chunk = int(chunk_len or default_chunk_len(cfg))
-        # deferred-drain aliasing bound: between a draw and its priority
-        # application (one dispatch later) at most two chunks can land,
-        # each advancing the ring by E plus a wrap skip of < E. The
-        # pointer-window mask is correct for any advancement < num_blocks;
-        # a FULL lap would alias ptr == old_ptr and apply stale priorities
-        # to fresh blocks, so reject configs where the bound can reach it.
-        # The same guard covers the chunk-accounting deferral: a pending
-        # chunk's slots could only be re-reserved by the next chunk when
-        # num_blocks < 3E (reserve advances at most 2E-1 past the pending
-        # slab), and consecutive collects require chunks_between=2 below,
-        # i.e. num_blocks >= 4E-1 — strictly stronger.
-        chunks_between = 2 if collect_every == 1 or samples_per_insert > 0 else 1
-        max_advance = chunks_between * (2 * self.E - 1)
-        if max_advance >= cfg.num_blocks:
-            raise ValueError(
-                f"store too small for deferred priorities: {cfg.num_blocks} "
-                f"block slots but up to {max_advance} can be overwritten "
-                f"between a draw and its application (E={self.E}); grow "
-                "buffer_capacity or reduce num_actors"
-            )
-        if collect_every < 1:
-            raise ValueError("collect_every must be >= 1")
-        self.collect_every = collect_every
-        # samples_per_insert > 0: ignore the fixed modulo and decide per
-        # dispatch from ACTUAL counters (the threaded pacer's rule,
-        # train.py actor_body) — chunks are episode-aligned and record
-        # fewer than E*chunk_len transitions, so a ratio derived from the
-        # theoretical max insert rate would silently overshoot the target
-        self.samples_per_insert = samples_per_insert
-        self._consumed = 0
-        # pacing baseline: THIS-RUN insertions only, measured off the
-        # replay's own recorded counter (the threaded pacer's rule,
-        # train.py actor_body) — warmup/snapshot totals must not skew the
-        # consumed:inserted ratio, and attempted-step proxies undercount
-        # episode-aligned chunks
-        self._inserted0 = replay.env_steps
+        self._init_protocol(
+            cfg, replay, collect_every, samples_per_insert, sample_rng,
+            chunk_len, ring_slots=cfg.num_blocks, ring_envs=self.E,
+        )
         self.epsilons = epsilons
         self.env_state = env_state
         self.key = key
         self._mega = make_megastep(cfg, net, fn_env, self.E, self.chunk, self.K)
         self._multi = make_fused_multi_train_step(cfg, net, self.K)
-        self._dispatch_count = 0
-        self.total_env_steps = 0
-        self._pending = None  # deferred (priorities, draws) readback
-        self._pending_chunk = None  # deferred (ptr0, chunk bookkeeping) readback
-        self.replay_rng = sample_rng if sample_rng is not None else np.random.default_rng(0)
 
-    def step(self, state: TrainState):
-        """One dispatch (K updates, plus the chunk on collect_every'th
-        calls); returns (state', metrics, env_steps_recorded). With both
-        readbacks deferred, `recorded` reports the PREVIOUS dispatch's
-        chunk as its accounting lands (zero on the first collect)."""
-        # consumption counted BEFORE the decision: this dispatch's K
-        # updates are committed either way, and an understated consumed
-        # would skip the first collect for no reason
-        self._consumed += self.K * self.cfg.batch_size * self.cfg.learning_steps
-        if self.samples_per_insert > 0:
-            inserted = max(self.replay.env_steps - self._inserted0, 1)
-            collect = self._consumed / inserted >= self.samples_per_insert
-        else:
-            collect = self._dispatch_count % self.collect_every == 0
-        self._dispatch_count += 1
+    def _dispatch(self, state: TrainState, collect: bool):
         replay = self.replay
+        ptr0 = chunk_host = None
         with replay.lock:
             if collect:
                 # reserve BEFORE drawing: retires the slots' old blocks and
@@ -250,52 +342,212 @@ class FusedSystemRunner:
                 replay.stores = new_stores
             else:
                 state, m, prios = self._multi(state, replay.stores, b, s, w)
+        return state, m, prios, draws, ptr0, chunk_host
 
-        # start this dispatch's readbacks async; collect them next call
-        for arr in (prios, *(chunk_host if collect else ())):
-            try:
-                arr.copy_to_host_async()
-            except AttributeError:
-                pass
-        recorded = 0
-        prev_chunk = self._pending_chunk
-        self._pending_chunk = (ptr0, chunk_host) if collect else None
-        if prev_chunk is not None:
-            recorded = self._drain_chunk(prev_chunk)
-        prev, self._pending = self._pending, (prios, draws)
-        if prev is not None:
-            self._drain(prev)
-        return state, m, recorded
-
-    def _drain_chunk(self, pending) -> int:
-        """Install a deferred chunk's accounting (tree priorities, sizes,
-        episode stats) at its reserved slots; returns recorded steps."""
-        ptr0, chunk_host = pending
-        chunk_prios, num_seq, sizes, dones, ep_rewards = map(np.asarray, chunk_host)
+    def _account_chunk(self, ptr0: int, arrays) -> int:
+        chunk_prios, num_seq, sizes, dones, ep_rewards = arrays
         # chunks are episode-aligned: every recorded transition is a
         # learning step (collect.py _pack), so learning totals == sizes
         with self.replay.lock:
             self.replay._account_blocks_at(
                 ptr0, num_seq, sizes, chunk_prios, ep_rewards, dones
             )
-        recorded = int(sizes.sum())
-        self.total_env_steps += recorded
-        return recorded
+        return int(sizes.sum())
 
-    def _drain(self, pending) -> None:
-        prios, draws = pending
-        for row, d in zip(np.asarray(prios), draws):
-            self.replay.update_priorities(d.idxes, row, d.old_ptr, d.old_advances)
+    def _apply_priorities(self, d, row) -> None:
+        self.replay.update_priorities(d.idxes, row, d.old_ptr, d.old_advances)
 
-    def finish(self) -> int:
-        """Apply the final in-flight readbacks (chunk accounting first,
-        then priorities); call once when the driving loop stops updating.
-        Returns the env steps recorded by the final chunk drain."""
+
+# ---------------------------------------------------------------------------
+# Multi-chip fused megastep: the same single-dispatch system over a dp mesh.
+# ---------------------------------------------------------------------------
+
+
+def make_sharded_megastep(
+    cfg: R2D2Config,
+    net: R2D2Network,
+    fn_env,
+    mesh,
+    num_envs: int,
+    chunk_len: int,
+    num_updates: int,
+    donate: bool = True,
+):
+    """The multi-chip megastep: ONE shard_map dispatch over the mesh's dp
+    axis runs, PER DEVICE,
+
+      K prioritized double-Q updates gathered from the device's LOCAL
+      replay shard (gradients psum over dp — ICI traffic is gradients
+      only, the data plane never crosses devices)
+    + a full collection chunk over the device's LOCAL E/dp envs (policy +
+      env dynamics + block packing, collect.make_collect_core)
+    + the slab write of those E/dp blocks into the device's local store
+      region (a plain dynamic_update_slice on the local view — the same
+      no-collectives trick as ShardedDeviceReplay._write_slabs)
+
+    Env slots are PINNED to their device for the run: shard s always
+    collects envs [s*E/dp, (s+1)*E/dp) and writes their blocks to its own
+    ring — each shard's stream is a statistically identical 1/dp slice, so
+    no round-robin dealing (and no cross-device block traffic) is needed.
+
+    Signature: mega(state, stores, env_state, epsilons, keys, b, s, w,
+    starts) -> (state', stores', metrics, priorities (K, dp, B/dp),
+    (chunk_prios, num_seq, sizes, dones, ep_rewards) each (E, ...),
+    env_state', keys') where b/s/w are (K, dp, B/dp) per-shard LOCAL
+    coordinates, keys is a (dp,) key vector (one PRNG stream per shard),
+    starts (dp,) the per-shard LOCAL first slot reserved via
+    _reserve_advance, and env_state/epsilons are sharded over dp on their
+    leading E axis. Ordering semantics are identical to the single-chip
+    megastep (SSA: update gathers read pre-scatter store contents)."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    dp = mesh.shape["dp"]
+    if num_envs % dp:
+        raise ValueError(f"num_envs {num_envs} not divisible by dp {dp}")
+    E_local = num_envs // dp
+    collect_core = make_collect_core(cfg, net, fn_env, E_local, chunk_len)
+    multi_core = make_multi_update_core(cfg, net, num_updates, axis_name="dp")
+
+    def body(state, stores, env_state, epsilons, keys, b, s, w, starts):
+        # local views: stores (nb/dp, ...), env_state/epsilons (E/dp, ...),
+        # keys (1,), b/s/w (K, 1, B/dp), starts (1,)
+        act_params = state.params
+        state, metrics, prios = multi_core(state, stores, b[:, 0], s[:, 0], w[:, 0])
+        (fields, chunk_prios, num_seq, sizes, dones, ep_rewards, fresh_env, key2) = (
+            collect_core(act_params, env_state, epsilons, keys[0])
+        )
+        new_stores = {
+            k: jax.lax.dynamic_update_slice_in_dim(arr, fields[k], starts[0], axis=0)
+            for k, arr in stores.items()
+        }
+        return (
+            state,
+            new_stores,
+            metrics,
+            prios[:, None],
+            (chunk_prios, num_seq, sizes, dones, ep_rewards),
+            fresh_env,
+            key2[None],
+        )
+
+    # P("dp") entries are PREFIX specs: one spec covers every leaf of the
+    # stores dict / env-state pytree / bookkeeping tuple
+    mega = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(), P("dp"), P("dp"), P("dp"), P("dp"),
+            P(None, "dp"), P(None, "dp"), P(None, "dp"), P("dp"),
+        ),
+        out_specs=(
+            P(), P("dp"), P(), P(None, "dp"), P("dp"), P("dp"), P("dp"),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(mega, donate_argnums=(0, 1) if donate else ())
+
+
+class ShardedFusedRunner(_DeferredDrainRunner):
+    """Drives the sharded megastep against a ShardedDeviceReplay — the
+    multi-chip FusedSystemRunner. Same deferred-drain protocol (reserve
+    advances every shard's ring before the draws; priority and chunk
+    readbacks collected one dispatch later), applied per shard:
+
+      1. under all shard locks: _reserve_advance(E/dp) on every shard,
+         then K stacked per-shard coordinate draws, then ONE dispatch.
+      2. next call drains the previous dispatch's chunk bookkeeping into
+         each shard's tree at its reserved slots, and the previous
+         priorities under each shard's own staleness window.
+    """
+
+    def __init__(
+        self,
+        cfg: R2D2Config,
+        net: R2D2Network,
+        fn_env,
+        replay,
+        epsilons,
+        env_state,
+        key: jax.Array,
+        mesh,
+        collect_every: int = 1,
+        chunk_len: Optional[int] = None,
+        sample_rng: Optional[np.random.Generator] = None,
+        samples_per_insert: float = 0.0,
+    ):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from r2d2_tpu.learner import make_sharded_fused_multi_train_step
+
+        self.mesh = mesh
+        dp = replay.dp
+        self.dp = dp
+        E = cfg.num_actors
+        if E % dp:
+            raise ValueError(f"num_actors {E} not divisible by dp {dp}")
+        self.E_local = E // dp
+        self._init_protocol(
+            cfg, replay, collect_every, samples_per_insert, sample_rng,
+            chunk_len, ring_slots=replay.blocks_per_shard, ring_envs=self.E_local,
+        )
+        shd = NamedSharding(mesh, P("dp"))
+        self.epsilons = jax.device_put(jnp.asarray(epsilons, jnp.float32), shd)
+        self.env_state = jax.device_put(env_state, shd)
+        # one PRNG stream per shard, sharded alongside its envs
+        self.keys = jax.device_put(jax.random.split(key, dp), shd)
+        self._mega = make_sharded_megastep(
+            cfg, net, fn_env, mesh, E, self.chunk, self.K
+        )
+        self._multi = make_sharded_fused_multi_train_step(cfg, net, mesh, self.K)
+
+    def _dispatch(self, state: TrainState, collect: bool):
+        replay = self.replay
+        starts = chunk_host = None
+        with replay.lock:
+            locks = [sh.lock for sh in replay.shards]
+            for lk in locks:
+                lk.acquire()
+            try:
+                if collect:
+                    starts = np.asarray(
+                        [sh._reserve_advance(self.E_local) for sh in replay.shards],
+                        np.int32,
+                    )
+                draws = [
+                    replay.sample_indices(self.replay_rng, locked=True)
+                    for _ in range(self.K)
+                ]
+            finally:
+                for lk in reversed(locks):
+                    lk.release()
+            b = jnp.asarray(np.stack([d.b for d in draws]))
+            s = jnp.asarray(np.stack([d.s for d in draws]))
+            w = jnp.asarray(np.stack([d.is_weights for d in draws]))
+            if collect:
+                (state, new_stores, m, prios, chunk_host,
+                 self.env_state, self.keys) = self._mega(
+                    state, replay.stores, self.env_state, self.epsilons,
+                    self.keys, b, s, w, jnp.asarray(starts),
+                )
+                replay.stores = new_stores
+            else:
+                state, m, prios = self._multi(state, replay.stores, b, s, w)
+        return state, m, prios, draws, starts, chunk_host
+
+    def _account_chunk(self, starts, arrays) -> int:
+        chunk_prios, num_seq, sizes, dones, ep_rewards = arrays
+        El = self.E_local
         recorded = 0
-        pending_chunk, self._pending_chunk = self._pending_chunk, None
-        if pending_chunk is not None:
-            recorded = self._drain_chunk(pending_chunk)
-        pending, self._pending = self._pending, None
-        if pending is not None:
-            self._drain(pending)
+        for sid, shard in enumerate(self.replay.shards):
+            sl = slice(sid * El, (sid + 1) * El)
+            with shard.lock:
+                shard._account_blocks_at(
+                    int(starts[sid]), num_seq[sl], sizes[sl],
+                    chunk_prios[sl], ep_rewards[sl], dones[sl],
+                )
+            recorded += int(sizes[sl].sum())
         return recorded
+
+    def _apply_priorities(self, d, row) -> None:
+        self.replay.update_priorities(d.idxes, row, d.old_ptrs, d.old_advances)

@@ -284,14 +284,19 @@ class ShardedDeviceReplay:
 
     # --------------------------------------------------------------- sample
 
-    def sample_indices(self, rng: np.random.Generator) -> ShardedSampleIdx:
+    def sample_indices(
+        self, rng: np.random.Generator, locked: bool = False
+    ) -> ShardedSampleIdx:
         """Each shard draws B/dp sequences; IS weights renormalized to the
         batch-global minimum priority so the sharded draw matches the
-        single-tree semantics."""
+        single-tree semantics. locked=True: the caller already holds every
+        shard's lock (the fused runner's draw-under-reservation path)."""
+        import contextlib
+
         bs, ss, idxs, prios = [], [], [], []
         old_ptrs, old_advances = [], []
         for shard in self.shards:
-            with shard.lock:
+            with shard.lock if not locked else contextlib.nullcontext():
                 b, s, idxes, _w = shard._draw(rng)
                 old_ptrs.append(shard.block_ptr)
                 old_advances.append(shard.ptr_advances)
@@ -332,6 +337,18 @@ class ShardedDeviceReplay:
             self.shards, idxes, np.asarray(td_errors), old_ptrs, advances
         ):
             shard.update_priorities(idx_row, td_row, old_ptr, old_adv)
+
+    def sample_and_run(self, rng: np.random.Generator, k: int, fn: Callable):
+        """Draw k per-shard coordinate sets and dispatch fn(stores, draws)
+        under ONE buffer-lock hold (multi-update path,
+        learner.make_sharded_fused_multi_train_step) — the sharded
+        counterpart of DeviceReplayBuffer.sample_and_run. Holding
+        self.lock excludes add paths (they take it first), so the in-jit
+        gathers read exactly the data the coordinates were drawn
+        against."""
+        with self.lock:
+            draws = [self.sample_indices(rng) for _ in range(k)]
+            return draws, fn(self.stores, draws)
 
     # ------------------------------------------------------------- dispatch
 

@@ -70,6 +70,11 @@ def build_vec_env(cfg: R2D2Config, seed: int = 0):
             num_envs=cfg.num_actors, height=cfg.obs_shape[0], width=cfg.obs_shape[1],
             seed=seed, cue_steps=catch_cue_steps(name),
         )
+    if name == "procmaze":
+        from r2d2_tpu.envs.functional import FnVecEnv
+        from r2d2_tpu.envs.procmaze import ProcMazeEnv
+
+        return FnVecEnv(ProcMazeEnv(), num_envs=cfg.num_actors, seed=seed)
     return HostEnvPool([make_env(cfg, seed=seed + i) for i in range(cfg.num_actors)])
 
 
@@ -83,6 +88,10 @@ def build_fn_env(cfg: R2D2Config):
             height=cfg.obs_shape[0], width=cfg.obs_shape[1],
             cue_steps=catch_cue_steps(name),
         )
+    if name == "procmaze":
+        from r2d2_tpu.envs.procmaze import ProcMazeEnv
+
+        return ProcMazeEnv()
     if name == "scripted":
         from r2d2_tpu.envs.fake import ScriptedFnEnv
 
@@ -223,20 +232,32 @@ class _ShardedPlane:
     """dp-sharded HBM replay + shard_map train step: local gathers per
     shard, gradient psum over dp (replay/sharded_store.py). Same
     inline/pipelined split as _DevicePlane; the pipelined gather runs under
-    shard_map so each device materializes its local sub-batch."""
-
-    steps_per_update = 1
+    shard_map so each device materializes its local sub-batch. K > 1 folds
+    K updates into one shard_map dispatch with the same deferred priority
+    readback as the device plane."""
 
     def __init__(self, tr: "Trainer"):
         if tr.mesh is None:
             raise ValueError("replay_plane='sharded' needs dp_size*tp_size > 1")
         self.tr = tr
         self.replay = ShardedDeviceReplay(tr.cfg, tr.mesh)
+        self.K = self.steps_per_update = tr.cfg.updates_per_dispatch
+        self._pending = None  # deferred (priorities, draws) readback
+        if self.K > 1:
+            from r2d2_tpu.learner import make_sharded_fused_multi_train_step
+
+            self.multi_fn = make_sharded_fused_multi_train_step(
+                tr.cfg, tr.net, tr.mesh, self.K
+            )
         self.step_fn = make_sharded_fused_train_step(tr.cfg, tr.net, tr.mesh)
         self.gather_fn = make_sharded_gather_step(tr.cfg, tr.mesh)
         self.batch_step_fn = make_batch_train_step(tr.cfg, tr.net)
 
     def sample(self, pipelined: bool = False):
+        if self.K > 1:
+            # multi-update dispatch draws its own coordinates at update
+            # time, atomically with the dispatch (_DevicePlane rationale)
+            return ("multi", None, None, None)
         with span("replay/sample"):
             si = self.replay.sample_indices(self.tr.sample_rng)
             coords = (jnp.asarray(si.b), jnp.asarray(si.s), jnp.asarray(si.is_weights))
@@ -246,8 +267,42 @@ class _ShardedPlane:
                 return "batch", batch, si.idxes, stamp
             return "coords", coords, si.idxes, stamp
 
+    def _multi_update(self, state):
+        """K sharded updates in one dispatch; priorities (K, dp, B/dp)
+        drain one dispatch late under each draw's per-shard windows."""
+
+        def dispatch(stores, draws):
+            b = jnp.asarray(np.stack([d.b for d in draws]))
+            s = jnp.asarray(np.stack([d.s for d in draws]))
+            w = jnp.asarray(np.stack([d.is_weights for d in draws]))
+            return self.multi_fn(state, stores, b, s, w)
+
+        draws, (new_state, m, priorities) = self.replay.sample_and_run(
+            self.tr.sample_rng, self.K, dispatch
+        )
+        try:
+            priorities.copy_to_host_async()
+        except AttributeError:
+            pass
+        prev, self._pending = self._pending, (priorities, draws)
+        if prev is not None:
+            self.drain_pending(prev)
+        return new_state, m
+
+    def drain_pending(self, pending=None) -> None:
+        if pending is None:
+            pending, self._pending = self._pending, None
+        if pending is None:
+            return
+        prios, draws = pending
+        for row, d in zip(np.asarray(prios), draws):
+            self.replay.update_priorities(d.idxes, row, d.old_ptrs, d.old_advances)
+
     def update(self, state, item):
-        kind, payload, idxes, (old_ptrs, old_adv) = item
+        kind, payload, idxes, stamp = item
+        if kind == "multi":
+            return self._multi_update(state)
+        old_ptrs, old_adv = stamp
         if kind == "batch":
             # gathered batch is dp-sharded; plain jit inserts the grad psum
             state, m, priorities = self.batch_step_fn(state, payload)
@@ -760,10 +815,10 @@ class Trainer:
         samples_per_insert == 0 collects every dispatch. An explicit
         collect_every overrides both."""
         cfg = self.cfg
-        if cfg.collector != "device" or cfg.replay_plane != "device":
+        if cfg.collector != "device" or cfg.replay_plane not in ("device", "sharded"):
             raise ValueError(
-                "run_fused needs collector='device' and replay_plane='device' "
-                f"(got {cfg.collector!r}, {cfg.replay_plane!r})"
+                "run_fused needs collector='device' and replay_plane="
+                f"'device'/'sharded' (got {cfg.collector!r}, {cfg.replay_plane!r})"
             )
         self._start_time = time.time()
         # main-thread watchdog: this loop has no worker threads, so a
@@ -776,22 +831,27 @@ class Trainer:
 
     def _run_fused_body(self, sup: Supervisor, collect_every: Optional[int]) -> None:
         cfg = self.cfg
-        from r2d2_tpu.megastep import FusedSystemRunner
+        from r2d2_tpu.megastep import FusedSystemRunner, ShardedFusedRunner
 
         self.warmup(beat=sup.main_beat)
-        runner = FusedSystemRunner(
-            cfg,
-            self.net,
-            self.fn_env,
-            self.replay,
-            self.actor.epsilons,
-            self.actor.env_state,
-            self.actor.key,
+        common = dict(
             collect_every=1 if collect_every is None else collect_every,
             chunk_len=self.actor.chunk,
             sample_rng=self.sample_rng,
             samples_per_insert=cfg.samples_per_insert if collect_every is None else 0.0,
         )
+        if cfg.replay_plane == "sharded":
+            runner = ShardedFusedRunner(
+                cfg, self.net, self.fn_env, self.replay,
+                self.actor.epsilons, self.actor.env_state, self.actor.key,
+                self.mesh, **common,
+            )
+        else:
+            runner = FusedSystemRunner(
+                cfg, self.net, self.fn_env, self.replay,
+                self.actor.epsilons, self.actor.env_state, self.actor.key,
+                **common,
+            )
         try:
             # metrics log lags ONE dispatch: reading a dispatch's loss
             # floats immediately would sync on it, re-serializing the very
@@ -821,8 +881,11 @@ class Trainer:
             if pending_log is not None:
                 self._log(*pending_log)
             # hand the collector loop state back so a later warmup/eval on
-            # this Trainer continues from consistent episodes
-            self.actor.env_state, self.actor.key = runner.env_state, runner.key
+            # this Trainer continues from consistent episodes (the sharded
+            # runner keeps one PRNG stream per shard; shard 0's continues
+            # the actor's single stream)
+            self.actor.env_state = runner.env_state
+            self.actor.key = runner.key if hasattr(runner, "key") else runner.keys[0]
             self.actor.total_steps += runner.total_env_steps
             if cfg.snapshot_replay:
                 self._snapshot_on_exit()

@@ -1,0 +1,187 @@
+"""ProcMaze: procedural layout generation, mechanics, rendering, and the
+generic functional-env adapters + collector integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.envs.procmaze import ProcMazeEnv
+
+
+def _bfs_reachable(walls, start, goal):
+    """Host-side BFS ground truth for solvability."""
+    g = walls.shape[0]
+    seen = np.zeros_like(walls, bool)
+    frontier = [tuple(start)]
+    seen[start[0], start[1]] = True
+    while frontier:
+        r, c = frontier.pop()
+        if (r, c) == tuple(goal):
+            return True
+        for dr, dc in ((1, 0), (-1, 0), (0, 1), (0, -1)):
+            nr, nc = r + dr, c + dc
+            if 0 <= nr < g and 0 <= nc < g and not walls[nr, nc] and not seen[nr, nc]:
+                seen[nr, nc] = True
+                frontier.append((nr, nc))
+    return False
+
+
+def test_every_level_is_solvable_and_diverse():
+    env = ProcMazeEnv()
+    layouts = []
+    for seed in range(50):
+        s = env.reset(jax.random.PRNGKey(seed))
+        walls = np.asarray(s.walls)
+        agent, goal = np.asarray(s.agent), np.asarray(s.goal)
+        assert not walls[agent[0], agent[1]] and not walls[goal[0], goal[1]]
+        assert tuple(agent) != tuple(goal)
+        assert _bfs_reachable(walls, agent, goal), f"unsolvable level seed={seed}"
+        layouts.append(walls.tobytes())
+    # procedural diversity: essentially every level is distinct
+    assert len(set(layouts)) >= 45
+
+
+def test_step_mechanics_walls_block_and_goal_pays():
+    env = ProcMazeEnv(horizon=96)
+    s = env.reset(jax.random.PRNGKey(3))
+    # drive the agent along the carved corridor toward the goal greedily:
+    # BFS on host to get a shortest path, then replay it through step()
+    walls = np.asarray(s.walls)
+    start, goal = tuple(np.asarray(s.agent)), tuple(np.asarray(s.goal))
+    from collections import deque
+
+    prev = {start: None}
+    q = deque([start])
+    while q:
+        cur = q.popleft()
+        if cur == goal:
+            break
+        for a, (dr, dc) in ((1, (-1, 0)), (2, (1, 0)), (3, (0, -1)), (4, (0, 1))):
+            nxt = (cur[0] + dr, cur[1] + dc)
+            if (
+                0 <= nxt[0] < env.g and 0 <= nxt[1] < env.g
+                and not walls[nxt] and nxt not in prev
+            ):
+                prev[nxt] = (cur, a)
+                q.append(nxt)
+    assert goal in prev
+    path = []
+    node = goal
+    while prev[node] is not None:
+        node, a = prev[node]
+        path.append(a)
+    path.reverse()
+    total = 0.0
+    done = False
+    for a in path:
+        assert not done
+        s, r, done = env.step(s, jnp.int32(a))
+        total += float(r)
+    assert done and total == 1.0
+
+    # walls block: stepping into a wall leaves the agent in place
+    s2 = env.reset(jax.random.PRNGKey(7))
+    walls2 = np.asarray(s2.walls)
+    agent = np.asarray(s2.agent)
+    for a, (dr, dc) in ((1, (-1, 0)), (2, (1, 0)), (3, (0, -1)), (4, (0, 1))):
+        tr, tc = agent[0] + dr, agent[1] + dc
+        if 0 <= tr < env.g and 0 <= tc < env.g and walls2[tr, tc]:
+            s3, _, _ = env.step(s2, jnp.int32(a))
+            np.testing.assert_array_equal(np.asarray(s3.agent), agent)
+            break
+
+
+def test_horizon_truncates_with_zero_reward():
+    env = ProcMazeEnv(horizon=5)
+    s = env.reset(jax.random.PRNGKey(0))
+    done = False
+    steps, total = 0, 0.0
+    while not done:
+        s, r, done = env.step(s, jnp.int32(0))  # NOOP forever
+        total += float(r)
+        steps += 1
+    assert steps == 5 and total == 0.0
+
+
+def test_render_shape_and_colors():
+    env = ProcMazeEnv()
+    s = env.reset(jax.random.PRNGKey(1))
+    img = np.asarray(env.render(s))
+    assert img.shape == (64, 64, 3) and img.dtype == np.uint8
+    # agent cell pure red, goal cell pure green, at 4px cell granularity
+    ar, ac = np.asarray(s.agent) * env.cell
+    gr, gc = np.asarray(s.goal) * env.cell
+    np.testing.assert_array_equal(img[ar, ac], [255, 0, 0])
+    np.testing.assert_array_equal(img[gr, gc], [0, 255, 0])
+
+
+def test_functional_adapters_and_factories():
+    from r2d2_tpu.config import procgen_impala
+    from r2d2_tpu.envs import make_env
+    from r2d2_tpu.train import build_fn_env, build_vec_env
+
+    cfg = procgen_impala().replace(num_actors=3)
+    host = make_env(cfg, seed=0)
+    assert host.action_dim == 5 and host.obs_shape == (64, 64, 3)
+    obs = host.reset()
+    assert obs.shape == (64, 64, 3) and obs.dtype == np.uint8
+    obs2, r, done, _ = host.step(0)
+    assert obs2.shape == (64, 64, 3)
+
+    vec = build_vec_env(cfg, seed=0)
+    assert vec.num_envs == 3 and vec.obs_shape == (64, 64, 3)
+    obs = vec.reset_all()
+    assert obs.shape == (3, 64, 64, 3)
+    term, r, d, nxt = vec.step(np.zeros(3, np.int64))
+    assert term.shape == (3, 64, 64, 3) and nxt.shape == (3, 64, 64, 3)
+
+    fn_env = build_fn_env(cfg)
+    assert fn_env.NUM_ACTIONS == 5
+
+
+def test_vec_autoreset_draws_new_level():
+    from r2d2_tpu.envs.functional import FnVecEnv
+
+    env = ProcMazeEnv(horizon=3)
+    vec = FnVecEnv(env, num_envs=2, seed=5)
+    vec.reset_all()
+    walls0 = np.asarray(vec._state.walls).copy()
+    done_seen = False
+    for _ in range(4):
+        _, _, done, _ = vec.step(np.zeros(2, np.int64))
+        done_seen = done_seen or done.any()
+    assert done_seen
+    # after auto-reset the layouts changed (fresh levels)
+    assert not np.array_equal(np.asarray(vec._state.walls), walls0)
+
+
+def test_device_collector_runs_on_procmaze():
+    """The on-device collector composes with procmaze unchanged (fn_env
+    protocol) — chunk collection fills the HBM replay."""
+    from r2d2_tpu.collect import DeviceCollector
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.learner import init_train_state
+    from r2d2_tpu.replay.device_store import DeviceReplayBuffer
+
+    env = ProcMazeEnv(grid=6, cell=2, horizon=12)
+    cfg = tiny_test().replace(
+        env_name="procmaze",
+        obs_shape=(12, 12, 3),
+        action_dim=5,
+        encoder="mlp",
+        num_actors=4,
+        max_episode_steps=12,
+        collector="device",
+        replay_plane="device",
+    )
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+
+    class _P:
+        def latest(self):
+            return state.params, 0
+
+    replay = DeviceReplayBuffer(cfg)
+    col = DeviceCollector(cfg, net, _P(), env, replay, seed=3)
+    for _ in range(4):
+        col.step()
+    assert replay.env_steps > 0 and len(replay) > 0
