@@ -76,19 +76,64 @@ class HostEnvPool:
     def reset_all(self) -> np.ndarray:
         return np.stack([e.reset() for e in self.envs])
 
+    @staticmethod
+    def _step_one(env, action) -> tuple:
+        """The per-env step + auto-reset contract, defined once for the
+        serial and threaded pools: returns (terminal-inclusive obs,
+        reward, done, next obs where next differs only on done)."""
+        o, r, d, _ = env.step(int(action))
+        return o, r, d, (env.reset() if d else o)
+
     def step(self, actions: np.ndarray):
-        obs, rewards, dones, nxt = [], [], [], []
-        for e, a in zip(self.envs, actions):
-            o, r, d, _ = e.step(int(a))
-            obs.append(o)
-            rewards.append(r)
-            dones.append(d)
-            nxt.append(e.reset() if d else o)
+        obs, rewards, dones, nxt = zip(
+            *(self._step_one(e, a) for e, a in zip(self.envs, actions))
+        )
         return np.stack(obs), np.asarray(rewards), np.asarray(dones), np.stack(nxt)
 
     def force_reset(self, i: int) -> np.ndarray:
         """Mid-flight reset of one slot (max_episode_steps truncation)."""
         return self.envs[i].reset()
+
+
+class ThreadedHostEnvPool(HostEnvPool):
+    """HostEnvPool with env stepping fanned across a persistent thread
+    pool — the scaling fix for emulator fleets: the reference ran 8 actor
+    PROCESSES to step 8 ALEs concurrently (reference worker.py:655-762,
+    train.py:44-46); here E≥256 emulator envs on a many-core host step in
+    parallel threads under one vectorized policy. Worthwhile because ALE
+    (and most C-core emulators) release the GIL inside step(); pure-Python
+    envs gain nothing and pure-JAX envs should use their vec adapters
+    instead. Same step()/reset_all() contract as HostEnvPool — per-env
+    ordering is preserved by mapping over the env list index."""
+
+    def __init__(self, envs: Sequence, workers: Optional[int] = None):
+        super().__init__(envs)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=workers or min(32, self.num_envs),
+            thread_name_prefix="envpool",
+        )
+
+    def reset_all(self) -> np.ndarray:
+        return np.stack(list(self._pool.map(lambda e: e.reset(), self.envs)))
+
+    def step(self, actions: np.ndarray):
+        obs, rewards, dones, nxt = zip(
+            *self._pool.map(self._step_one, self.envs, actions)
+        )
+        return np.stack(obs), np.asarray(rewards), np.asarray(dones), np.stack(nxt)
+
+    def close(self) -> None:
+        """Release the worker threads; a sweep building one pool per game
+        must not accumulate idle executors. Also called on GC."""
+        self._pool.shutdown(wait=False)
+
+    def __del__(self):  # best-effort: explicit close() is preferred
+        try:
+            self._pool.shutdown(wait=False)
+        except Exception:
+            pass
 
 
 class VectorizedActor:

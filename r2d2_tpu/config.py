@@ -75,6 +75,11 @@ class R2D2Config:
 
     # --- actor fleet ------------------------------------------------------
     num_actors: int = 8  # reference config.py:21
+    # host env pools: > 0 steps the E envs across a persistent thread pool
+    # of this size (ThreadedHostEnvPool — emulators release the GIL, so a
+    # many-core host parallelizes them; the reference used 8 processes).
+    # 0 = serial loop. Ignored by the pure-JAX vec envs (already batched).
+    env_pool_workers: int = 0
     # collection pacing (threaded mode): target ratio of learner-consumed
     # transitions to collected transitions (the Acme/Reverb
     # samples-per-insert knob). 0 = free-running actors (the reference's

@@ -36,11 +36,15 @@ def make_env(cfg, seed: int = 0):
         )
     if name == "procmaze":
         from r2d2_tpu.envs.functional import FnHostEnv
-        from r2d2_tpu.envs.procmaze import ProcMazeEnv
+        from r2d2_tpu.envs.procmaze import ProcMazeEnv, procmaze_geometry
 
-        return FnHostEnv(ProcMazeEnv, (), seed=seed)
-    if name == "scripted":
-        return ScriptedEnv(obs_shape=cfg.obs_shape, action_dim=cfg.action_dim)
+        grid, cell, horizon = procmaze_geometry(cfg.obs_shape, cfg.max_episode_steps)
+        return FnHostEnv(ProcMazeEnv, (grid, cell, horizon), seed=seed)
+    if name == "scripted" or name.startswith("scripted:"):
+        # "scripted:A" pins the action space independently of cfg — gives
+        # the sweep tests per-game action_dim diversity without ALE
+        adim = int(name.split(":", 1)[1]) if ":" in name else cfg.action_dim
+        return ScriptedEnv(obs_shape=cfg.obs_shape, action_dim=adim)
     from r2d2_tpu.envs.atari import create_atari_env  # gated import
 
     return create_atari_env(cfg.env_name, noop_start=True, noop_max=cfg.noop_max, seed=seed)

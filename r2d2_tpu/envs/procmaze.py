@@ -35,6 +35,18 @@ import jax
 import jax.numpy as jnp
 
 
+def procmaze_geometry(obs_shape, max_episode_steps: int):
+    """(grid, cell, horizon) for a ProcMazeEnv rendering exactly
+    cfg.obs_shape: square, 3-channel, cell size h//16 (>=1)."""
+    h, w, c = obs_shape
+    if h != w or c != 3:
+        raise ValueError(f"procmaze needs a square 3-channel obs_shape, got {obs_shape}")
+    cell = max(h // 16, 1)
+    if h % cell:
+        raise ValueError(f"obs height {h} not divisible by cell {cell}")
+    return h // cell, cell, max_episode_steps
+
+
 class ProcMazeState(NamedTuple):
     walls: jnp.ndarray   # (G, G) bool
     agent: jnp.ndarray   # (2,) int32 row, col

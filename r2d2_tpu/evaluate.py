@@ -36,8 +36,21 @@ def evaluate_params(
     seed: int = 0,
     max_steps: Optional[int] = None,
     policy=None,
+    episodes_per_slot: int = 1,
 ) -> float:
-    """Mean episodic reward over one episode per env slot.
+    """Mean episodic reward over `episodes_per_slot` episodes per env slot
+    (the reference evaluated 5 per checkpoint, test.py:18,32). Slots whose
+    episode ends roll straight into the next one via the vec env's
+    auto-reset — no slot idles (or wastes device work) while slower
+    episodes finish; the recurrent state, last action, and last reward are
+    re-zeroed per slot at each episode boundary exactly as at training
+    episode starts.
+
+    max_steps is a PER-EPISODE budget: the loop runs at most max_steps *
+    episodes_per_slot total env steps. If the budget expires, each slot
+    still short of its episode quota contributes its CURRENT partial
+    return once — every slot counts exactly while it has evidence, so the
+    estimate is not biased against long-surviving (often best) policies.
 
     Pass a prebuilt jitted `policy` when calling repeatedly (the series
     evaluator does) so the acting forward compiles once, not per call."""
@@ -53,24 +66,39 @@ def evaluate_params(
         jnp.zeros((E, cfg.hidden_dim), jnp.float32),
         jnp.zeros((E, cfg.hidden_dim), jnp.float32),
     )
-    ep_reward = np.zeros(E)
-    finished = np.zeros(E, bool)
+    cur_reward = np.zeros(E)
+    completed = np.zeros(E, np.int64)
+    finished_returns: list = []
     steps = 0
-    max_steps = max_steps or cfg.max_episode_steps
+    max_steps = (max_steps or cfg.max_episode_steps) * episodes_per_slot
 
-    while not finished.all() and steps < max_steps:
+    while (completed < episodes_per_slot).any() and steps < max_steps:
         q, carry = policy(params, jnp.asarray(obs), jnp.asarray(last_action), jnp.asarray(last_reward), carry)
         q_np = np.asarray(q)
         greedy = q_np.argmax(1)
         explore = rng.random(E) < cfg.test_epsilon
         actions = np.where(explore, rng.integers(0, cfg.action_dim, E), greedy).astype(np.int32)
         term_obs, rewards, dones, next_obs = vec_env.step(actions)
-        ep_reward += np.where(finished, 0.0, rewards)
-        finished |= dones
-        obs = term_obs
-        last_action, last_reward = actions, rewards.astype(np.float32)
+        active = completed < episodes_per_slot
+        cur_reward += np.where(active, rewards, 0.0)
+        for i in np.nonzero(dones & active)[0]:
+            finished_returns.append(cur_reward[i])
+            completed[i] += 1
+            cur_reward[i] = 0.0
+        # episode boundary: fresh-episode obs (auto-reset) + zeroed
+        # recurrent state / NOOP last action / zero last reward, matching
+        # training episode starts (reference worker.py:496-502)
+        obs = next_obs
+        d = jnp.asarray(dones)
+        carry = tuple(jnp.where(d[:, None], 0.0, c) for c in carry)
+        last_action = np.where(dones, 0, actions).astype(np.int32)
+        last_reward = np.where(dones, 0.0, rewards).astype(np.float32)
         steps += 1
-    return float(ep_reward.mean())
+    # budget expired mid-episode: count each unfinished slot's partial
+    # return once (see docstring)
+    for i in np.nonzero(completed < episodes_per_slot)[0]:
+        finished_returns.append(cur_reward[i])
+    return float(np.mean(finished_returns))
 
 
 def evaluate_params_device(
@@ -81,10 +109,12 @@ def evaluate_params_device(
     num_envs: int = 16,
     seed: int = 0,
     collect_fn=None,
+    episodes_per_slot: int = 1,
 ):
-    """Device-side evaluation for pure-JAX envs: one jitted chunk runs
-    `num_envs` near-greedy episodes (policy + env dynamics in a lax.scan,
-    collect.make_collect_fn) and only episode rewards return to the host.
+    """Device-side evaluation for pure-JAX envs: each of episodes_per_slot
+    jitted chunks runs `num_envs` near-greedy episodes (policy + env
+    dynamics in a lax.scan, collect.make_collect_fn) and only episode
+    rewards return to the host.
 
     On latency-heavy links this is the difference between one dispatch and
     hundreds of per-step round trips. Pass a prebuilt `collect_fn` (from
@@ -95,14 +125,18 @@ def evaluate_params_device(
     the score a partial-return estimate, reported with a warning."""
     if collect_fn is None:
         collect_fn = make_eval_collect_fn(cfg, net, fn_env, num_envs)
-    key = jax.random.PRNGKey(seed)
-    env_state = jax.vmap(fn_env.reset)(jax.random.split(key, num_envs))
     eps = jnp.full(num_envs, cfg.test_epsilon, jnp.float32)
-    (_, _, _, sizes, dones, ep_rewards, _, _) = collect_fn(
-        params, env_state, eps, jax.random.PRNGKey(seed + 1)
-    )
-    dones = np.asarray(dones)
-    ep_rewards = np.asarray(ep_rewards)
+    all_rewards, all_dones = [], []
+    for ep in range(max(episodes_per_slot, 1)):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), ep)
+        env_state = jax.vmap(fn_env.reset)(jax.random.split(key, num_envs))
+        (_, _, _, sizes, dones, ep_rewards, _, _) = collect_fn(
+            params, env_state, eps, jax.random.fold_in(jax.random.PRNGKey(seed + 1), ep)
+        )
+        all_dones.append(np.asarray(dones))
+        all_rewards.append(np.asarray(ep_rewards))
+    dones = np.concatenate(all_dones)
+    ep_rewards = np.concatenate(all_rewards)
     if not dones.all():
         import warnings
 
@@ -129,12 +163,13 @@ def evaluate_series(
     out_path: Optional[str] = None,
     seed: int = 0,
     reward_fn=None,
+    episodes_per_slot: int = 1,
 ):
     """Reference test.py:14-58 equivalent over the orbax series.
 
     reward_fn(net, params) -> float overrides the per-checkpoint
     evaluation (e.g. a device-side evaluator for pure-JAX envs); default
-    is the host vec-env rollout."""
+    is the host vec-env rollout of episodes_per_slot episodes per slot."""
     net, template = init_train_state(cfg, jax.random.PRNGKey(0))
     policy = make_policy(net)
     rows = []
@@ -143,7 +178,10 @@ def evaluate_series(
         if reward_fn is not None:
             reward = reward_fn(net, state.params)
         else:
-            reward = evaluate_params(cfg, net, state.params, vec_env, seed=seed, policy=policy)
+            reward = evaluate_params(
+                cfg, net, state.params, vec_env, seed=seed, policy=policy,
+                episodes_per_slot=episodes_per_slot,
+            )
         row = {
             "step": step,
             "env_steps": env_steps,
@@ -195,6 +233,10 @@ def main(argv=None):
     p.add_argument("--plot", default=None,
                    help="save the two-panel learning curve (reward vs "
                         "frames / vs hours) to this image path")
+    p.add_argument("--episodes", type=int, default=1,
+                   help="completed episodes per env slot per checkpoint "
+                        "(slots roll into fresh episodes via auto-reset; "
+                        "the reference evaluated 5 per checkpoint)")
     p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
                    help="override any R2D2Config field (repeatable, typed "
                         "by the field — must match the training run, e.g. "
@@ -207,7 +249,9 @@ def main(argv=None):
         cfg = cfg.replace(**parse_overrides(args.set))
     vec_env = build_vec_env(cfg, seed=123)
     cfg = cfg.replace(action_dim=vec_env.action_dim)
-    rows = evaluate_series(cfg, vec_env, out_path=args.out)
+    rows = evaluate_series(
+        cfg, vec_env, out_path=args.out, episodes_per_slot=args.episodes
+    )
     if args.plot and rows:
         plot_series(rows, args.plot)
 

@@ -72,10 +72,18 @@ def build_vec_env(cfg: R2D2Config, seed: int = 0):
         )
     if name == "procmaze":
         from r2d2_tpu.envs.functional import FnVecEnv
-        from r2d2_tpu.envs.procmaze import ProcMazeEnv
+        from r2d2_tpu.envs.procmaze import ProcMazeEnv, procmaze_geometry
 
-        return FnVecEnv(ProcMazeEnv(), num_envs=cfg.num_actors, seed=seed)
-    return HostEnvPool([make_env(cfg, seed=seed + i) for i in range(cfg.num_actors)])
+        grid, cell, horizon = procmaze_geometry(cfg.obs_shape, cfg.max_episode_steps)
+        return FnVecEnv(
+            ProcMazeEnv(grid, cell, horizon), num_envs=cfg.num_actors, seed=seed
+        )
+    envs = [make_env(cfg, seed=seed + i) for i in range(cfg.num_actors)]
+    if cfg.env_pool_workers > 0:
+        from r2d2_tpu.actor import ThreadedHostEnvPool
+
+        return ThreadedHostEnvPool(envs, workers=cfg.env_pool_workers)
+    return HostEnvPool(envs)
 
 
 def build_fn_env(cfg: R2D2Config):
@@ -89,13 +97,15 @@ def build_fn_env(cfg: R2D2Config):
             cue_steps=catch_cue_steps(name),
         )
     if name == "procmaze":
-        from r2d2_tpu.envs.procmaze import ProcMazeEnv
+        from r2d2_tpu.envs.procmaze import ProcMazeEnv, procmaze_geometry
 
-        return ProcMazeEnv()
-    if name == "scripted":
+        return ProcMazeEnv(*procmaze_geometry(cfg.obs_shape, cfg.max_episode_steps))
+    if name == "scripted" or name.startswith("scripted:"):
         from r2d2_tpu.envs.fake import ScriptedFnEnv
 
-        return ScriptedFnEnv(obs_shape=cfg.obs_shape, action_dim=cfg.action_dim)
+        # "scripted:A" pins the action space (same rule as make_env)
+        adim = int(name.split(":", 1)[1]) if ":" in name else cfg.action_dim
+        return ScriptedFnEnv(obs_shape=cfg.obs_shape, action_dim=adim)
     raise ValueError(
         f"env {cfg.env_name!r} has no pure-JAX functional core; "
         "use collector='host' for emulator/host-protocol envs"

@@ -297,3 +297,26 @@ def test_train_cli_fused_mode(tmp_path):
 
     rows = [json.loads(l) for l in open(f"{tmp_path}/m.jsonl")]
     assert rows[-1]["step"] == 6
+
+
+def test_evaluate_params_multi_episode_auto_reset():
+    """episodes_per_slot > 1: slots roll into fresh episodes via the vec
+    env's auto-reset, per-slot recurrent state re-zeroes at boundaries,
+    and the mean covers exactly the completed episodes."""
+    import jax
+
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.envs.catch import CatchVecEnv
+    from r2d2_tpu.evaluate import evaluate_params
+    from r2d2_tpu.learner import init_train_state
+
+    cfg = tiny_test().replace(env_name="catch", obs_shape=(12, 12, 1), action_dim=3)
+    vec = CatchVecEnv(num_envs=4, height=12, width=12, seed=0)
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    r3 = evaluate_params(
+        cfg, net, state.params, vec, seed=1, episodes_per_slot=3, max_steps=12
+    )
+    assert -1.0 <= r3 <= 1.0
+    # catch episodes pay exactly +-1: a mean over 12 completed episodes
+    # must be a multiple of 1/12 (it is NOT guaranteed for partial sums)
+    assert abs(r3 * 12 - round(r3 * 12)) < 1e-9
