@@ -12,18 +12,28 @@ whole actor loop is vmappable/jittable. The functional core
 (reset/step/render) is exposed for fully on-device rollout pipelines; the
 CatchVecEnv adapter speaks the host numpy protocol for the generic actor.
 
-MEMORY VARIANT — flashing-cue catch ("memory_catch", cue_steps set): the
-ball is rendered ONLY while ball_y < cue_steps (the first few frames of
-its ~82-step fall), then flies invisibly. A memoryless policy sees nothing
-but the paddle for the rest of the episode and cannot beat chance; solving
-it requires carrying the ball column in recurrent state for ~70+ steps.
-This is the capability the reference demonstrates on MsPacman with the
-R2D2 recipe (stored recurrent states + burn-in replay, reference
-model.py:99-158, worker.py:574) distilled into a pure-JAX env: the
-full-machinery agent must beat the zero-state/no-burn-in ablation
-(config.zero_state_replay) for the recurrent replay plumbing to be doing
-its job. Same dynamics, geometry, and reward as plain catch — only
-observability changes.
+MEMORY VARIANT — flashing-cue catch ("memory_catch", cue_steps set):
+
+- the ball is rendered ONLY while ball_y < cue_steps (the first frames of
+  its fall), then flies invisibly;
+- the paddle is FROZEN during the cue phase: moving under the ball while
+  it is visible would store the answer in the WORLD (paddle position as
+  external memory) and a memoryless policy could then just hold still —
+  freezing forces every pixel of positioning to happen blind, from
+  internal recurrent state;
+- the spawn distance |ball_x − paddle_x| is capped to what the paddle can
+  still cover in the post-cue steps (minus a margin), so every episode
+  remains catchable under optimal play and the reward ceiling stays +1.
+
+A memoryless policy sees only the paddle after the cue and cannot beat
+chance; solving the task requires carrying the ball column in recurrent
+state across the whole blind phase. This is the capability the reference
+demonstrates on MsPacman with the R2D2 recipe (stored recurrent states +
+burn-in replay, reference model.py:99-158, worker.py:574) distilled into
+a pure-JAX env: the full-machinery agent must beat the zero-state /
+no-burn-in ablation (config.zero_state_replay) for the recurrent replay
+plumbing to be doing its job. Dynamics and reward match plain catch —
+only observability, the cue-phase freeze, and the spawn cap change.
 """
 
 from __future__ import annotations
@@ -87,12 +97,28 @@ class CatchEnv:
         self.pw = paddle_width
         self.bs = ball_size
         # memory variant: ball rendered only while ball_y < cue_steps
+        if cue_steps is not None and not (1 <= cue_steps <= height - 3):
+            # cue >= h-2 would freeze the paddle for the whole fall and
+            # leave zero blind steps: a degenerate auto-catch task
+            raise ValueError(
+                f"cue_steps must be in [1, height-3={height - 3}], got {cue_steps}"
+            )
         self.cue = cue_steps
 
     def reset(self, key: jax.Array) -> CatchState:
         key, kx, kp = jax.random.split(key, 3)
         ball_x = jax.random.randint(kx, (), 0, self.w)
-        paddle_x = jax.random.randint(kp, (), 0, self.w)
+        if self.cue is None:
+            paddle_x = jax.random.randint(kp, (), 0, self.w)
+        else:
+            # memory variant: spawn within blind-phase reach (paddle moves
+            # 2/step only after the cue) so optimal play always catches.
+            # Uniform over the VALID interval — clipping an over-wide
+            # offset would pile most spawns onto the walls
+            reach = max(2 * (self.h - 2 - self.cue) - 4, 1)
+            lo = jnp.maximum(ball_x - reach, 0)
+            hi = jnp.minimum(ball_x + reach, self.w - 1)
+            paddle_x = jax.random.randint(kp, (), lo, hi + 1)
         return CatchState(ball_x, jnp.zeros((), jnp.int32), paddle_x, key)
 
     def render(self, s: CatchState) -> jnp.ndarray:
@@ -110,8 +136,12 @@ class CatchEnv:
         return frame[:, :, None]
 
     def step(self, s: CatchState, action: jnp.ndarray):
-        """Returns (state', reward, done). Terminal when the ball lands."""
+        """Returns (state', reward, done). Terminal when the ball lands.
+        In the memory variant the paddle ignores actions during the cue
+        phase (see module docstring)."""
         dx = jnp.where(action == 1, -1, jnp.where(action == 2, 1, 0))
+        if self.cue is not None:
+            dx = jnp.where(s.ball_y < self.cue, 0, dx)
         paddle_x = jnp.clip(s.paddle_x + dx * 2, 0, self.w - 1)
         ball_y = s.ball_y + 1
         done = ball_y >= self.h - 2

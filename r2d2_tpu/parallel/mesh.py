@@ -51,23 +51,53 @@ def shard_batch(mesh: Mesh, batch_pytree):
 
 
 def train_state_shardings(state, mesh: Mesh):
-    """Per-leaf NamedShardings for a TrainState: the LSTM's wide kernels
-    (wi/wh: (in, 4H), the model's largest matmuls) shard their OUTPUT axis
-    over tp; everything else replicates. With tp=1 this degenerates to
-    fully-replicated, so it is safe to apply unconditionally on any mesh.
+    """Per-leaf NamedShardings for a TrainState: every dense matmul in the
+    model shards over tp in Megatron column/row pairs; with tp=1 this
+    degenerates to fully-replicated, so it is safe to apply
+    unconditionally on any mesh.
+
+    The pairing (one collective per pair, inserted by GSPMD from the
+    annotations alone):
+    - LSTM `wi`/`wh` (in, 4H) + bias `b`: COLUMN-parallel — each tp shard
+      owns a 4H/tp slice of every gate; the recurrence's h feeding back
+      into wh re-gathers once per step (the scan's unavoidable tp
+      collective).
+    - encoder `Dense_0` (3136, 512) + bias: COLUMN-parallel (the largest
+      single matmul in the model).
+    - dueling `adv_hidden`/`val_hidden` (H, H) + biases: COLUMN-parallel,
+      paired with `adv_out`/`val_out` (H, A)/(H, 1): ROW-parallel — the
+      contraction over the sharded H axis psums, so each head pair costs
+      one all-reduce and no intermediate gather.
+    - conv kernels stay REPLICATED deliberately: the Nature/IMPALA stacks
+      top out at 64/32 output channels — a tp=2 split leaves 16-32
+      channel shards whose collective cost exceeds the FLOPs they save on
+      the MXU. The convs' FLOPs share is also dominated by the batched
+      seq dimension, which dp already covers.
 
     Scope: the plain-jit learner paths (host/device planes) — XLA/GSPMD
     partitions the matmuls and inserts the tp collectives from these
-    annotations alone. The shard_map paths (sharded/multihost planes) keep
-    params replicated per their P() in_specs; they are dp-scaling designs.
+    annotations alone (compile-level partitioning is pinned by
+    tests/test_learner.py). The shard_map paths (sharded/multihost
+    planes) keep params replicated per their P() in_specs; they are
+    dp-scaling designs.
 
     Adam's mu/nu mirror the param tree structure, so the same path rule
     shards them consistently (optimizer math is elementwise)."""
 
+    COLUMN = {"wi", "wh", "adv_hidden", "val_hidden", "Dense_0"}
+    ROW = {"adv_out", "val_out"}
+    # bias of a column-parallel layer lives on the sharded output axis
+    COLUMN_BIAS_OWNERS = {"core", "adv_hidden", "val_hidden", "Dense_0"}
+
     def spec_for(path, leaf):
         keys = {getattr(p, "key", getattr(p, "name", "")) for p in path}
-        if leaf.ndim == 2 and keys & {"wi", "wh"}:
-            return P(None, "tp")
+        if leaf.ndim == 2:
+            if keys & COLUMN:
+                return P(None, "tp")
+            if keys & ROW:
+                return P("tp", None)
+        if leaf.ndim == 1 and keys & {"b", "bias"} and keys & COLUMN_BIAS_OWNERS:
+            return P("tp")
         return P()
 
     import jax.tree_util as jtu
