@@ -106,15 +106,21 @@ def main():
                         "threaded mode's three streams")
     args = p.parse_args()
 
+    from r2d2_tpu.envs.catch import catch_params as _catch_params
     from r2d2_tpu.envs.catch import is_catch_name
 
     if not is_catch_name(args.env):
         # the demo's action_dim/obs geometry are catch-specific; fail at
         # parse time, not with a shape mismatch mid-run
         p.error(f"--env must be catch or memory_catch[:K], got {args.env!r}")
+    if _catch_params(args.env).get("fall_every", 1) != 1:
+        # slow-fall episodes outlive this demo's episode caps — the
+        # collector would truncate before the ball ever lands
+        p.error("memory_catch:K:F (slow fall) needs the long-context "
+                "sizing: use examples/long_context_demo.py")
     os.makedirs(args.out, exist_ok=True)
 
-    from r2d2_tpu.envs.catch import CatchVecEnv, catch_cue_steps
+    from r2d2_tpu.envs.catch import CatchVecEnv, catch_params
     from r2d2_tpu.evaluate import evaluate_series, plot_series
     from r2d2_tpu.train import Trainer
     from r2d2_tpu.utils.supervision import WorkerStalledError, exit_for_stall
@@ -138,7 +144,7 @@ def main():
         exit_for_stall(e)
 
     h = cfg.obs_shape[0]
-    cue = catch_cue_steps(cfg.env_name)
+    params_kw = catch_params(cfg.env_name)
     reward_fn = None
     if args.full:
         # host-driven eval pays a device round trip per step; at 82-step
@@ -146,13 +152,13 @@ def main():
         from r2d2_tpu.envs.catch import CatchEnv
         from r2d2_tpu.evaluate import evaluate_params_device, make_eval_collect_fn
 
-        fn_env = CatchEnv(height=h, width=h, cue_steps=cue)
+        fn_env = CatchEnv(height=h, width=h, **params_kw)
         collect_fn = make_eval_collect_fn(cfg, trainer.net, fn_env, num_envs=16)
         reward_fn = lambda net, p: evaluate_params_device(
             cfg, net, p, fn_env, num_envs=16, seed=1234, collect_fn=collect_fn
         )
     vec = None if reward_fn else CatchVecEnv(
-        num_envs=16, height=h, width=h, seed=1234, cue_steps=cue
+        num_envs=16, height=h, width=h, seed=1234, **params_kw
     )
     rows = evaluate_series(
         cfg, vec, out_path=os.path.join(args.out, "eval.jsonl"), reward_fn=reward_fn

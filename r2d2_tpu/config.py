@@ -321,20 +321,38 @@ def procgen_impala(game: str = "procmaze") -> R2D2Config:
     ).validate()
 
 
-def long_context(game: str = "Craftax") -> R2D2Config:
-    """seq_len=512 stored-state burn-in stretch config (BASELINE.json
+def long_context(game: str = "memory_catch:8:12") -> R2D2Config:
+    """seq_len=581 stored-state burn-in stretch config (BASELINE.json
     config 5). The LSTM recurrence is sequential in time, so long sequences
     scale via remat-chunked lax.scan over time (SURVEY.md section 5.7), not
-    sequence-dimension sharding."""
+    sequence-dimension sharding.
+
+    The default env is the slow-fall flashing-cue catch
+    (envs/catch.py: cue 8 rows, ball falls every 12 steps -> 984-step
+    episodes at 84x84): each block holds TWO 512-step learning windows,
+    so the second window's burn-in starts from a stored recurrent state
+    that must carry the cue across ~450 blind steps — a genuine
+    long-context memory task, trained end to end by
+    examples/long_context_demo.py. Pass another game name to retarget
+    (e.g. a NetHack/Craftax-class env where one is installed) — the
+    catch-specific geometry below applies only to catch-family names."""
+    from r2d2_tpu.envs.catch import catch_params, is_catch_name
+
+    kw = {}
+    if is_catch_name(game):
+        fall = catch_params(game).get("fall_every", 1)
+        # episode length = (84-2) rows x fall steps/row
+        kw = dict(action_dim=3, max_episode_steps=82 * fall)
     return R2D2Config(
         env_name=game,
         burn_in_steps=64,
         learning_steps=512,
         forward_steps=5,
-        block_length=512,
-        buffer_capacity=2_048_000,  # 4000 blocks of 512
+        block_length=1024,  # 2 learning windows per block
+        buffer_capacity=2_048_000,  # 2000 blocks of 1024
         scan_chunk=64,
         compute_dtype="bfloat16",
+        **kw,
     ).validate()
 
 

@@ -17,6 +17,7 @@ from r2d2_tpu.envs.catch import (
     CatchHostEnv,
     CatchVecEnv,
     catch_cue_steps,
+    catch_params,
     is_catch_name,
 )
 
@@ -32,7 +33,7 @@ def make_env(cfg, seed: int = 0):
     if is_catch_name(name):
         return CatchHostEnv(
             height=cfg.obs_shape[0], width=cfg.obs_shape[1], seed=seed,
-            cue_steps=catch_cue_steps(name),
+            **catch_params(name),
         )
     if name == "procmaze":
         from r2d2_tpu.envs.functional import FnHostEnv

@@ -51,21 +51,41 @@ import numpy as np
 MEMORY_CATCH_DEFAULT_CUE = 8
 
 
-def catch_cue_steps(name: str) -> Optional[int]:
-    """Cue length encoded in an env name: None for plain 'catch', the cue
-    frame count for 'memory_catch' / 'memory_catch:K'. Raises on other
-    names (callers gate on is_catch_name)."""
+def catch_params(name: str) -> dict:
+    """Variant parameters encoded in an env name, as CatchEnv kwargs:
+    'catch' (plain), 'memory_catch' (default cue), 'memory_catch:K'
+    (K-row cue), 'memory_catch:K:F' (K-row cue, ball falls one row every
+    F steps — the LONG-CONTEXT variant: episode length (H-2)*F, so F=12
+    at 84x84 gives ~984-step episodes whose cue must be carried across
+    two 512-step learning windows via stored recurrent state). Raises on
+    other names (callers gate on is_catch_name)."""
     n = name.lower()
     if n == "catch":
-        return None
+        return {}
     if n == "memory_catch":
-        return MEMORY_CATCH_DEFAULT_CUE
+        return {"cue_steps": MEMORY_CATCH_DEFAULT_CUE}
     if n.startswith("memory_catch:"):
-        cue = int(n.split(":", 1)[1])
+        parts = n.split(":")
+        if len(parts) > 3:
+            raise ValueError(
+                f"memory_catch takes at most cue:fall, got {name!r}"
+            )
+        cue = int(parts[1])
         if cue < 1:
             raise ValueError(f"memory_catch cue must be >= 1, got {cue}")
-        return cue
+        out = {"cue_steps": cue}
+        if len(parts) > 2:
+            fall = int(parts[2])
+            if fall < 1:
+                raise ValueError(f"memory_catch fall interval must be >= 1, got {fall}")
+            out["fall_every"] = fall
+        return out
     raise ValueError(f"not a catch family env name: {name!r}")
+
+
+def catch_cue_steps(name: str) -> Optional[int]:
+    """Cue length encoded in an env name (None for plain 'catch')."""
+    return catch_params(name).get("cue_steps")
 
 
 def is_catch_name(name: str) -> bool:
@@ -78,6 +98,7 @@ class CatchState(NamedTuple):
     ball_y: jnp.ndarray   # int32
     paddle_x: jnp.ndarray # int32
     key: jnp.ndarray      # PRNG key
+    t: jnp.ndarray        # int32 step counter (drives slow-fall variants)
 
 
 class CatchEnv:
@@ -92,6 +113,7 @@ class CatchEnv:
         paddle_width: int = 7,
         ball_size: int = 3,
         cue_steps: Optional[int] = None,
+        fall_every: int = 1,
     ):
         self.h, self.w = height, width
         self.pw = paddle_width
@@ -104,6 +126,11 @@ class CatchEnv:
                 f"cue_steps must be in [1, height-3={height - 3}], got {cue_steps}"
             )
         self.cue = cue_steps
+        # long-context variant: the ball falls one row every fall_every
+        # steps, stretching the episode to (h-2)*fall_every env steps
+        if fall_every < 1:
+            raise ValueError(f"fall_every must be >= 1, got {fall_every}")
+        self.fall = fall_every
 
     def reset(self, key: jax.Array) -> CatchState:
         key, kx, kp = jax.random.split(key, 3)
@@ -112,14 +139,16 @@ class CatchEnv:
             paddle_x = jax.random.randint(kp, (), 0, self.w)
         else:
             # memory variant: spawn within blind-phase reach (paddle moves
-            # 2/step only after the cue) so optimal play always catches.
-            # Uniform over the VALID interval — clipping an over-wide
-            # offset would pile most spawns onto the walls
-            reach = max(2 * (self.h - 2 - self.cue) - 4, 1)
+            # 2/step only after the cue; blind steps scale with the fall
+            # interval) so optimal play always catches. Uniform over the
+            # VALID interval — clipping an over-wide offset would pile
+            # most spawns onto the walls
+            reach = max(2 * (self.h - 2 - self.cue) * self.fall - 4, 1)
             lo = jnp.maximum(ball_x - reach, 0)
             hi = jnp.minimum(ball_x + reach, self.w - 1)
             paddle_x = jax.random.randint(kp, (), lo, hi + 1)
-        return CatchState(ball_x, jnp.zeros((), jnp.int32), paddle_x, key)
+        zero = jnp.zeros((), jnp.int32)
+        return CatchState(ball_x, zero, paddle_x, key, zero)
 
     def render(self, s: CatchState) -> jnp.ndarray:
         """(H, W, 1) uint8 frame: ball block + paddle strip at 255. With
@@ -138,24 +167,29 @@ class CatchEnv:
     def step(self, s: CatchState, action: jnp.ndarray):
         """Returns (state', reward, done). Terminal when the ball lands.
         In the memory variant the paddle ignores actions during the cue
-        phase (see module docstring)."""
+        phase; in the slow-fall variant the ball advances one row every
+        fall_every steps (see module docstring)."""
         dx = jnp.where(action == 1, -1, jnp.where(action == 2, 1, 0))
         if self.cue is not None:
             dx = jnp.where(s.ball_y < self.cue, 0, dx)
         paddle_x = jnp.clip(s.paddle_x + dx * 2, 0, self.w - 1)
-        ball_y = s.ball_y + 1
+        t = s.t + 1
+        if self.fall == 1:
+            ball_y = s.ball_y + 1
+        else:
+            ball_y = s.ball_y + jnp.where(t % self.fall == 0, 1, 0)
         done = ball_y >= self.h - 2
         caught = jnp.abs(s.ball_x - paddle_x) <= self.pw // 2
         reward = jnp.where(done, jnp.where(caught, 1.0, -1.0), 0.0)
-        return CatchState(s.ball_x, ball_y, paddle_x, s.key), reward, done
+        return CatchState(s.ball_x, ball_y, paddle_x, s.key, t), reward, done
 
 
 @functools.lru_cache(maxsize=None)
-def _host_fns(height: int, width: int, cue_steps: Optional[int]):
+def _host_fns(height: int, width: int, cue_steps: Optional[int], fall_every: int):
     """Jitted reset/step/render shared by every CatchHostEnv of the same
     geometry — a pool of N envs compiles each computation once, not N
     times."""
-    env = CatchEnv(height, width, cue_steps=cue_steps)
+    env = CatchEnv(height, width, cue_steps=cue_steps, fall_every=fall_every)
     return jax.jit(env.reset), jax.jit(env.step), jax.jit(env.render)
 
 
@@ -166,13 +200,15 @@ class CatchHostEnv:
 
     def __init__(
         self, height: int = 84, width: int = 84, seed: int = 0,
-        cue_steps: Optional[int] = None,
+        cue_steps: Optional[int] = None, fall_every: int = 1,
     ):
-        self.env = CatchEnv(height, width, cue_steps=cue_steps)
+        self.env = CatchEnv(height, width, cue_steps=cue_steps, fall_every=fall_every)
         self.action_dim = CatchEnv.NUM_ACTIONS
         self.obs_shape = (height, width, 1)
         self._key = jax.random.PRNGKey(seed)
-        self._reset, self._step, self._render = _host_fns(height, width, cue_steps)
+        self._reset, self._step, self._render = _host_fns(
+            height, width, cue_steps, fall_every
+        )
         self._state = None
 
     def reset(self) -> np.ndarray:
@@ -193,9 +229,9 @@ class CatchVecEnv:
 
     def __init__(
         self, num_envs: int = 1, height: int = 84, width: int = 84, seed: int = 0,
-        cue_steps: Optional[int] = None,
+        cue_steps: Optional[int] = None, fall_every: int = 1,
     ):
-        self.env = CatchEnv(height, width, cue_steps=cue_steps)
+        self.env = CatchEnv(height, width, cue_steps=cue_steps, fall_every=fall_every)
         self.num_envs = num_envs
         self.action_dim = CatchEnv.NUM_ACTIONS
         self.obs_shape = (height, width, 1)

@@ -62,13 +62,13 @@ from r2d2_tpu.utils.supervision import Supervisor, WorkerStalledError
 
 def build_vec_env(cfg: R2D2Config, seed: int = 0):
     """One vectorized env spanning cfg.num_actors slots."""
-    from r2d2_tpu.envs.catch import catch_cue_steps, is_catch_name
+    from r2d2_tpu.envs.catch import catch_params, is_catch_name
 
     name = cfg.env_name.lower()
     if is_catch_name(name):
         return CatchVecEnv(
             num_envs=cfg.num_actors, height=cfg.obs_shape[0], width=cfg.obs_shape[1],
-            seed=seed, cue_steps=catch_cue_steps(name),
+            seed=seed, **catch_params(name),
         )
     if name == "procmaze":
         from r2d2_tpu.envs.functional import FnVecEnv
@@ -88,13 +88,12 @@ def build_vec_env(cfg: R2D2Config, seed: int = 0):
 
 def build_fn_env(cfg: R2D2Config):
     """Functional (jit/vmap-safe) env core for the on-device collector."""
-    from r2d2_tpu.envs.catch import CatchEnv, catch_cue_steps, is_catch_name
+    from r2d2_tpu.envs.catch import CatchEnv, catch_params, is_catch_name
 
     name = cfg.env_name.lower()
     if is_catch_name(name):
         return CatchEnv(
-            height=cfg.obs_shape[0], width=cfg.obs_shape[1],
-            cue_steps=catch_cue_steps(name),
+            height=cfg.obs_shape[0], width=cfg.obs_shape[1], **catch_params(name)
         )
     if name == "procmaze":
         from r2d2_tpu.envs.procmaze import ProcMazeEnv, procmaze_geometry

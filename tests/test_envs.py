@@ -177,3 +177,36 @@ def test_memory_catch_vec_and_host_wiring():
     assert host.env.cue == 2
     obs = host.reset()
     assert obs.shape == (12, 12, 1)
+
+
+def test_slow_fall_memory_catch():
+    """Long-context variant: ball falls one row every fall_every steps,
+    episode spans (h-2)*fall_every steps, cue visible cue*fall_every
+    steps, reward/catch semantics unchanged."""
+    from r2d2_tpu.envs.catch import catch_params
+
+    assert catch_params("memory_catch:8:12") == {"cue_steps": 8, "fall_every": 12}
+    assert catch_params("catch") == {}
+    env = CatchEnv(height=12, width=12, paddle_width=3, cue_steps=2, fall_every=4)
+    s = env.reset(jax.random.PRNGKey(5))
+    done = False
+    steps = 0
+    total = 0.0
+    cue_visible_steps = 0
+    while not done:
+        a = jnp.where(s.ball_x < s.paddle_x, 1, jnp.where(s.ball_x > s.paddle_x, 2, 0))
+        s, r, done = env.step(s, a)
+        total += float(r)
+        steps += 1
+        if int(s.ball_y) < 2:
+            cue_visible_steps += 1
+    assert steps == (12 - 2) * 4  # slow fall stretches the episode
+    assert cue_visible_steps >= 2 * 4 - 1  # cue spans ~cue*fall steps
+    assert total == 1.0
+
+    # preset wiring: long_context names the slow-fall env and validates
+    from r2d2_tpu.config import long_context
+
+    cfg = long_context()
+    assert cfg.env_name == "memory_catch:8:12"
+    assert cfg.seqs_per_block == 2  # two 512-step windows per block
