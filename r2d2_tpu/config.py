@@ -222,22 +222,10 @@ class R2D2Config:
         if self.replay_plane == "multihost":
             if self.tp_size != 1:
                 raise ValueError("replay_plane='multihost' supports tp_size=1")
-            if self.collector != "host":
-                raise ValueError(
-                    "replay_plane='multihost' uses the host actor path "
-                    "(device-collector support is single-chip only)"
-                )
             if self.updates_per_dispatch != 1:
                 raise ValueError(
                     "replay_plane='multihost' dispatches one collective "
                     "step at a time (updates_per_dispatch must be 1)"
-                )
-            if self.snapshot_replay:
-                raise ValueError(
-                    "snapshot_replay is not implemented for the multihost "
-                    "plane (per-host snapshots of a collective store would "
-                    "need coordinated restore); use the sharded plane for "
-                    "snapshotting"
                 )
         if self.collector not in ("host", "device"):
             raise ValueError(f"unknown collector {self.collector!r}")
@@ -255,10 +243,11 @@ class R2D2Config:
                 "training_steps must be a multiple of updates_per_dispatch "
                 "(each dispatch advances the step counter by that amount)"
             )
-        if self.collector == "device" and self.replay_plane not in ("device", "sharded"):
+        if self.collector == "device" and self.replay_plane == "host":
             raise ValueError(
                 "collector='device' writes packed blocks straight into the "
-                "HBM store; it requires replay_plane='device' or 'sharded'"
+                "HBM store; it requires replay_plane='device', 'sharded', "
+                "or 'multihost'"
             )
         if self.replay_plane == "sharded":
             if self.dp_size * self.tp_size <= 1:

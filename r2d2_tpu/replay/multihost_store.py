@@ -147,6 +147,25 @@ class MultiHostShardedReplay:
 
     # ------------------------------------------------------------------ add
 
+    def _add_one_locked(
+        self, vals: Dict[str, jnp.ndarray], num_sequences: int,
+        learning_total: int, priorities: np.ndarray,
+        episode_reward: Optional[float],
+    ) -> None:
+        """Write ONE block's fields into the next local shard and account
+        it (write first, account last — the add contract shared with the
+        other planes). Caller holds self.lock; vals must live on (or be
+        movable to) the owning shard's device."""
+        g = self.local_ids[self._rr]
+        shard = self.shards[g]
+        vals = {k: jax.device_put(v, self._shard_device[g]) for k, v in vals.items()}
+        with shard.lock:
+            self.stores[g] = self._write(self.stores[g], shard.block_ptr, vals)
+            shard._account_add(
+                num_sequences, learning_total, priorities, episode_reward
+            )
+        self._rr = (self._rr + 1) % len(self.local_ids)
+
     def add_block(
         self, block: Block, priorities: np.ndarray, episode_reward: Optional[float]
     ) -> None:
@@ -154,15 +173,36 @@ class MultiHostShardedReplay:
         hosts add to their own shards independently)."""
         vals = DeviceReplayBuffer.pad_block_fields(self.cfg, block)
         with self.lock:
-            g = self.local_ids[self._rr]
-            shard = self.shards[g]
-            with shard.lock:
-                self.stores[g] = self._write(self.stores[g], shard.block_ptr, vals)
-                shard._account_add(
-                    block.num_sequences, int(block.learning_steps.sum()),
-                    priorities, episode_reward,
+            self._add_one_locked(
+                vals, block.num_sequences, int(block.learning_steps.sum()),
+                priorities, episode_reward,
+            )
+
+    def add_blocks_batch(
+        self,
+        fields: Dict[str, jnp.ndarray],
+        num_seq: np.ndarray,
+        learning_totals: np.ndarray,
+        priorities: np.ndarray,
+        episode_rewards: np.ndarray,
+        dones: np.ndarray,
+    ) -> None:
+        """Write E collector-packed blocks round-robin across this host's
+        LOCAL shards (the DeviceCollector contract, mirroring
+        ShardedDeviceReplay.add_blocks_batch): collection is host-local,
+        so the device collector composes with the multihost plane exactly
+        like with the single-host planes. Block i's fields hop from the
+        collect dispatch's device to the owning shard's device (an
+        intra-host copy of ~one block)."""
+        with self.lock:
+            for i in range(len(num_seq)):
+                self._add_one_locked(
+                    {k: v[i] for k, v in fields.items()},
+                    int(num_seq[i]),
+                    int(learning_totals[i]),
+                    priorities[i],
+                    float(episode_rewards[i]) if dones[i] else None,
                 )
-            self._rr = (self._rr + 1) % len(self.local_ids)
 
     # --------------------------------------------------------------- global
 

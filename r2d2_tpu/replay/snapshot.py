@@ -77,17 +77,20 @@ def _check_kind(kind: str, want: str) -> None:
         raise ValueError(f"snapshot kind {kind!r} != replay plane {want!r}")
 
 
-def _validated_stores(d, current: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-    """Load every store field from the npz, checking shape/dtype against the
+def _validated_stores(
+    d, current: Dict[str, np.ndarray], prefix: str = "store_"
+) -> Dict[str, np.ndarray]:
+    """Load every store field from the npz ONCE (NpzFile re-parses per
+    access, and obs dominate the file), checking shape/dtype against the
     live buffer BEFORE the caller mutates anything — a mismatched snapshot
     must leave the buffer untouched."""
     out = {}
     for k in STORE_FIELDS:
         cur = current[k]
-        val = d["store_" + k]
+        val = d[prefix + k]
         if val.shape != cur.shape or val.dtype != cur.dtype:
             raise ValueError(
-                f"store {k}: snapshot {val.shape}/{val.dtype} != "
+                f"store {prefix}{k}: snapshot {val.shape}/{val.dtype} != "
                 f"buffer {cur.shape}/{cur.dtype}"
             )
         out[k] = val
@@ -107,9 +110,24 @@ def save_replay(replay, path: str) -> None:
 
     The payload (control state + a copy of every store) is captured under
     the buffer lock; the npz write happens after release."""
+    from r2d2_tpu.replay.multihost_store import MultiHostShardedReplay
     from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
 
-    if isinstance(replay, ShardedDeviceReplay):
+    if isinstance(replay, MultiHostShardedReplay):
+        # PER-HOST snapshot: each process saves only the shards it owns
+        # (keyed by GLOBAL shard id), to its own path — restore requires
+        # the same process layout, which is validated, not assumed
+        with replay.lock:
+            payload = {"kind": np.asarray("multihost")}
+            payload["local_ids"] = np.asarray(replay.local_ids, np.int64)
+            payload["rr"] = np.asarray(replay._rr)
+            for g in replay.local_ids:
+                shard = replay.shards[g]
+                with shard.lock:
+                    payload.update(_plane_state(shard, prefix=f"g{g}_"))
+                    for k in STORE_FIELDS:
+                        payload[f"g{g}_store_{k}"] = np.asarray(replay.stores[g][k])
+    elif isinstance(replay, ShardedDeviceReplay):
         with replay.lock:
             payload: Dict[str, np.ndarray] = {"kind": np.asarray("sharded")}
             payload["rr"] = np.asarray(replay._rr)
@@ -143,11 +161,40 @@ def restore_replay(replay, path: str) -> None:
     Mismatches (different plane kind, capacity, obs shape, hidden dim, dp)
     raise BEFORE any state is touched — a failed restore leaves the buffer
     exactly as constructed."""
+    from r2d2_tpu.replay.multihost_store import MultiHostShardedReplay
     from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
 
     with np.load(path, allow_pickle=False) as d:
         kind = str(d["kind"])
-        if isinstance(replay, ShardedDeviceReplay):
+        if isinstance(replay, MultiHostShardedReplay):
+            _check_kind(kind, "multihost")
+            with replay.lock:
+                saved_ids = [int(x) for x in d["local_ids"]]
+                if saved_ids != list(replay.local_ids):
+                    raise ValueError(
+                        f"snapshot owns global shards {saved_ids}, this "
+                        f"process owns {list(replay.local_ids)} — restore "
+                        "with the same process/mesh layout"
+                    )
+                # validate EVERY shard before mutating anything (the
+                # validated arrays are reused below — one npz read each)
+                vals_by_shard = {}
+                for g in replay.local_ids:
+                    if len(d[f"g{g}_tree_leaves"]) != replay.shards[g].tree.capacity:
+                        raise ValueError(f"shard {g}: tree size mismatch")
+                    vals_by_shard[g] = _validated_stores(
+                        d, replay.stores[g], prefix=f"g{g}_store_"
+                    )
+                replay._rr = int(d["rr"][()])
+                for g in replay.local_ids:
+                    shard = replay.shards[g]
+                    with shard.lock:
+                        _restore_plane(shard, d, prefix=f"g{g}_")
+                        replay.stores[g] = {
+                            k: jax.device_put(v, replay._shard_device[g])
+                            for k, v in vals_by_shard[g].items()
+                        }
+        elif isinstance(replay, ShardedDeviceReplay):
             _check_kind(kind, "sharded")
             with replay.lock:
                 vals = _validated_stores(d, replay.stores)

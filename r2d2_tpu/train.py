@@ -464,8 +464,18 @@ class Trainer:
                 restore_replay(self.replay, snap)
                 # restored env steps are part of the run total already
                 # counted by env_steps_offset from the learner checkpoint;
-                # rebase so the sum isn't double-counted
-                self.env_steps_offset -= self.replay.env_steps
+                # rebase so the sum isn't double-counted. The offset is a
+                # GLOBAL total, so a multi-process run must subtract the
+                # GLOBAL restored count (each host's snapshot holds only
+                # its local shards' steps — mirror _global_env_steps)
+                restored = self.replay.env_steps
+                if jax.process_count() > 1:
+                    from jax.experimental import multihost_utils
+
+                    restored = int(
+                        multihost_utils.process_allgather(np.int64(restored)).sum()
+                    )
+                self.env_steps_offset -= restored
         self.param_store = ParamStore(self.state.params)
         if cfg.collector == "device":
             from r2d2_tpu.collect import DeviceCollector
@@ -557,6 +567,13 @@ class Trainer:
             drain()
 
     def _replay_snapshot_path(self) -> str:
+        # the multihost plane snapshots PER PROCESS (each host owns its
+        # shards); a shared checkpoint dir must not collide across hosts
+        if self.cfg.replay_plane == "multihost":
+            return os.path.join(
+                self.cfg.checkpoint_dir,
+                f"replay_snapshot_p{jax.process_index()}.npz",
+            )
         return os.path.join(self.cfg.checkpoint_dir, "replay_snapshot.npz")
 
     def save_replay_snapshot(self) -> str:
