@@ -460,22 +460,39 @@ class Trainer:
             from r2d2_tpu.replay.snapshot import restore_replay
 
             snap = self._replay_snapshot_path()
+            # restored env steps are part of the run total already counted
+            # by env_steps_offset from the learner checkpoint; rebase so
+            # the sum isn't double-counted. The offset is a GLOBAL total,
+            # so a multi-process run subtracts the GLOBAL restored count
+            # (each host's snapshot holds only its local shards' steps).
+            # EVERY process participates in the collective unconditionally
+            # — a host whose snapshot is missing contributes 0, and a
+            # failed restore is agreed across hosts — because a collective
+            # guarded by per-host file checks deadlocks the others.
+            restored, failed = 0, 0
             if os.path.exists(snap):
-                restore_replay(self.replay, snap)
-                # restored env steps are part of the run total already
-                # counted by env_steps_offset from the learner checkpoint;
-                # rebase so the sum isn't double-counted. The offset is a
-                # GLOBAL total, so a multi-process run must subtract the
-                # GLOBAL restored count (each host's snapshot holds only
-                # its local shards' steps — mirror _global_env_steps)
-                restored = self.replay.env_steps
-                if jax.process_count() > 1:
-                    from jax.experimental import multihost_utils
+                try:
+                    restore_replay(self.replay, snap)
+                    restored = self.replay.env_steps
+                except Exception as e:  # noqa: BLE001 — agreed below
+                    failed = 1
+                    restore_err = e
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
 
-                    restored = int(
-                        multihost_utils.process_allgather(np.int64(restored)).sum()
-                    )
-                self.env_steps_offset -= restored
+                gathered = multihost_utils.process_allgather(
+                    np.asarray([restored, failed], np.int64)
+                )
+                restored = int(gathered[:, 0].sum())
+                if int(gathered[:, 1].sum()):
+                    bad = [int(p) for p in np.nonzero(gathered[:, 1])[0]]
+                    raise RuntimeError(
+                        f"replay snapshot restore failed on process(es) "
+                        f"{bad}"
+                    ) from (restore_err if failed else None)
+            elif failed:
+                raise restore_err
+            self.env_steps_offset -= restored
         self.param_store = ParamStore(self.state.params)
         if cfg.collector == "device":
             from r2d2_tpu.collect import DeviceCollector

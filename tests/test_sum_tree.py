@@ -229,3 +229,110 @@ def test_control_plane_fuzz_contiguous_reservations_and_lap_stamps():
         np.testing.assert_array_equal(cp.occupied, occupied)
         np.testing.assert_allclose(cp.tree.leaves(), leaf, rtol=1e-9)
         np.testing.assert_allclose(cp.tree.total, leaf.sum(), rtol=1e-9)
+
+
+def test_control_plane_fuzz_deferred_reservation_protocol():
+    """The deferred-drain protocol's control-plane half (FusedSystemRunner
+    semantics): _reserve_advance retires the reserved slots and advances
+    the pointer BEFORE the chunk's data exists; _account_blocks_at installs
+    the accounting any number of ops later. Fuzzed invariants:
+    - reserved-but-unaccounted slots hold zero priority mass (no draw can
+      target them) and are excluded from size/env accounting;
+    - stamped priority applications respect the pointer-window mask with
+      reserve-time advancement (the model replays the same rule);
+    - accounting at the reserved slots restores exact bookkeeping."""
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.replay.control_plane import ReplayControlPlane
+
+    cfg = tiny_test().replace(buffer_capacity=160, learning_starts=16)  # 10 slots
+    cp = ReplayControlPlane(cfg)
+    rng = np.random.default_rng(21)
+    S, nb, L = cfg.seqs_per_block, cfg.num_blocks, cfg.learning_steps
+
+    leaf = np.zeros(cfg.num_sequences)
+    learning = np.zeros(nb, np.int64)
+    occupied = np.zeros(nb, bool)
+    ptr = advances = size = env = 0
+    pending_prio = []   # (idxes, old_ptr, old_advances)
+    pending_chunk = None  # (start, n) reserved but not yet accounted
+
+    def model_retire(slots):
+        nonlocal size
+        occ = slots[occupied[slots]]
+        if occ.size:
+            leaf[(occ[:, None] * S + np.arange(S)).ravel()] = 0.0
+            size -= int(learning[occ].sum())
+            learning[occ] = 0
+            occupied[occ] = False
+
+    for op in rng.integers(0, 4, size=800):
+        if op == 0 and pending_chunk is None:  # reserve-advance a chunk
+            n = int(rng.integers(1, 4))
+            with cp.lock:
+                start = cp._reserve_advance(n)
+            if ptr + n > nb:  # tail retirement + wrap
+                model_retire(np.arange(ptr, nb))
+                advances += nb - ptr
+                ptr = 0
+            assert start == ptr
+            model_retire(np.arange(start, start + n))
+            advances += n
+            ptr = (ptr + n) % nb
+            pending_chunk = (start, n)
+            # reserved slots carry no mass: undrawable
+            idx = (np.arange(start, start + n)[:, None] * S + np.arange(S)).ravel()
+            np.testing.assert_array_equal(cp.tree.priorities_of(idx), 0.0)
+        elif op == 1 and pending_chunk is not None:  # deferred accounting
+            start, n = pending_chunk
+            pending_chunk = None
+            ns = rng.integers(1, S + 1, size=n)
+            steps = ns * L - rng.integers(0, L, size=n)
+            prios = np.zeros((n, S), np.float32)
+            for i in range(n):
+                prios[i, : ns[i]] = rng.uniform(0.1, 2.0, int(ns[i]))
+            with cp.lock:
+                cp._account_blocks_at(
+                    start, ns.astype(np.int64), steps.astype(np.int64), prios,
+                    np.ones(n), np.zeros(n, bool),
+                )
+            for i in range(n):
+                slot = start + i
+                leaf[slot * S : (slot + 1) * S] = (
+                    prios[i].astype(np.float64) ** cfg.prio_exponent
+                )
+                size += int(steps[i]) - learning[slot]
+                env += int(steps[i])
+                learning[slot] = steps[i]
+                occupied[slot] = True
+        elif op == 2 and cp.tree.total > 0:
+            with cp.lock:
+                b, s, idxes, w = cp._draw(rng)
+            # draws can only land on accounted (occupied) slots
+            assert occupied[idxes // S].all()
+            pending_prio.append((idxes, cp.block_ptr, cp.ptr_advances))
+        elif op == 3 and pending_prio:
+            idxes, old_ptr, old_adv = pending_prio.pop(int(rng.integers(len(pending_prio))))
+            td = rng.uniform(0.1, 3.0, len(idxes))
+            cp.update_priorities(idxes, td, old_ptr, old_adv)
+            if advances - old_adv < nb:
+                p = cp.block_ptr
+                if p > old_ptr:
+                    mask = (idxes < old_ptr * S) | (idxes >= p * S)
+                elif p < old_ptr:
+                    mask = (idxes < old_ptr * S) & (idxes >= p * S)
+                else:
+                    mask = np.ones(len(idxes), bool)
+                # rows on still-unaccounted reserved slots would resurrect
+                # retired leaves — but the window mask must already have
+                # rejected them (reservation advanced the pointer)
+                if pending_chunk is not None:
+                    start, n = pending_chunk
+                    in_chunk = (idxes // S >= start) & (idxes // S < start + n)
+                    assert not (mask & in_chunk).any()
+                leaf[idxes[mask]] = td[mask] ** cfg.prio_exponent
+        # invariants after every op
+        assert len(cp) == size
+        assert cp.env_steps == env
+        assert cp.block_ptr == ptr
+        assert cp.ptr_advances == advances
+        np.testing.assert_allclose(cp.tree.leaves(), leaf, rtol=1e-9)
