@@ -1,0 +1,24 @@
+#!/bin/bash
+# Waits for the midscale pair, then runs the 84x84 memory-catch proof pair.
+# cue=40 aligns the cue phase exactly with seq0/burn-in windows: seq1+ is
+# fully blind, so the zero-state ablation has no path to the ball column.
+cd /root/repo
+while ! grep -q MID_ALL_DONE runs/mc_mid_driver.log 2>/dev/null; do sleep 60; done
+run_with_retry() {
+  local out=$1; shift
+  local tries=0
+  python examples/catch_demo.py --out "$out" "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1))
+    echo "=== stall exit 86; resuming $out (try $tries) ==="
+    python examples/catch_demo.py --out "$out" "$@" --resume
+    rc=$?
+  done
+  return $rc
+}
+run_with_retry runs/memcatch84_main --env memory_catch:40 --full --steps 100000 --mode fused
+echo "=== FULL MAIN EXIT: $? ==="
+run_with_retry runs/memcatch84_zerostate --env memory_catch:40 --full --steps 100000 --mode fused --ablate-zero-state
+echo "=== FULL ABLATION EXIT: $? ==="
+echo FULL_ALL_DONE

@@ -1,0 +1,7 @@
+#!/bin/bash
+cd /root/repo
+python examples/catch_demo.py --out runs/mc_mid_main --env memory_catch:10 --steps 48000 --mode fused
+echo "=== MID MAIN EXIT: $? ==="
+python examples/catch_demo.py --out runs/mc_mid_zerostate --env memory_catch:10 --steps 48000 --mode fused --ablate-zero-state
+echo "=== MID ABLATION EXIT: $? ==="
+echo MID_ALL_DONE

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Full-scale memory_catch learning proof: main run (stored-state + burn-in)
+# then the zero-state ablation. Retries with --resume on stall exit 86.
+cd /root/repo
+run_with_retry() {
+  local out=$1; shift
+  local tries=0
+  python examples/catch_demo.py --out "$out" "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1))
+    echo "=== stall exit 86; resuming $out (try $tries) ==="
+    python examples/catch_demo.py --out "$out" "$@" --resume
+    rc=$?
+  done
+  return $rc
+}
+run_with_retry runs/memory_catch_full --env memory_catch --full --steps 100000 --mode fused
+echo "=== MAIN RUN EXIT: $? ==="
+run_with_retry runs/memory_catch_zerostate --env memory_catch --full --steps 100000 --mode fused --ablate-zero-state
+echo "=== ABLATION RUN EXIT: $? ==="
+echo ALL_DONE

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Runs after the full-scale memory pair: procmaze e2e (IMPALA encoder) and
+# the long-context learning run.
+cd /root/repo
+while ! grep -q FULL_ALL_DONE runs/mc_full_driver.log 2>/dev/null; do sleep 60; done
+
+mkdir -p runs/procmaze
+python -m r2d2_tpu.train --preset procgen_impala --mode fused --steps 30000 \
+  --updates-per-dispatch 16 \
+  --set checkpoint_dir=runs/procmaze/ckpt \
+  --set metrics_path=runs/procmaze/metrics.jsonl \
+  --set buffer_capacity=200000 --set learning_starts=30000 \
+  --set samples_per_insert=15.0 --set save_interval=3750
+echo "=== PROCMAZE TRAIN EXIT: $? ==="
+python -m r2d2_tpu.evaluate --preset procgen_impala --episodes 2 \
+  --out runs/procmaze/eval.jsonl --plot runs/procmaze/curve.jpg \
+  --set checkpoint_dir=runs/procmaze/ckpt
+echo "=== PROCMAZE EVAL EXIT: $? ==="
+
+python examples/long_context_demo.py --out runs/long_context --steps 12000
+echo "=== LONG CONTEXT EXIT: $? ==="
+echo TAIL_ALL_DONE
