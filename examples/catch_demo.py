@@ -98,6 +98,9 @@ def main():
                         "machinery's proof of life")
     p.add_argument("--resume", action="store_true",
                    help="continue from the checkpoints under --out")
+    p.add_argument("--eval-episodes", type=int, default=1,
+                   help="episodes per eval slot per checkpoint (16 slots; "
+                        "raise for lower-variance curves)")
     p.add_argument("--mode", default="threaded", choices=["threaded", "fused"],
                    help="fused: single-threaded megastep loop (one dispatch "
                         "= K updates + collection chunk) — no concurrent "
@@ -155,13 +158,15 @@ def main():
         fn_env = CatchEnv(height=h, width=h, **params_kw)
         collect_fn = make_eval_collect_fn(cfg, trainer.net, fn_env, num_envs=16)
         reward_fn = lambda net, p: evaluate_params_device(
-            cfg, net, p, fn_env, num_envs=16, seed=1234, collect_fn=collect_fn
+            cfg, net, p, fn_env, num_envs=16, seed=1234, collect_fn=collect_fn,
+            episodes_per_slot=args.eval_episodes,
         )
     vec = None if reward_fn else CatchVecEnv(
         num_envs=16, height=h, width=h, seed=1234, **params_kw
     )
     rows = evaluate_series(
-        cfg, vec, out_path=os.path.join(args.out, "eval.jsonl"), reward_fn=reward_fn
+        cfg, vec, out_path=os.path.join(args.out, "eval.jsonl"), reward_fn=reward_fn,
+        episodes_per_slot=args.eval_episodes,
     )
     if not rows:
         print("no checkpoints to evaluate (steps < save_interval?)")
