@@ -1,0 +1,19 @@
+#!/bin/bash
+cd /root/repo
+while ! grep -q TAIL2_ALL_DONE runs/tail2_driver.log 2>/dev/null; do sleep 60; done
+mkdir -p runs/procmaze_v2
+python -m r2d2_tpu.train --preset procgen_impala --mode fused --steps 30000 \
+  --updates-per-dispatch 16 \
+  --set checkpoint_dir=runs/procmaze_v2/ckpt \
+  --set metrics_path=runs/procmaze_v2/metrics.jsonl \
+  --set buffer_capacity=200000 --set learning_starts=30000 \
+  --set samples_per_insert=15.0 --set save_interval=3750 \
+  --set forward_steps=20 --set target_net_update_interval=500 \
+  --set num_actors=16
+echo "=== PROCMAZE V2 TRAIN EXIT: $? ==="
+python -m r2d2_tpu.evaluate --preset procgen_impala --episodes 2 \
+  --out runs/procmaze_v2/eval.jsonl --plot runs/procmaze_v2/curve.jpg \
+  --set forward_steps=20 --set num_actors=16 \
+  --set checkpoint_dir=runs/procmaze_v2/ckpt
+echo "=== PROCMAZE V2 EVAL EXIT: $? ==="
+echo PMV2_ALL_DONE

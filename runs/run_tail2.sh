@@ -1,0 +1,23 @@
+#!/bin/bash
+cd /root/repo
+mkdir -p runs/procmaze
+python -m r2d2_tpu.train --preset procgen_impala --mode fused --steps 30000 \
+  --updates-per-dispatch 16 \
+  --set checkpoint_dir=runs/procmaze/ckpt \
+  --set metrics_path=runs/procmaze/metrics.jsonl \
+  --set buffer_capacity=200000 --set learning_starts=30000 \
+  --set samples_per_insert=15.0 --set save_interval=3750
+echo "=== PROCMAZE TRAIN EXIT: $? ==="
+python -m r2d2_tpu.evaluate --preset procgen_impala --episodes 2 \
+  --out runs/procmaze/eval.jsonl --plot runs/procmaze/curve.jpg \
+  --set checkpoint_dir=runs/procmaze/ckpt
+echo "=== PROCMAZE EVAL EXIT: $? ==="
+
+python examples/long_context_demo.py --out runs/long_context --steps 12000
+echo "=== LONG CONTEXT EXIT: $? ==="
+
+# extended full-scale memory run: +100k on top of the first 100k budget
+python examples/catch_demo.py --out runs/memcatch84_main --env memory_catch:40 \
+  --full --steps 200000 --mode fused --resume
+echo "=== MEMCATCH84 EXTENSION EXIT: $? ==="
+echo TAIL2_ALL_DONE
