@@ -161,13 +161,20 @@ def test_priority_roundtrip_per_shard_staleness(mesh):
     assert after != before
 
 
+def _stack_block_fields(cfg, blocks):
+    """Pad each block to store-slot shape and stack to (E, ...) device
+    arrays — the collector's add_blocks_batch packing, shared by the
+    batched-path tests."""
+    from r2d2_tpu.replay.device_store import DeviceReplayBuffer
+
+    padded = [DeviceReplayBuffer.pad_block_fields(cfg, blk) for blk in blocks]
+    return {k: jnp.stack([jnp.asarray(p[k]) for p in padded]) for k in padded[0]}
+
+
 def test_sharded_add_blocks_batch_matches_sequential():
     """The collector's batched scatter lands blocks in the same slots with
     the same accounting as E sequential add_block calls."""
-    import jax.numpy as jnp
-
     from bench import synth_block
-    from r2d2_tpu.replay.device_store import DeviceReplayBuffer
 
     dp = 4
     mesh = make_mesh(dp=dp, tp=1, devices=jax.devices()[:dp])
@@ -185,13 +192,7 @@ def test_sharded_add_blocks_batch_matches_sequential():
     for blk, p, r, d in zip(blocks, prios, rewards, dones):
         a.add_block(blk, p, float(r) if d else None)
 
-    fields = {
-        k: jnp.stack([
-            jnp.asarray(DeviceReplayBuffer.pad_block_fields(cfg, blk)[k])
-            for blk in blocks
-        ])
-        for k in DeviceReplayBuffer.pad_block_fields(cfg, blocks[0])
-    }
+    fields = _stack_block_fields(cfg, blocks)
     b.add_blocks_batch(
         fields,
         np.asarray([blk.num_sequences for blk in blocks]),
@@ -209,3 +210,82 @@ def test_sharded_add_blocks_batch_matches_sequential():
         np.testing.assert_allclose(sa.tree.tree, sb.tree.tree, rtol=1e-12)
     for k in a.stores:
         np.testing.assert_array_equal(np.asarray(a.stores[k]), np.asarray(b.stores[k]))
+
+
+def test_sharded_add_blocks_batch_post_wrap_tail_retirement():
+    """AFTER a shard's local ring wraps, the batched path deliberately
+    diverges from sequential add_block: _reserve_contiguous retires the
+    ring tail so each slab stays contiguous (zeroed priorities, size
+    deducted, slots freed), where the sequential path would wrap slot by
+    slot without retiring. This pins the documented intended divergence
+    (the add_blocks_batch docstring) instead of leaving it folklore."""
+    from bench import synth_block
+
+    dp = 2
+    mesh = make_mesh(dp=dp, tp=1, devices=jax.devices()[:dp])
+    # 640 capacity / 16 block = 40 slots -> 20 per shard
+    cfg = tiny_test().replace(dp_size=dp, replay_plane="sharded", batch_size=8)
+    sh = ShardedDeviceReplay(cfg, mesh)
+    bps = sh.blocks_per_shard
+    rng = np.random.default_rng(3)
+    S = cfg.seqs_per_block
+
+    def batch(n):
+        blocks = [synth_block(cfg, rng) for _ in range(n)]
+        fields = _stack_block_fields(cfg, blocks)
+        prios = rng.uniform(0.5, 2.0, (n, S)).astype(np.float32)
+        return fields, prios
+
+    per = 3
+    n = per * dp
+    steps_per_block = cfg.block_length
+    # lap 1: batches to slot 18 per shard, then SEQUENTIAL adds fill the
+    # 2-slot tail (the sequential path has no contiguity constraint) —
+    # every slot occupied, pointers wrapped to 0
+    filled = 0
+    while filled + per <= bps - 1:
+        fields, prios = batch(n)
+        sh.add_blocks_batch(
+            fields, np.full(n, S), np.full(n, steps_per_block), prios,
+            np.zeros(n), np.zeros(n, bool),
+        )
+        filled += per
+    tail = bps - filled  # stranded tail per shard if only batches wrote
+    assert 0 < tail < per
+    for _ in range(dp * tail):
+        sh.add_block(
+            synth_block(cfg, rng),
+            rng.uniform(0.5, 2.0, S).astype(np.float32), None,
+        )
+    assert all(s.block_ptr == 0 and s.occupied.all() for s in sh.shards)
+
+    # lap 2: batches march back to slot 18 over the full ring
+    for k in range(filled // per):
+        fields, prios = batch(n)
+        sh.add_blocks_batch(
+            fields, np.full(n, S), np.full(n, steps_per_block), prios,
+            np.zeros(n), np.zeros(n, bool),
+        )
+    size_before = len(sh)
+    assert size_before == dp * bps * steps_per_block  # ring full
+    assert all(s.block_ptr == filled for s in sh.shards)
+
+    # this batch cannot fit the OCCUPIED tail: each shard wraps, RETIRES
+    # the tail (sequential add_block would instead wrap slot by slot —
+    # the documented intended divergence), and overwrites slots [0, per)
+    fields, prios = batch(n)
+    sh.add_blocks_batch(
+        fields, np.full(n, S), np.full(n, steps_per_block), prios,
+        np.zeros(n), np.zeros(n, bool),
+    )
+    for s in sh.shards:
+        assert s.block_ptr == per  # wrapped to 0, wrote per blocks
+        tail_slots = np.arange(filled, bps)
+        assert not s.occupied[tail_slots].any()
+        leaves = s.tree.priorities_of(
+            (tail_slots[:, None] * S + np.arange(S)).ravel()
+        )
+        np.testing.assert_array_equal(leaves, 0.0)
+    # net: the n new blocks evict n occupied slots (wash) and the
+    # retirement removes dp*tail occupied blocks outright
+    assert len(sh) == size_before - dp * tail * steps_per_block
