@@ -101,6 +101,9 @@ def main():
     p.add_argument("--eval-episodes", type=int, default=1,
                    help="episodes per eval slot per checkpoint (16 slots; "
                         "raise for lower-variance curves)")
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   help="override any R2D2Config field on top of the demo "
+                        "config (repeatable, typed by the field)")
     p.add_argument("--mode", default="threaded", choices=["threaded", "fused"],
                    help="fused: single-threaded megastep loop (one dispatch "
                         "= K updates + collection chunk) — no concurrent "
@@ -135,6 +138,10 @@ def main():
         cfg = cfg.replace(samples_per_insert=15.0)
     if args.ablate_zero_state:
         cfg = cfg.replace(burn_in_steps=0, zero_state_replay=True)
+    if args.set:
+        from r2d2_tpu.config import parse_overrides
+
+        cfg = cfg.replace(**parse_overrides(args.set))
     trainer = Trainer(cfg, resume=args.resume)
     try:
         if args.mode == "fused":
