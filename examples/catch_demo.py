@@ -98,9 +98,10 @@ def main():
                         "machinery's proof of life")
     p.add_argument("--resume", action="store_true",
                    help="continue from the checkpoints under --out")
-    p.add_argument("--eval-episodes", type=int, default=1,
-                   help="episodes per eval slot per checkpoint (16 slots; "
-                        "raise for lower-variance curves)")
+    p.add_argument("--eval-episodes", type=int, default=4,
+                   help="episodes per eval slot per checkpoint (16 slots, "
+                        "so the default is 64 episodes per point — the "
+                        "reference averaged 5 total, test.py:18,32)")
     p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
                    help="override any R2D2Config field on top of the demo "
                         "config (repeatable, typed by the field)")
@@ -174,6 +175,7 @@ def main():
     rows = evaluate_series(
         cfg, vec, out_path=os.path.join(args.out, "eval.jsonl"), reward_fn=reward_fn,
         episodes_per_slot=args.eval_episodes,
+        episodes_per_checkpoint=16 * args.eval_episodes,
     )
     if not rows:
         print("no checkpoints to evaluate (steps < save_interval?)")

@@ -86,7 +86,8 @@ def main():
         cfg, net, p, fn_env, num_envs=16, seed=1234, collect_fn=collect_fn
     )
     rows = evaluate_series(
-        cfg, None, out_path=os.path.join(args.out, "eval.jsonl"), reward_fn=reward_fn
+        cfg, None, out_path=os.path.join(args.out, "eval.jsonl"), reward_fn=reward_fn,
+        episodes_per_checkpoint=16,
     )
     if rows:
         plot_series(rows, os.path.join(args.out, "curve.jpg"))

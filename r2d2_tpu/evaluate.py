@@ -47,10 +47,13 @@ def evaluate_params(
     episode starts.
 
     max_steps is a PER-EPISODE budget: the loop runs at most max_steps *
-    episodes_per_slot total env steps. If the budget expires, each slot
-    still short of its episode quota contributes its CURRENT partial
-    return once — every slot counts exactly while it has evidence, so the
-    estimate is not biased against long-surviving (often best) policies.
+    episodes_per_slot total env steps. If the budget expires, a slot that
+    has completed NO episode yet contributes its current partial return
+    once (so long-surviving — often best — policies still count); slots
+    with at least one finished episode contribute only their finished
+    returns (a partial from a slot that just auto-reset would be a
+    near-zero sample and would give slow slots completed+1 samples vs
+    exactly episodes_per_slot for fast ones).
 
     Pass a prebuilt jitted `policy` when calling repeatedly (the series
     evaluator does) so the acting forward compiles once, not per call."""
@@ -94,9 +97,9 @@ def evaluate_params(
         last_action = np.where(dones, 0, actions).astype(np.int32)
         last_reward = np.where(dones, 0.0, rewards).astype(np.float32)
         steps += 1
-    # budget expired mid-episode: count each unfinished slot's partial
-    # return once (see docstring)
-    for i in np.nonzero(completed < episodes_per_slot)[0]:
+    # budget expired mid-episode: a slot with no finished episode counts
+    # its partial once; slots that already finished one don't (docstring)
+    for i in np.nonzero(completed == 0)[0]:
         finished_returns.append(cur_reward[i])
     return float(np.mean(finished_returns))
 
@@ -164,14 +167,20 @@ def evaluate_series(
     seed: int = 0,
     reward_fn=None,
     episodes_per_slot: int = 1,
+    episodes_per_checkpoint: Optional[int] = None,
 ):
     """Reference test.py:14-58 equivalent over the orbax series.
 
     reward_fn(net, params) -> float overrides the per-checkpoint
     evaluation (e.g. a device-side evaluator for pure-JAX envs); default
-    is the host vec-env rollout of episodes_per_slot episodes per slot."""
+    is the host vec-env rollout of episodes_per_slot episodes per slot.
+    episodes_per_checkpoint annotates each row with the sample size behind
+    its mean (defaults to slots x episodes_per_slot when the default
+    evaluator runs; pass it explicitly with reward_fn)."""
     net, template = init_train_state(cfg, jax.random.PRNGKey(0))
     policy = make_policy(net)
+    if episodes_per_checkpoint is None and vec_env is not None:
+        episodes_per_checkpoint = episodes_per_slot * vec_env.num_envs
     rows = []
     for step in list_checkpoint_steps(cfg.checkpoint_dir):
         state, env_steps, wall_minutes = restore_checkpoint(cfg.checkpoint_dir, template, step)
@@ -188,6 +197,10 @@ def evaluate_series(
             "env_frames": env_steps * 4,  # frameskip semantics (test.py:28,36)
             "hours": wall_minutes / 60.0,
             "mean_reward": reward,
+            # sample size behind the mean (VERDICT r2: headline curves
+            # must state their episode counts; reference averaged 5 —
+            # test.py:18,32)
+            "episodes": episodes_per_checkpoint,
         }
         rows.append(row)
         print(json.dumps(row))
@@ -225,7 +238,9 @@ def plot_series(rows, out_path: str) -> str:
 
 def main(argv=None):
     from r2d2_tpu.train import build_vec_env
+    from r2d2_tpu.utils.compilation_cache import enable_compilation_cache
 
+    enable_compilation_cache()
     p = argparse.ArgumentParser(description="r2d2_tpu checkpoint-series evaluator")
     p.add_argument("--preset", default="atari", choices=sorted(PRESETS))
     p.add_argument("--env", default=None)

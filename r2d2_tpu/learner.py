@@ -280,6 +280,7 @@ def make_fused_multi_train_step(
 def make_multi_update_core(
     cfg: R2D2Config, net: R2D2Network, num_steps: int,
     axis_name: Optional[str] = None,
+    is_from_priorities: bool = False,
 ):
     """The un-jitted K-update scan body shared by
     make_fused_multi_train_step and megastep.make_megastep — one
@@ -288,7 +289,15 @@ def make_multi_update_core(
     axis_name="dp": the body runs per-shard under shard_map — gathers hit
     the LOCAL store shard and gradients/denominators psum over the axis
     (same contract as make_sharded_fused_train_step); b/s/w are then the
-    local (K, B/dp) coordinate stacks."""
+    local (K, B/dp) coordinate stacks.
+
+    is_from_priorities=True (needs axis_name): w carries RAW sampled tree
+    priorities; each scan iteration normalizes ITS OWN batch against that
+    update's batch-global minimum via a pmin over the axis — per-update
+    semantics identical to K single is_from_priorities steps (the
+    multihost K-dispatch contract, replay/multihost_store.py)."""
+    if is_from_priorities and axis_name is None:
+        raise ValueError("is_from_priorities needs an axis_name (pmin)")
     raw = _raw_train_step(cfg, net, axis_name=axis_name)
     gather_batch = make_store_gather(cfg)
 
@@ -300,6 +309,13 @@ def make_multi_update_core(
 
         def body(state, xs):
             bb, ss, ww = xs
+            if is_from_priorities:
+                # same formula as make_sharded_fused_train_step's body
+                p = ww
+                pos_min = jnp.min(jnp.where(p > 0, p, jnp.inf))
+                min_p = jax.lax.pmin(pos_min, axis_name)
+                min_p = jnp.where(jnp.isfinite(min_p), min_p, 1.0)
+                ww = jnp.power(jnp.maximum(p, min_p) / min_p, -cfg.is_exponent)
             batch = gather_batch(stores, bb, ss, ww)
             state, metrics, prios = raw(state, batch)
             return state, (metrics, prios)
@@ -311,7 +327,8 @@ def make_multi_update_core(
 
 
 def make_sharded_fused_multi_train_step(
-    cfg: R2D2Config, net: R2D2Network, mesh, num_steps: int, donate: bool = True
+    cfg: R2D2Config, net: R2D2Network, mesh, num_steps: int, donate: bool = True,
+    is_from_priorities: bool = False,
 ):
     """K updates in ONE shard_map dispatch over a dp-SHARDED replay store:
     the multi-chip form of make_fused_multi_train_step. Each device scans
@@ -320,11 +337,15 @@ def make_sharded_fused_multi_train_step(
 
     Signature: (state, stores, b, s, w) with b/s/w of shape (K, dp, B/dp)
     and b LOCAL to each shard; returns (state, metrics-of-last-step,
-    priorities (K, dp, B/dp))."""
+    priorities (K, dp, B/dp)). is_from_priorities: see
+    make_multi_update_core — w carries raw priorities, normalized per
+    update with a pmin over dp (the multihost K-dispatch path)."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
-    multi = make_multi_update_core(cfg, net, num_steps, axis_name="dp")
+    multi = make_multi_update_core(
+        cfg, net, num_steps, axis_name="dp", is_from_priorities=is_from_priorities
+    )
 
     def body(state: TrainState, stores, b, s, w):
         # local views: stores (nb/dp, ...), b/s/w (K, 1, B/dp)

@@ -203,6 +203,12 @@ class _DeferredDrainRunner:
         # would skip the first collect for no reason
         self._consumed += self.K * self.cfg.batch_size * self.cfg.learning_steps
         if self.samples_per_insert > 0:
+            # chunk accounting is deferred one dispatch, so `inserted` lags
+            # one chunk: the first dispatches see ~1 and always collect (a
+            # bounded initial burst), and steady-state pacing tracks the
+            # target ratio one chunk behind — harmless (the staleness
+            # guard assumes consecutive collects), documented here so the
+            # early overshoot doesn't read as a pacing bug
             inserted = max(self.replay.env_steps - self._inserted0, 1)
             collect = self._consumed / inserted >= self.samples_per_insert
         else:

@@ -35,9 +35,13 @@ step finds the batch-global minimum with a pmin collective over dp
 device mesh does the one piece of global coordination the weights need.
 
 Verified end to end by tests/test_multihost.py: a REAL 2-process CPU run
-(jax.distributed) trains 3 steps whose losses match the single-process
-4-device run of this plane exactly, and the assembled data plane matches
-ShardedDeviceReplay loss-for-loss on identical contents and coordinates.
+(jax.distributed) trains 3 single steps PLUS two K=2 run_step_k
+dispatches (deferred drain included, global tree mass folded into the
+checksum) whose losses match the single-process 4-device run of this
+plane exactly; the assembled data plane matches ShardedDeviceReplay
+loss-for-loss on identical contents and coordinates; and one K-scan
+dispatch is pinned update-for-update against K sequential single steps
+on the same pre-drawn coordinates.
 """
 
 from __future__ import annotations
@@ -104,6 +108,7 @@ class MultiHostShardedReplay:
         self._rr = 0  # round-robin over LOCAL shards
         self._seed = seed
         self._epoch = 0  # sample_global counter (part of the draw seeds)
+        self._pending = None  # run_step_k's deferred (priorities, draws)
         # store-level lock: add_block's donated write swaps stores[g], so a
         # concurrent run_step must not be assembling/dispatching over the
         # old buffers (same contract as run_with_stores on the other device
@@ -233,13 +238,15 @@ class MultiHostShardedReplay:
         (pinned by the 2-process test).
 
         Returns (b, s, raw_priorities) global arrays plus host-side
-        (idxes_by_shard, old_ptrs_by_shard) for the priority round trip.
-        The third array feeds a step built with is_from_priorities=True."""
+        (idxes_by_shard, old_ptrs_by_shard, old_advances_by_shard) for the
+        priority round trip. The third array feeds a step built with
+        is_from_priorities=True."""
         Bs = self.cfg.batch_size // self.dp
         epoch = self._epoch
         self._epoch += 1
         idxes_by_shard: Dict[int, np.ndarray] = {}
         old_ptrs: Dict[int, int] = {}
+        old_advances: Dict[int, int] = {}
         per_b, per_s, per_w = {}, {}, {}
         for g in self.local_ids:
             rng = np.random.default_rng((self._seed, g, epoch))
@@ -247,6 +254,7 @@ class MultiHostShardedReplay:
             with shard.lock:
                 b, s, idxes, _w = shard._draw(rng)
                 old_ptrs[g] = shard.block_ptr
+                old_advances[g] = shard.ptr_advances
                 p = shard.tree.priorities_of(idxes)
             dev = self._shard_device[g]
             per_b[g] = jax.device_put(b.astype(np.int32)[None], dev)
@@ -265,18 +273,29 @@ class MultiHostShardedReplay:
             self._assemble(per_w, shape, P("dp")),
             idxes_by_shard,
             old_ptrs,
+            old_advances,
         )
 
     def update_priorities(
-        self, idxes_by_shard: Dict[int, np.ndarray], priorities, old_ptrs: Dict[int, int]
+        self,
+        idxes_by_shard: Dict[int, np.ndarray],
+        priorities,
+        old_ptrs: Dict[int, int],
+        old_advances: Optional[Dict[int, int]] = None,
     ) -> None:
         """Apply the step's (dp, B/dp) dp-sharded priorities: each host
-        reads only its addressable rows."""
+        reads only its addressable rows, under its shard's own staleness
+        window AND lap stamp (a full ring lap between draw and apply wraps
+        the pointer back into the window mask's blind spot — the stamp is
+        the only guard, control_plane.update_priorities)."""
         dev_to_g = {d: g for g, d in self._shard_device.items()}
         for shard_piece in priorities.addressable_shards:
             g = dev_to_g[shard_piece.device]
             row = np.asarray(shard_piece.data)[0]
-            self.shards[g].update_priorities(idxes_by_shard[g], row, old_ptrs[g])
+            self.shards[g].update_priorities(
+                idxes_by_shard[g], row, old_ptrs[g],
+                None if old_advances is None else old_advances[g],
+            )
 
     # ------------------------------------------------------------- dispatch
 
@@ -292,7 +311,103 @@ class MultiHostShardedReplay:
             # sample + assemble + dispatch under the store lock: a
             # concurrent add_block's donated swap must not invalidate the
             # buffers behind the global views mid-dispatch
-            b, s, w, idxes_by_shard, old_ptrs = self.sample_global()
+            b, s, w, idxes_by_shard, old_ptrs, old_advances = self.sample_global()
             new_state, metrics, priorities = step_fn(state, self.global_stores(), b, s, w)
-        self.update_priorities(idxes_by_shard, priorities, old_ptrs)
+        self.update_priorities(idxes_by_shard, priorities, old_ptrs, old_advances)
         return new_state, metrics
+
+    def sample_global_k(self, k: int):
+        """K independent global draws stacked for one K-scan dispatch
+        (learner.make_sharded_fused_multi_train_step(is_from_priorities=
+        True)). Consumes k draw epochs — the i-th stacked draw uses the
+        exact seed the i-th sequential sample_global call would have, so
+        the K-dispatch samples the same coordinate sequence as K single
+        dispatches from the same tree state (layout-independent, like
+        sample_global).
+
+        Returns ((b, s, w) global arrays of shape (K, dp, B/dp), with b
+        LOCAL to each shard and w carrying RAW priorities, plus a list of
+        K host-side draw records {idxes, old_ptrs, old_advances} for the
+        deferred priority drain). Caller holds self.lock."""
+        Bs = self.cfg.batch_size // self.dp
+        epoch0 = self._epoch
+        self._epoch += k
+        draws = [
+            {"idxes": {}, "old_ptrs": {}, "old_advances": {}} for _ in range(k)
+        ]
+        per_b, per_s, per_w = {}, {}, {}
+        for g in self.local_ids:
+            shard = self.shards[g]
+            bk = np.empty((k, 1, Bs), np.int32)
+            sk = np.empty((k, 1, Bs), np.int32)
+            wk = np.empty((k, 1, Bs), np.float32)
+            with shard.lock:
+                for i in range(k):
+                    rng = np.random.default_rng((self._seed, g, epoch0 + i))
+                    b, s, idxes, _w = shard._draw(rng)
+                    bk[i, 0], sk[i, 0] = b, s
+                    wk[i, 0] = shard.tree.priorities_of(idxes)
+                    draws[i]["idxes"][g] = idxes
+                    draws[i]["old_ptrs"][g] = shard.block_ptr
+                    draws[i]["old_advances"][g] = shard.ptr_advances
+            dev = self._shard_device[g]
+            per_b[g] = jax.device_put(bk, dev)
+            per_s[g] = jax.device_put(sk, dev)
+            per_w[g] = jax.device_put(wk, dev)
+        shape = (k, self.dp, Bs)
+        spec = P(None, "dp")
+        return (
+            self._assemble(per_b, shape, spec),
+            self._assemble(per_s, shape, spec),
+            self._assemble(per_w, shape, spec),
+        ), draws
+
+    def run_step_k(self, multi_fn: Callable, state, k: int):
+        """K collective updates in ONE shard_map dispatch, with the
+        priority readback DEFERRED one dispatch — the multihost form of
+        the device/sharded planes' K-update amortization. Reading this
+        dispatch's (K, dp, B/dp) priorities synchronously would stall
+        every host for the dispatch plus a device->host round trip per
+        update burst (the >10x cliff ARCHITECTURE.md measures at 2.3 ms
+        dispatch / 131 ms readback); instead the transfer starts async and
+        the PREVIOUS dispatch's priorities are applied while this one
+        executes. Tree priorities lag K extra updates — same bounded class
+        as the single-host planes; each shard's pointer-window + lap stamp
+        still reject rows overwritten meanwhile.
+
+        multi_fn: make_sharded_fused_multi_train_step(cfg, net, mesh, k,
+        is_from_priorities=True). EVERY process calls this in the same
+        order (SPMD); the drain itself is host-local."""
+        with self.lock:
+            (b, s, w), draws = self.sample_global_k(k)
+            new_state, metrics, priorities = multi_fn(
+                state, self.global_stores(), b, s, w
+            )
+        try:
+            priorities.copy_to_host_async()
+        except AttributeError:
+            pass
+        prev, self._pending = self._pending, (priorities, draws)
+        if prev is not None:
+            self.drain_pending(prev)
+        return new_state, metrics
+
+    def drain_pending(self, pending=None) -> None:
+        """Apply a deferred (priorities, draws) pair: each host reads only
+        its addressable (K, 1, B/dp) pieces and applies row i under draw
+        i's own per-shard staleness window + lap stamp. Called with the
+        previous dispatch's pair each run_step_k, and once with the final
+        in-flight pair when the run mode exits (Trainer.finish_updates)."""
+        if pending is None:
+            pending, self._pending = self._pending, None
+        if pending is None:
+            return
+        prios, draws = pending
+        dev_to_g = {d: g for g, d in self._shard_device.items()}
+        for piece in prios.addressable_shards:
+            g = dev_to_g[piece.device]
+            data = np.asarray(piece.data)  # (K, 1, B/dp)
+            for i, d in enumerate(draws):
+                self.shards[g].update_priorities(
+                    d["idxes"][g], data[i, 0], d["old_ptrs"][g], d["old_advances"][g]
+                )

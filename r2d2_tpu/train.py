@@ -327,13 +327,17 @@ class _ShardedPlane:
 
 class _MultiHostPlane:
     """Per-process local replay shards over a GLOBAL (possibly multi-
-    process) mesh; one collective shard_map step per update with in-step
-    IS normalization (replay/multihost_store.py). Every process runs the
+    process) mesh; collective shard_map updates with in-step IS
+    normalization (replay/multihost_store.py). Every process runs the
     same Trainer loop — updates are SPMD-collective, so processes stay in
-    lockstep through the step dispatches themselves; collection and
-    logging are host-local."""
+    lockstep through the step dispatches themselves; collection, logging,
+    and the priority drain are host-local.
 
-    steps_per_update = 1
+    K = updates_per_dispatch > 1 folds K collective updates into ONE
+    shard_map K-scan dispatch with the priority readback deferred one
+    dispatch (replay.run_step_k) — the same dispatch-latency amortization
+    the repo measured as mandatory on single-chip (ARCHITECTURE.md
+    "dispatch granularity"), now on the scale-out plane."""
 
     def __init__(self, tr: "Trainer"):
         from r2d2_tpu.replay.multihost_store import MultiHostShardedReplay
@@ -342,16 +346,28 @@ class _MultiHostPlane:
             raise ValueError("multihost plane needs a mesh")
         self.tr = tr
         self.replay = MultiHostShardedReplay(tr.cfg, tr.mesh, seed=tr.cfg.seed + 3)
+        self.K = self.steps_per_update = tr.cfg.updates_per_dispatch
+        if self.K > 1:
+            from r2d2_tpu.learner import make_sharded_fused_multi_train_step
+
+            self.multi_fn = make_sharded_fused_multi_train_step(
+                tr.cfg, tr.net, tr.mesh, self.K, is_from_priorities=True
+            )
         self.step_fn = make_sharded_fused_train_step(
             tr.cfg, tr.net, tr.mesh, is_from_priorities=True
         )
 
     def sample(self, pipelined: bool = False):
-        # draws happen inside run_step, atomically with the dispatch
+        # draws happen inside run_step(_k), atomically with the dispatch
         return ("multihost", None, None, None)
 
     def update(self, state, item):
+        if self.K > 1:
+            return self.replay.run_step_k(self.multi_fn, state, self.K)
         return self.replay.run_step(self.step_fn, state)
+
+    def drain_pending(self, pending=None) -> None:
+        self.replay.drain_pending(pending)
 
 
 _PLANES = {
@@ -373,6 +389,9 @@ class Trainer:
         profile_dir: Optional[str] = None,
         profile_steps: int = 20,
     ):
+        from r2d2_tpu.utils.compilation_cache import enable_compilation_cache
+
+        enable_compilation_cache()
         # profiling hooks (SURVEY.md 5.1): trace the first `profile_steps`
         # post-warmup updates — the steady-state pipeline shape
         self.profile_dir = profile_dir
@@ -627,10 +646,24 @@ class Trainer:
 
     def _log(self, m, step, extra: Optional[dict] = None):
         n_ep, r_sum = self.replay.pop_episode_stats()
+        if self.cfg.replay_plane == "multihost" and jax.process_count() > 1:
+            # env_steps_offset is a GLOBAL restored total (the snapshot
+            # restore rebases it against the globally-summed restored
+            # count), so local + offset would understate — possibly go
+            # negative — on a resumed multi-process run. Log the two
+            # unambiguous pieces instead; checkpoints carry the true
+            # global total via _global_env_steps() (no collective here:
+            # logging is per-host and must not require lockstep).
+            env_steps = {
+                "env_steps_local": self.replay.env_steps,
+                "env_steps_offset_global": self.env_steps_offset,
+            }
+        else:
+            env_steps = {"env_steps": self.replay.env_steps + self.env_steps_offset}
         self.metrics.log(
             {
                 "step": step,
-                "env_steps": self.replay.env_steps + self.env_steps_offset,
+                **env_steps,
                 "replay_size": len(self.replay),
                 "loss": float(m["loss"]),
                 "q_mean": float(m["q_mean"]),

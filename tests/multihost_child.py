@@ -43,9 +43,30 @@ def build_and_run(mesh):
     for _ in range(3):
         state, metrics = replay.run_step(step_fn, state)
         losses.append(float(metrics["loss"]))
+    # K-dispatch phase: two K=2 collective scan dispatches (the second
+    # also drains the first's deferred priorities), then the final drain —
+    # the full run_step_k lifecycle on both process topologies
+    from r2d2_tpu.learner import make_sharded_fused_multi_train_step
+
+    multi_fn = make_sharded_fused_multi_train_step(
+        cfg, net, mesh, 2, donate=False, is_from_priorities=True
+    )
+    for _ in range(2):
+        state, metrics = replay.run_step_k(multi_fn, state, 2)
+        losses.append(float(metrics["loss"]))
+    replay.drain_pending()
     checksum = float(
         sum(np.abs(np.asarray(x)).sum() for x in jax.tree.leaves(state.params))
     )
+    # the trees saw every drained priority batch: fold the GLOBAL tree
+    # mass into the cross-topology comparison too (each process only
+    # holds its local shards' trees)
+    local_tree = np.float64(sum(replay.shards[g].tree.total for g in replay.local_ids))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        local_tree = multihost_utils.process_allgather(local_tree).sum()
+    checksum += float(local_tree)
     return losses, checksum
 
 

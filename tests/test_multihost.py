@@ -72,7 +72,8 @@ def test_multihost_store_single_process():
 
     mesh = make_global_mesh(dp=4, tp=1, devices=jax.devices()[:4])
     losses, checksum = build_and_run(mesh)
-    assert len(losses) == 3 and all(np.isfinite(l) for l in losses)
+    # 3 single-step losses + 2 K=2-dispatch losses (multihost_child)
+    assert len(losses) == 5 and all(np.isfinite(l) for l in losses)
     assert np.isfinite(checksum)
 
 
@@ -152,7 +153,7 @@ def test_multihost_data_plane_matches_sharded_store():
             mh.add_block(block, prios, None)
             sh.add_block(block, prios, None)
 
-    b, s, raw_p, idxes_by_shard, old_ptrs = mh.sample_global()
+    b, s, raw_p, idxes_by_shard, old_ptrs, old_advances = mh.sample_global()
     net, state = init_train_state(cfg, jax.random.PRNGKey(0))
     state = jax.device_put(state, replicated_sharding(mesh))
     flagged = make_sharded_fused_train_step(
@@ -280,3 +281,151 @@ def test_multihost_snapshot_roundtrip(tmp_path):
         np.testing.assert_array_equal(
             np.asarray(replay.stores[g]["obs"]), np.asarray(fresh.stores[g]["obs"])
         )
+
+
+def test_multihost_priority_lap_stamp():
+    """A FULL ring lap between draw and apply wraps each shard's pointer
+    back to its draw-time value — invisible to the pointer-window mask —
+    and only the ptr_advances stamp threaded through sample_global /
+    update_priorities rejects the stale batch (the same guard every other
+    plane has, control_plane.update_priorities)."""
+    from bench import synth_block
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.parallel.multihost import make_global_mesh
+    from r2d2_tpu.replay.multihost_store import MultiHostShardedReplay
+    from jax.sharding import PartitionSpec as P
+
+    cfg = tiny_test().replace(
+        obs_shape=(10, 8, 1), action_dim=3, num_actors=4, batch_size=8,
+        block_length=16, buffer_capacity=1280, learning_starts=32,
+        replay_plane="multihost", dp_size=4, collector="host",
+    )
+    mesh = make_global_mesh(dp=4, tp=1, devices=jax.devices()[:4])
+    replay = MultiHostShardedReplay(cfg, mesh, seed=7)
+    rng = np.random.default_rng(1)
+
+    def lap():
+        for _ in range(cfg.num_blocks):
+            replay.add_block(
+                synth_block(cfg, rng),
+                np.full(cfg.seqs_per_block, 1.0, np.float32),
+                1.0,
+            )
+
+    lap()
+    b, s, w, idxes_by_shard, old_ptrs, old_advances = replay.sample_global()
+    lap()  # full lap: every slot overwritten, pointers back where they were
+    for g in replay.local_ids:
+        assert replay.shards[g].block_ptr == old_ptrs[g]
+
+    Bs = cfg.batch_size // replay.dp
+    per = {
+        g: jax.device_put(
+            np.full((1, Bs), 99.0, np.float32), replay._shard_device[g]
+        )
+        for g in replay.local_ids
+    }
+    prios = replay._assemble(per, (replay.dp, Bs), P("dp"))
+
+    before = {
+        g: replay.shards[g].tree.priorities_of(idxes_by_shard[g]).copy()
+        for g in replay.local_ids
+    }
+    # stamped path: the whole batch is stale (one full lap) -> rejected
+    replay.update_priorities(idxes_by_shard, prios, old_ptrs, old_advances)
+    for g in replay.local_ids:
+        np.testing.assert_array_equal(
+            replay.shards[g].tree.priorities_of(idxes_by_shard[g]), before[g]
+        )
+
+    # the window mask ALONE cannot see the lap: without the stamp the
+    # stale batch is (wrongly) applied — documents why the stamp exists
+    replay.update_priorities(idxes_by_shard, prios, old_ptrs, None)
+    for g in replay.local_ids:
+        got = replay.shards[g].tree.priorities_of(idxes_by_shard[g])
+        assert np.all(got != before[g])
+
+
+def test_multihost_k_dispatch_matches_sequential():
+    """One run_step_k K-scan dispatch must equal K sequential
+    is_from_priorities single steps on the SAME pre-drawn coordinates:
+    identical per-update priorities out and identical final params (the
+    make_fused_multi_train_step equivalence contract, now on the
+    multihost plane's raw-priority pmin-normalized path)."""
+    from bench import synth_block
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.learner import (
+        init_train_state,
+        make_sharded_fused_multi_train_step,
+        make_sharded_fused_train_step,
+    )
+    from r2d2_tpu.parallel.mesh import replicated_sharding
+    from r2d2_tpu.parallel.multihost import make_global_mesh
+    from r2d2_tpu.replay.multihost_store import MultiHostShardedReplay
+
+    import jax.numpy as jnp
+
+    K = 4
+    mesh = make_global_mesh(dp=4, tp=1, devices=jax.devices()[:4])
+    cfg = tiny_test().replace(
+        batch_size=8, updates_per_dispatch=K, replay_plane="multihost",
+        training_steps=2 * K,
+    )
+    replay = MultiHostShardedReplay(cfg, mesh, seed=11)
+    rng = np.random.default_rng(2)
+    for _ in range(8):
+        replay.add_block(
+            synth_block(cfg, rng),
+            rng.uniform(0.5, 2.0, cfg.seqs_per_block).astype(np.float32),
+            1.0,
+        )
+
+    (b, s, w), draws = replay.sample_global_k(K)
+    net, state0 = init_train_state(cfg, jax.random.PRNGKey(0))
+    state0 = jax.device_put(state0, replicated_sharding(mesh))
+
+    multi_fn = make_sharded_fused_multi_train_step(
+        cfg, net, mesh, K, donate=False, is_from_priorities=True
+    )
+    state_k, m_k, prios_k = multi_fn(state0, replay.global_stores(), b, s, w)
+
+    single_fn = make_sharded_fused_train_step(
+        cfg, net, mesh, donate=False, is_from_priorities=True
+    )
+    state_seq = state0
+    b_np, s_np, w_np = (np.asarray(x) for x in (b, s, w))
+    for i in range(K):
+        state_seq, m_i, p_i = single_fn(
+            state_seq, replay.global_stores(),
+            jnp.asarray(b_np[i]), jnp.asarray(s_np[i]), jnp.asarray(w_np[i]),
+        )
+        np.testing.assert_allclose(
+            np.asarray(prios_k)[i], np.asarray(p_i), rtol=2e-5, atol=1e-6
+        )
+    np.testing.assert_allclose(float(m_k["loss"]), float(m_i["loss"]), rtol=1e-5)
+    for a, bb in zip(jax.tree.leaves(state_k.params), jax.tree.leaves(state_seq.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=1e-5)
+
+
+def test_trainer_multihost_plane_k_dispatch(tmp_path):
+    """Trainer end to end with replay_plane='multihost' AND
+    updates_per_dispatch=4: the lifted K restriction (config), the K-scan
+    collective dispatch, and the deferred drain (finish_updates) all in
+    one run."""
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.train import Trainer
+
+    cfg = tiny_test().replace(
+        env_name="catch",
+        replay_plane="multihost",
+        batch_size=8,
+        updates_per_dispatch=4,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        training_steps=8,
+        save_interval=4,
+        learning_starts=48,
+    )
+    trainer = Trainer(cfg)
+    trainer.run_inline()
+    assert int(trainer.state.step) == 8
+    assert trainer.plane.replay._pending is None  # final drain happened
