@@ -28,11 +28,32 @@ replay.accumulator.SequenceAccumulator bit-for-bit, including the quirk-1
 (stored-state alignment) and quirk-6/7 (rescaled-space initial priority)
 fixes; tests/test_collect.py pins equivalence against the host actor path
 on identical trajectories.
+
+EPISODES LONGER THAN ONE CHUNK (carry_episodes=True): a slot still alive
+at the chunk end is NOT reset — its env state, recurrent state, last
+action/reward, and partial episode reward carry into the next chunk,
+whose block stores the episode's continuation. The chunk boundary is a
+standard truncation-with-bootstrap cut (the same final-Q bootstrap as
+above, reward-correct under n-step returns), and the continuation
+block's first learning window replays from the CARRIED recurrent state
+stored as its window-0 state with ZERO burn-in — the R2D2 paper's pure
+stored-state strategy at the seam. This is deliberately SIMPLER than the
+host SequenceAccumulator, which also copies the previous block's last
+burn_in entries into a continuation block's head so window 0 can refresh
+the stale stored state by burn-in replay (accumulator.py:123,170-176,
+mirroring reference worker.py:613-616): here only windows 1+ of each
+block get burn-in refresh, and the seam window leans on the stored
+state alone. Consequence: host-vs-device block equivalence holds
+exactly for episode-aligned chunks (the tested contract); for
+multi-chunk episodes the device path trades the seam window's burn-in
+refresh for a fixed-shape jittable packer. Episode stats (count, total
+reward) are reported once per episode, at its true end (or at the
+cfg.max_episode_steps cap).
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -56,15 +77,50 @@ def default_chunk_len(cfg: R2D2Config) -> int:
     return min(cfg.block_length, cfg.max_episode_steps)
 
 
+class CollectCarry(NamedTuple):
+    """Per-slot cross-chunk episode state (carry_episodes=True): the env
+    state, post-step recurrent state, last action/reward the policy must
+    resume from, and the episode reward/steps accumulated in earlier
+    chunks (ep_steps enforces cfg.max_episode_steps for envs whose
+    internal horizon is looser than the config cap)."""
+
+    env_state: object
+    h: jnp.ndarray              # (E, H) f32
+    c: jnp.ndarray              # (E, H) f32
+    last_action: jnp.ndarray    # (E,) int32
+    last_reward: jnp.ndarray    # (E,) f32
+    prefix_reward: jnp.ndarray  # (E,) f32
+    ep_steps: jnp.ndarray       # (E,) int32
+
+
+def initial_carry(cfg: R2D2Config, fn_env, num_envs: int, key) -> CollectCarry:
+    """Fresh episodes in every slot: reset env states, zero recurrent
+    state / NOOP last action / zero reward (reference worker.py:488-509)."""
+    E, H = num_envs, cfg.hidden_dim
+    return CollectCarry(
+        env_state=jax.vmap(fn_env.reset)(jax.random.split(key, E)),
+        h=jnp.zeros((E, H), jnp.float32),
+        c=jnp.zeros((E, H), jnp.float32),
+        last_action=jnp.zeros(E, jnp.int32),
+        last_reward=jnp.zeros(E, jnp.float32),
+        prefix_reward=jnp.zeros(E, jnp.float32),
+        ep_steps=jnp.zeros(E, jnp.int32),
+    )
+
+
 def make_collect_fn(
-    cfg: R2D2Config, net: R2D2Network, fn_env, num_envs: int, chunk_len: int
+    cfg: R2D2Config, net: R2D2Network, fn_env, num_envs: int, chunk_len: int,
+    carry_episodes: bool = False,
 ):
     """Jitted chunk collector (see make_collect_core for the contract)."""
-    return jax.jit(make_collect_core(cfg, net, fn_env, num_envs, chunk_len))
+    return jax.jit(
+        make_collect_core(cfg, net, fn_env, num_envs, chunk_len, carry_episodes)
+    )
 
 
 def make_collect_core(
-    cfg: R2D2Config, net: R2D2Network, fn_env, num_envs: int, chunk_len: int
+    cfg: R2D2Config, net: R2D2Network, fn_env, num_envs: int, chunk_len: int,
+    carry_episodes: bool = False,
 ):
     """Build the (un-jitted) chunk collector — jit it directly
     (make_collect_fn) or compose it into a larger dispatch
@@ -79,6 +135,13 @@ def make_collect_core(
        fresh_env_state, key')
     where `fields` is a dict of (E, ...) store-slot-shaped device arrays
     keyed exactly like DeviceReplayBuffer.stores.
+
+    carry_episodes=True (episodes longer than one chunk, module
+    docstring): the env_state argument and the 7th result are a
+    CollectCarry instead of a bare env state — slots alive at the chunk
+    end continue their episode next chunk (carried env/recurrent state),
+    finished/idle slots restart fresh, and ep_rewards holds FULL episode
+    returns (prefix + chunk), meaningful where dones is set.
     """
     E, T = num_envs, chunk_len
     L, Bn, n = cfg.learning_steps, cfg.burn_in_steps, cfg.forward_steps
@@ -96,14 +159,18 @@ def make_collect_core(
     tT = jnp.arange(T)
     sid = jnp.arange(S)
 
-    def _pack(obs, final_obs, actions, rewards, qs, hiddens, size, done, qf):
+    def _pack(obs, final_obs, actions, rewards, qs, hiddens, size, done, qf,
+              init_la, init_lr, init_hid):
         """Pack ONE env's chunk into store-slot-shaped block fields.
 
         Mirrors SequenceAccumulator.finish (replay/accumulator.py) with
         fixed shapes + masks: obs (T, ...), actions/rewards (T,) already
         zero-masked past `size`, qs (T, A), hiddens (T, 2, H) post-step
         states, size scalar int, done scalar bool, qf (A,) the final
-        policy eval for the truncation bootstrap."""
+        policy eval for the truncation bootstrap. init_la/init_lr/init_hid
+        are the pre-chunk last action / last reward / recurrent state:
+        zeros at an episode start, the carried values on a continuation
+        chunk (carry_episodes)."""
         valid_t1 = t1 <= size          # stored entries 0..size
         valid_T = tT < size            # recorded transitions
 
@@ -111,10 +178,8 @@ def make_collect_core(
         stored_obs = jnp.where(
             valid_t1.reshape(-1, *([1] * (obs.ndim - 1))), stored_obs, 0
         )
-        zero1i = jnp.zeros(1, jnp.int32)
-        zero1f = jnp.zeros(1, jnp.float32)
-        stored_la = jnp.where(valid_t1, jnp.concatenate([zero1i, actions]), 0)
-        stored_lr = jnp.where(valid_t1, jnp.concatenate([zero1f, rewards]), 0.0)
+        stored_la = jnp.where(valid_t1, jnp.concatenate([init_la[None], actions]), 0)
+        stored_lr = jnp.where(valid_t1, jnp.concatenate([init_lr[None], rewards]), 0.0)
         pad1 = slot - (T + 1)
         f_obs = jnp.pad(stored_obs, ((0, pad1),) + ((0, 0),) * (obs.ndim - 1))
         f_la = jnp.pad(stored_la, (0, pad1))
@@ -143,7 +208,10 @@ def make_collect_core(
         f_gamma = jnp.pad(gamma_n, (0, padT))
 
         # per-sequence counters (reference worker.py:606-610; int32 per
-        # SURVEY.md quirk 12). curr_burn_in == 0: chunks are episode-aligned.
+        # SURVEY.md quirk 12). Window 0 always packs with burn_in=0: the
+        # chunk is either episode-aligned (its true start) or a
+        # carry_episodes continuation whose window 0 replays from the
+        # carried stored state without burn-in (module docstring).
         num_seq = (size + L - 1) // L
         valid_seq = sid < num_seq
         burn = jnp.where(valid_seq, jnp.minimum(sid * L, Bn), 0)
@@ -153,10 +221,9 @@ def make_collect_core(
 
         # stored recurrent state at the TRUE window start (quirk-1 fix):
         # hidden_buf[t] = state before consuming obs t; index 0 is the
-        # episode-start zero state
-        stored_hid = jnp.concatenate(
-            [jnp.zeros((1, 2, H), jnp.float32), hiddens], axis=0
-        )
+        # episode-start zero state, or the carried state on a
+        # continuation chunk (carry_episodes)
+        stored_hid = jnp.concatenate([init_hid[None], hiddens], axis=0)
         wstart = jnp.clip(sid * L - burn, 0, T)
         hid_seq = jnp.where(valid_seq[:, None, None], stored_hid[wstart], 0.0)
 
@@ -189,6 +256,17 @@ def make_collect_core(
         return fields, prios, num_seq.astype(jnp.int32)
 
     def collect(params, env_state, epsilons, key):
+        if carry_episodes:
+            carry0: CollectCarry = env_state
+            env_state = carry0.env_state
+            h0, c0 = carry0.h, carry0.c
+            la0, lr0 = carry0.last_action, carry0.last_reward
+        else:
+            h0 = jnp.zeros((E, H), jnp.float32)
+            c0 = jnp.zeros((E, H), jnp.float32)
+            la0 = jnp.zeros(E, jnp.int32)
+            lr0 = jnp.zeros(E, jnp.float32)
+
         def body(carry, key_t):
             env_state, h, c, la, lr, active = carry
             obs = vrender(env_state)
@@ -224,15 +302,8 @@ def make_collect_core(
             return (env_state, h2, c2, la2, lr2, active & ~done), rec
 
         keys = jax.random.split(key, T + 2)
-        init = (
-            env_state,
-            jnp.zeros((E, H), jnp.float32),
-            jnp.zeros((E, H), jnp.float32),
-            jnp.zeros(E, jnp.int32),
-            jnp.zeros(E, jnp.float32),
-            jnp.ones(E, bool),
-        )
-        (env_f, h_f, c_f, la_f, lr_f, _), rec = jax.lax.scan(body, init, keys[:T])
+        init = (env_state, h0, c0, la0, lr0, jnp.ones(E, bool))
+        (env_f, h_f, c_f, la_f, lr_f, alive_f), rec = jax.lax.scan(body, init, keys[:T])
 
         final_obs = vrender(env_f)
         q_final, _ = net.apply(params, final_obs, la_f, lr_f, (h_f, c_f), method=net.act)
@@ -252,8 +323,41 @@ def make_collect_core(
             sizes,
             dones,
             q_final,
+            la0,
+            lr0,
+            jnp.stack([h0, c0], axis=1),
         )
         fresh_env = vreset(jax.random.split(keys[T + 1], E))
+        if carry_episodes:
+            # slots still alive continue their episode next chunk; done
+            # slots restart fresh. alive_f == ~dones here (every slot
+            # starts the chunk alive), kept explicit for clarity. A slot
+            # whose episode has reached cfg.max_episode_steps is CAPPED:
+            # restarted fresh (its last block already carries the
+            # truncation bootstrap) and counted as a finished episode in
+            # the stats — the reference's Atari-style cap semantics.
+            ep_len = carry0.ep_steps + sizes
+            capped = alive_f & (ep_len >= cfg.max_episode_steps)
+            cont = alive_f & ~capped
+            next_env = jax.tree.map(
+                lambda o, f: _where_rows(cont, o, f), env_f, fresh_env
+            )
+            ep_total = carry0.prefix_reward + ep_rewards
+            new_carry = CollectCarry(
+                env_state=next_env,
+                h=jnp.where(cont[:, None], h_f, 0.0),
+                c=jnp.where(cont[:, None], c_f, 0.0),
+                last_action=jnp.where(cont, la_f, 0),
+                last_reward=jnp.where(cont, lr_f, 0.0),
+                prefix_reward=jnp.where(cont, ep_total, 0.0),
+                ep_steps=jnp.where(cont, ep_len, 0),
+            )
+            # dones | capped drives EPISODE STATS only (the in-block
+            # gamma encoding already happened per the env's own terminal)
+            return (
+                fields, priorities, num_seq, sizes, dones | capped, ep_total,
+                new_carry, keys[T],
+            )
         return fields, priorities, num_seq, sizes, dones, ep_rewards, fresh_env, keys[T]
 
     return collect
@@ -283,18 +387,12 @@ class DeviceCollector:
         self.cfg = cfg
         self.E = E
         self.chunk = int(chunk_len or default_chunk_len(cfg))
-        if cfg.max_episode_steps > self.chunk:
-            import warnings
-
-            warnings.warn(
-                f"DeviceCollector truncates every episode at chunk_len="
-                f"{self.chunk} (< max_episode_steps={cfg.max_episode_steps}): "
-                "chunks are episode-aligned, so states beyond one chunk are "
-                "never visited. Fine for short-episode envs (catch); use "
-                "collector='host' if episodes must run longer than "
-                "block_length.",
-                stacklevel=2,
-            )
+        # episodes longer than one chunk: carry env + recurrent state
+        # across chunks so the episode CONTINUES into its next block
+        # (truncation-bootstrap at the seam, stored-state window-0 replay
+        # — module docstring) instead of silently never visiting states
+        # past the first chunk
+        self.carry_episodes = cfg.max_episode_steps > self.chunk
         self.replay = replay
         self.param_store = param_store
         self._fn_env = fn_env
@@ -305,10 +403,15 @@ class DeviceCollector:
         )
         assert len(eps) == E
         self.epsilons = jnp.asarray(eps, jnp.float32)
-        self._collect = make_collect_fn(cfg, net, fn_env, E, self.chunk)
+        self._collect = make_collect_fn(
+            cfg, net, fn_env, E, self.chunk, carry_episodes=self.carry_episodes
+        )
         self.key = jax.random.PRNGKey(seed)
         kr, self.key = jax.random.split(self.key)
-        self.env_state = jax.vmap(fn_env.reset)(jax.random.split(kr, E))
+        if self.carry_episodes:
+            self.env_state = initial_carry(cfg, fn_env, E, kr)
+        else:
+            self.env_state = jax.vmap(fn_env.reset)(jax.random.split(kr, E))
         self.total_steps = 0
 
     @property
@@ -339,4 +442,7 @@ class DeviceCollector:
         """Supervised-restart hook: fresh episodes in every slot (the
         in-flight chunk, if any, was never pushed — nothing to unwind)."""
         kr, self.key = jax.random.split(self.key)
-        self.env_state = jax.vmap(self._fn_env.reset)(jax.random.split(kr, self.E))
+        if self.carry_episodes:
+            self.env_state = initial_carry(self.cfg, self._fn_env, self.E, kr)
+        else:
+            self.env_state = jax.vmap(self._fn_env.reset)(jax.random.split(kr, self.E))

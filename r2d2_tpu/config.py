@@ -296,7 +296,9 @@ def procgen_impala(game: str = "procmaze") -> R2D2Config:
     # geometry knobs are procmaze-specific; an emulator game keeps the
     # generic defaults (action_dim auto-corrects from the env at Trainer
     # construction, max_episode_steps stays the Atari-style cap)
-    kw = dict(action_dim=5, max_episode_steps=96) if game.lower() == "procmaze" else {}
+    from r2d2_tpu.envs.procmaze import is_procmaze_name
+
+    kw = dict(action_dim=5, max_episode_steps=96) if is_procmaze_name(game) else {}
     return R2D2Config(
         env_name=game,
         obs_shape=(64, 64, 3),

@@ -20,6 +20,7 @@ from r2d2_tpu.envs.catch import (
     catch_params,
     is_catch_name,
 )
+from r2d2_tpu.envs.procmaze import is_procmaze_name, procmaze_params
 
 __all__ = ["ScriptedEnv", "CatchEnv", "CatchHostEnv", "CatchVecEnv", "make_env"]
 
@@ -35,12 +36,22 @@ def make_env(cfg, seed: int = 0):
             height=cfg.obs_shape[0], width=cfg.obs_shape[1], seed=seed,
             **catch_params(name),
         )
-    if name == "procmaze":
+    if is_procmaze_name(name):
         from r2d2_tpu.envs.functional import FnHostEnv
-        from r2d2_tpu.envs.procmaze import ProcMazeEnv, procmaze_geometry
+        from r2d2_tpu.envs.procmaze import (
+            ProcMazeEnv,
+            procmaze_geometry,
+            procmaze_params,
+        )
 
-        grid, cell, horizon = procmaze_geometry(cfg.obs_shape, cfg.max_episode_steps)
-        return FnHostEnv(ProcMazeEnv, (grid, cell, horizon), seed=seed)
+        # same construction as procmaze.build_procmaze_env, but through
+        # FnHostEnv's (class, args, kwargs) form so the jitted fns cache
+        # across a pool of N host envs
+        params = procmaze_params(name)
+        grid, cell, horizon = procmaze_geometry(
+            cfg.obs_shape, cfg.max_episode_steps, grid=params.pop("grid", None)
+        )
+        return FnHostEnv(ProcMazeEnv, (grid, cell, horizon), seed=seed, kwargs=params)
     if name == "scripted" or name.startswith("scripted:"):
         # "scripted:A" pins the action space independently of cfg — gives
         # the sweep tests per-game action_dim diversity without ALE

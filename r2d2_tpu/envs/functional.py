@@ -24,21 +24,25 @@ import numpy as np
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_fns(make_env: Callable, env_args: tuple):
-    env = make_env(*env_args)
+def _jitted_fns(make_env: Callable, env_args: tuple, env_kw: tuple = ()):
+    env = make_env(*env_args, **dict(env_kw))
     return jax.jit(env.reset), jax.jit(env.step), jax.jit(env.render)
 
 
 class FnHostEnv:
     """Single-env host protocol (reset()/step(int)) over a functional core.
-    `make_env(*env_args)` must be hashable/cacheable (a class + scalar
-    args) so jitted functions are shared across instances."""
+    `make_env(*env_args, **kwargs)` must be hashable/cacheable (a class +
+    scalar args) so jitted functions are shared across instances."""
 
-    def __init__(self, make_env: Callable, env_args: tuple = (), seed: int = 0):
-        self.env = make_env(*env_args)
+    def __init__(
+        self, make_env: Callable, env_args: tuple = (), seed: int = 0,
+        kwargs: dict | None = None,
+    ):
+        kw = tuple(sorted((kwargs or {}).items()))
+        self.env = make_env(*env_args, **dict(kw))
         self.action_dim = self.env.NUM_ACTIONS
         self._key = jax.random.PRNGKey(seed)
-        self._reset, self._step, self._render = _jitted_fns(make_env, env_args)
+        self._reset, self._step, self._render = _jitted_fns(make_env, env_args, kw)
         self._state = None
         self.obs_shape = tuple(
             jax.eval_shape(

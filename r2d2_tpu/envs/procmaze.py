@@ -29,22 +29,76 @@ on-device collector (collect.py), and the fused megastep unchanged.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
+# shaped variant: per-step potential delta on the Manhattan distance to
+# goal. Telescopes to coef * initial_distance over any reaching path
+# (potential-based shaping, policy-invariant at gamma ~ 1), so the
+# terminal +1 still dominates: coef 0.02 x max distance 30 = 0.6
+PROCMAZE_SHAPING_COEF = 0.02
 
-def procmaze_geometry(obs_shape, max_episode_steps: int):
+
+def procmaze_params(name: str) -> dict:
+    """Variant parameters encoded in an env name, as ProcMazeEnv kwargs
+    past the geometry: 'procmaze' (sparse terminal reward, 16x16),
+    'procmaze_shaped' (adds the distance-delta shaping above — the
+    exploration aid the sparse variant measurably needs at horizon 96),
+    and an optional ':G' grid suffix on either ('procmaze:8' = an 8x8
+    maze rendered at the same obs size — the smaller-grid preset of the
+    difficulty ladder). Raises on other names (gate on is_procmaze_name)."""
+    n = name.lower()
+    base, _, suffix = n.partition(":")
+    if base == "procmaze":
+        out = {}
+    elif base == "procmaze_shaped":
+        out = {"shaping_coef": PROCMAZE_SHAPING_COEF}
+    else:
+        raise ValueError(f"not a procmaze family env name: {name!r}")
+    if suffix:
+        grid = int(suffix)
+        if grid < 2:
+            raise ValueError(f"procmaze grid must be >= 2, got {grid}")
+        out["grid"] = grid
+    return out
+
+
+def is_procmaze_name(name: str) -> bool:
+    n = name.lower()
+    base, _, _ = n.partition(":")
+    return base in ("procmaze", "procmaze_shaped")
+
+
+def procmaze_geometry(obs_shape, max_episode_steps: int, grid: Optional[int] = None):
     """(grid, cell, horizon) for a ProcMazeEnv rendering exactly
-    cfg.obs_shape: square, 3-channel, cell size h//16 (>=1)."""
+    cfg.obs_shape: square, 3-channel. Default grid: cell size h//16
+    (>=1), grid = h/cell — any h divisible by its cell works (64 -> 16
+    cells of 4, 40 -> 20 cells of 2). An explicit grid divides h
+    directly (64 with grid 8 -> cell 8)."""
     h, w, c = obs_shape
     if h != w or c != 3:
         raise ValueError(f"procmaze needs a square 3-channel obs_shape, got {obs_shape}")
-    cell = max(h // 16, 1)
-    if h % cell:
-        raise ValueError(f"obs height {h} not divisible by cell {cell}")
-    return h // cell, cell, max_episode_steps
+    if grid is None:
+        cell = max(h // 16, 1)
+        if h % cell:
+            raise ValueError(f"obs height {h} not divisible by cell {cell}")
+        return h // cell, cell, max_episode_steps
+    if h % grid:
+        raise ValueError(f"obs height {h} not divisible into a {grid}-cell grid")
+    return grid, h // grid, max_episode_steps
+
+
+def build_procmaze_env(obs_shape, max_episode_steps: int, name: str) -> "ProcMazeEnv":
+    """ONE factory for every 'procmaze[_shaped][:G]' name — the trainer's
+    functional/vec paths and envs.make_env all construct through here so
+    a new name-encoded variant knob lands in one place."""
+    params = procmaze_params(name)
+    grid, cell, horizon = procmaze_geometry(
+        obs_shape, max_episode_steps, grid=params.pop("grid", None)
+    )
+    return ProcMazeEnv(grid, cell, horizon, **params)
 
 
 class ProcMazeState(NamedTuple):
@@ -66,11 +120,15 @@ class ProcMazeEnv:
         cell: int = 4,
         horizon: int = 96,
         wall_density: float = 0.3,
+        shaping_coef: float = 0.0,
     ):
         self.g = grid
         self.cell = cell
         self.horizon = horizon
         self.density = wall_density
+        # 0.0 keeps the sparse variant's compiled program identical;
+        # > 0 adds the per-step distance-delta shaping (module constant)
+        self.shaping = shaping_coef
 
     # ------------------------------------------------------------ layout
 
@@ -127,6 +185,12 @@ class ProcMazeEnv:
         reached = jnp.all(agent == s.goal)
         done = reached | (t >= self.horizon)
         reward = jnp.where(reached, 1.0, 0.0)
+        if self.shaping > 0.0:
+            d_old = jnp.abs(s.agent - s.goal).sum()
+            d_new = jnp.abs(agent - s.goal).sum()
+            reward = jnp.where(
+                reached, 1.0, self.shaping * (d_old - d_new).astype(jnp.float32)
+            )
         return ProcMazeState(s.walls, agent, s.goal, t, s.key), reward, done
 
     # ------------------------------------------------------------ render

@@ -151,6 +151,22 @@ class _DeferredDrainRunner:
         self.replay = replay
         self.K = cfg.updates_per_dispatch
         self.chunk = int(chunk_len or default_chunk_len(cfg))
+        if cfg.max_episode_steps > self.chunk:
+            # the fused collect core runs WITHOUT cross-chunk episode
+            # carry (its env_state threads through the dispatch as a bare
+            # state): episodes longer than one chunk would silently never
+            # visit their tail. The standalone DeviceCollector carries
+            # episodes across chunks (collect.CollectCarry) — use the
+            # threaded/inline modes for such envs, or size block_length
+            # to hold a full episode for the fused mode.
+            raise ValueError(
+                f"fused megastep: max_episode_steps={cfg.max_episode_steps} "
+                f"exceeds the collection chunk ({self.chunk}); episodes "
+                "would be truncated at every chunk and their tails never "
+                "collected. Size block_length >= max_episode_steps or use "
+                "collector='device' with the threaded/inline modes (cross-"
+                "chunk episode carry)."
+            )
         # deferred-drain aliasing bound: between a draw and its priority
         # application (one dispatch later) at most two chunks can land,
         # each advancing the ring by its E plus a wrap skip of < E. The

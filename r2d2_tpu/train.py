@@ -60,6 +60,18 @@ from r2d2_tpu.utils.profiling import span, start_profiler_server, step_span
 from r2d2_tpu.utils.supervision import Supervisor, WorkerStalledError
 
 
+def _is_procmaze(name: str) -> bool:
+    from r2d2_tpu.envs.procmaze import is_procmaze_name
+
+    return is_procmaze_name(name)
+
+
+def _build_procmaze(cfg: R2D2Config, name: str):
+    from r2d2_tpu.envs.procmaze import build_procmaze_env
+
+    return build_procmaze_env(cfg.obs_shape, cfg.max_episode_steps, name)
+
+
 def build_vec_env(cfg: R2D2Config, seed: int = 0):
     """One vectorized env spanning cfg.num_actors slots."""
     from r2d2_tpu.envs.catch import catch_params, is_catch_name
@@ -70,13 +82,11 @@ def build_vec_env(cfg: R2D2Config, seed: int = 0):
             num_envs=cfg.num_actors, height=cfg.obs_shape[0], width=cfg.obs_shape[1],
             seed=seed, **catch_params(name),
         )
-    if name == "procmaze":
+    if _is_procmaze(name):
         from r2d2_tpu.envs.functional import FnVecEnv
-        from r2d2_tpu.envs.procmaze import ProcMazeEnv, procmaze_geometry
 
-        grid, cell, horizon = procmaze_geometry(cfg.obs_shape, cfg.max_episode_steps)
         return FnVecEnv(
-            ProcMazeEnv(grid, cell, horizon), num_envs=cfg.num_actors, seed=seed
+            _build_procmaze(cfg, name), num_envs=cfg.num_actors, seed=seed
         )
     envs = [make_env(cfg, seed=seed + i) for i in range(cfg.num_actors)]
     if cfg.env_pool_workers > 0:
@@ -95,10 +105,8 @@ def build_fn_env(cfg: R2D2Config):
         return CatchEnv(
             height=cfg.obs_shape[0], width=cfg.obs_shape[1], **catch_params(name)
         )
-    if name == "procmaze":
-        from r2d2_tpu.envs.procmaze import ProcMazeEnv, procmaze_geometry
-
-        return ProcMazeEnv(*procmaze_geometry(cfg.obs_shape, cfg.max_episode_steps))
+    if _is_procmaze(name):
+        return _build_procmaze(cfg, name)
     if name == "scripted" or name.startswith("scripted:"):
         from r2d2_tpu.envs.fake import ScriptedFnEnv
 

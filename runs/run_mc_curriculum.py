@@ -58,13 +58,16 @@ def last_eval_mean(out: str) -> float:
     return float(rows[-1]["mean_reward"])
 
 
-def run_stage(out: str, cue: int, total_steps: int, ablate: bool, log) -> int:
+def run_stage(out: str, cue: int, total_steps: int, ablate: bool, log,
+              overrides=()) -> int:
     cmd = [
         sys.executable, "examples/catch_demo.py",
         "--out", out, "--env", f"memory_catch:{cue}",
         "--full", "--mode", "fused", "--resume",
         "--steps", str(total_steps),
     ]
+    for kv in overrides:
+        cmd += ["--set", kv]
     if ablate:
         cmd.append("--ablate-zero-state")
     for attempt in range(4):  # stall (exit 86) retries, not budget extensions
@@ -85,6 +88,14 @@ def main():
                         "zero-state ablation instead of adapting")
     p.add_argument("--deadline-hours", type=float, default=4.0,
                    help="stop starting new attempts after this much wall")
+    p.add_argument("--cues", default=None,
+                   help="comma-separated cue schedule overriding the default")
+    p.add_argument("--stage-budget", type=int, default=STAGE_BUDGET)
+    p.add_argument("--advance-at", type=float, default=ADVANCE_AT)
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   help="forwarded to catch_demo (e.g. gamma=0.99 "
+                        "target_net_update_interval=250) — the curriculum's "
+                        "hyperparameter axis")
     args = p.parse_args()
 
     out = os.path.abspath(args.out)
@@ -121,7 +132,7 @@ def main():
 
     if ablate:
         for cue, total_steps in stages:
-            rc = run_stage(out, cue, total_steps, True, log)
+            rc = run_stage(out, cue, total_steps, True, log, args.set)
             ev = last_eval_mean(out)
             log({"event": "attempt_done", "cue": cue, "total_steps": total_steps,
                  "eval": ev, "rc": rc, "ablation": True})
@@ -130,15 +141,16 @@ def main():
         log({"event": "done", "mode": "ablation_replay"})
         return
 
-    for cue in CUES:
+    cues = [int(c) for c in args.cues.split(",")] if args.cues else CUES
+    for cue in cues:
         advanced = False
         for attempt in range(MAX_ATTEMPTS):
             if time.time() - t0 > args.deadline_hours * 3600:
                 log({"event": "deadline", "cue": cue})
                 log({"event": "done", "frontier_cue": cue, "best": best})
                 return
-            total += STAGE_BUDGET
-            rc = run_stage(out, cue, total, False, log)
+            total += args.stage_budget
+            rc = run_stage(out, cue, total, False, log, args.set)
             if rc not in (0,):
                 log({"event": "abort", "cue": cue, "rc": rc})
                 log({"event": "done", "frontier_cue": cue, "best": best})
@@ -146,9 +158,9 @@ def main():
             ev = last_eval_mean(out)
             log({"event": "attempt_done", "cue": cue, "total_steps": total,
                  "eval": ev, "attempt": attempt})
-            if best["eval"] is None or ev >= ADVANCE_AT:
+            if best["eval"] is None or ev >= args.advance_at:
                 best = {"cue": cue, "eval": ev}
-            if ev >= ADVANCE_AT:
+            if ev >= args.advance_at:
                 advanced = True
                 break
         if not advanced:

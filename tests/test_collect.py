@@ -189,3 +189,80 @@ def test_resync_restores_consistent_state():
     collector.resync()
     collector.step()
     assert collector.total_steps == 2 * before
+
+
+def test_carry_episodes_across_chunks():
+    """Episodes longer than one chunk (carry_episodes): the episode
+    CONTINUES into the next chunk's block — env state, recurrent state,
+    and last action/reward carry across the seam; the continuation
+    block's window-0 stored state is the carried state; episode stats
+    report once, with the full return."""
+    from r2d2_tpu.collect import initial_carry, make_collect_core
+
+    cfg = _cfg(max_episode_steps=24)  # block/chunk 12 -> 2-chunk episodes
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    fn_env = ScriptedFnEnv(episode_len=24, action_dim=cfg.action_dim)
+    collect = make_collect_fn(cfg, net, fn_env, E, 12, carry_episodes=True)
+
+    carry0 = initial_carry(cfg, fn_env, E, jax.random.PRNGKey(5))
+    eps = jax.numpy.zeros(E)
+    out1 = collect(state.params, carry0, eps, jax.random.PRNGKey(8))
+    f1, _, _, sizes1, dones1, ep1, carry1, _ = out1
+    assert not np.asarray(dones1).any()          # mid-episode at the seam
+    np.testing.assert_array_equal(np.asarray(sizes1), 12)
+    # prefix reward = chunk-1 script sum (0,1,2 repeating over 12 steps)
+    np.testing.assert_allclose(np.asarray(carry1.prefix_reward), 12.0)
+    # carried env state resumes at t=12, not a fresh episode
+    np.testing.assert_array_equal(np.asarray(carry1.env_state.t), 12)
+
+    out2 = collect(state.params, carry1, eps, jax.random.PRNGKey(9))
+    f2, _, _, sizes2, dones2, ep2, carry2, _ = out2
+    assert np.asarray(dones2).all()              # episode ends in chunk 2
+    np.testing.assert_array_equal(np.asarray(sizes2), 12)
+    np.testing.assert_allclose(np.asarray(ep2), 24.0)  # FULL return
+    np.testing.assert_allclose(np.asarray(carry2.prefix_reward), 0.0)
+
+    # continuation block: first stored obs is the seam obs (t=12), the
+    # window-0 stored state is the CARRIED recurrent state, and the first
+    # stored last-action/reward are the carried values
+    assert np.asarray(f2["obs"])[:, 0].max() == 12
+    np.testing.assert_allclose(
+        np.asarray(f2["hidden"])[:, 0],
+        np.stack([np.asarray(carry1.h), np.asarray(carry1.c)], axis=1),
+        atol=1e-6,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(f2["last_action"])[:, 0], np.asarray(carry1.last_action)
+    )
+    np.testing.assert_allclose(
+        np.asarray(f2["last_reward"])[:, 0], np.asarray(carry1.last_reward)
+    )
+
+
+def test_device_collector_carry_mode_end_to_end():
+    """DeviceCollector auto-enables the carry when max_episode_steps
+    exceeds the chunk: transitions past the first chunk ARE collected and
+    each multi-chunk episode is counted once with its full reward."""
+    cfg = _cfg(max_episode_steps=24)
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    fn_env = ScriptedFnEnv(episode_len=24, action_dim=cfg.action_dim)
+    replay = DeviceReplayBuffer(cfg)
+    collector = DeviceCollector(
+        cfg, net, ParamStore(state.params), fn_env, replay,
+        epsilons=np.zeros(E, np.float32), seed=5,
+    )
+    assert collector.carry_episodes
+    n1 = collector.step()
+    assert n1 == E * 12
+    n_ep, r_sum = replay.pop_episode_stats()
+    assert n_ep == 0  # no episode finished at the seam
+    n2 = collector.step()
+    assert n2 == E * 12
+    n_ep, r_sum = replay.pop_episode_stats()
+    assert n_ep == E and r_sum == pytest.approx(24.0 * E)
+    assert len(replay) == 2 * E * 12
+
+    # resync restarts fresh episodes (carry rebuilt)
+    collector.resync()
+    np.testing.assert_array_equal(np.asarray(collector.env_state.env_state.t), 0)
+    np.testing.assert_allclose(np.asarray(collector.env_state.prefix_reward), 0.0)

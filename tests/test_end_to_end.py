@@ -289,6 +289,10 @@ def test_train_cli_fused_mode(tmp_path):
     train_main([
         "--preset", "tiny_test", "--env", "catch", "--mode", "fused",
         "--steps", "6", "--updates-per-dispatch", "3",
+        # fused mode requires an ACCURATE episode bound <= the chunk
+        # (megastep refuses loose caps that would truncate episode
+        # tails); 12x12 catch episodes land in exactly 10 steps
+        "--set", "max_episode_steps=10",
         "--set", f"checkpoint_dir={tmp_path}/ckpt",
         "--set", "save_interval=1000",
         "--metrics", f"{tmp_path}/m.jsonl",

@@ -227,3 +227,18 @@ def test_trainer_run_fused_end_to_end(tmp_path):
     assert latest_checkpoint_step(cfg.checkpoint_dir) is not None
     # the collector hand-back leaves a consistent actor
     assert tr.actor.total_steps > 0
+
+
+def test_fused_runner_refuses_multi_chunk_episodes(setup):
+    """The fused collect core has no cross-chunk episode carry, so a
+    config whose episodes outlive the chunk must be refused loudly (the
+    DeviceCollector handles such envs via CollectCarry; the megastep
+    must not silently truncate every episode's tail)."""
+    cfg, fn_env, net, state = setup
+    bad = cfg.replace(max_episode_steps=cfg.block_length * 2)
+    replay, col = _filled_replay(cfg, net, state, fn_env)
+    with pytest.raises(ValueError, match="exceeds the collection chunk"):
+        FusedSystemRunner(
+            bad, net, fn_env, replay, col.epsilons, col.env_state, col.key,
+            sample_rng=np.random.default_rng(5),
+        )

@@ -4,6 +4,7 @@ generic functional-env adapters + collector integration."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from r2d2_tpu.envs.procmaze import ProcMazeEnv
 
@@ -185,3 +186,74 @@ def test_device_collector_runs_on_procmaze():
     for _ in range(4):
         col.step()
     assert replay.env_steps > 0 and len(replay) > 0
+
+
+def test_procmaze_name_parsing():
+    from r2d2_tpu.envs.procmaze import (
+        PROCMAZE_SHAPING_COEF,
+        is_procmaze_name,
+        procmaze_params,
+    )
+
+    assert is_procmaze_name("procmaze") and is_procmaze_name("procmaze_shaped:8")
+    assert not is_procmaze_name("catch") and not is_procmaze_name("procmazes")
+    assert procmaze_params("procmaze") == {}
+    assert procmaze_params("procmaze_shaped") == {"shaping_coef": PROCMAZE_SHAPING_COEF}
+    assert procmaze_params("procmaze:8") == {"grid": 8}
+    assert procmaze_params("procmaze_shaped:8") == {
+        "shaping_coef": PROCMAZE_SHAPING_COEF, "grid": 8,
+    }
+    with pytest.raises(ValueError):
+        procmaze_params("procmaze:1")
+
+
+def test_procmaze_shaped_rewards_telescope():
+    """Shaped variant: a step toward the goal pays +coef, away -coef,
+    blocked/NOOP 0, reaching still pays the full +1 — so the shaping sum
+    telescopes to coef * initial distance and cannot outweigh the goal."""
+    import jax
+    import numpy as np
+
+    from r2d2_tpu.envs.procmaze import PROCMAZE_SHAPING_COEF as C
+    from r2d2_tpu.envs.procmaze import ProcMazeEnv, ProcMazeState
+
+    env = ProcMazeEnv(grid=8, cell=8, horizon=96, shaping_coef=C)
+    walls = jnp.zeros((8, 8), bool)
+    s = ProcMazeState(
+        walls,
+        jnp.asarray([4, 2], jnp.int32),
+        jnp.asarray([4, 5], jnp.int32),
+        jnp.zeros((), jnp.int32),
+        jax.random.PRNGKey(0),
+    )
+    s1, r_toward, d = env.step(s, jnp.int32(4))   # right, toward goal
+    assert float(r_toward) == pytest.approx(C) and not bool(d)
+    _, r_away, _ = env.step(s, jnp.int32(3))      # left, away
+    assert float(r_away) == pytest.approx(-C)
+    _, r_noop, _ = env.step(s, jnp.int32(0))
+    assert float(r_noop) == 0.0
+    s2, _, _ = env.step(s1, jnp.int32(4))
+    s3, r_goal, done = env.step(s2, jnp.int32(4))  # lands on goal
+    assert float(r_goal) == 1.0 and bool(done)
+
+    # sparse variant unchanged: same path pays 0 until the goal
+    sparse = ProcMazeEnv(grid=8, cell=8, horizon=96)
+    _, r0, _ = sparse.step(s, jnp.int32(4))
+    assert float(r0) == 0.0
+
+
+def test_procmaze_grid_variant_through_trainer_envs():
+    """'procmaze_shaped:8' builds an 8x8 maze at the same 64x64x3 obs via
+    both the functional and vec construction paths."""
+    from r2d2_tpu.config import procgen_impala
+    from r2d2_tpu.train import build_fn_env, build_vec_env
+
+    cfg = procgen_impala("procmaze_shaped:8").replace(num_actors=2)
+    fn_env = build_fn_env(cfg)
+    assert fn_env.g == 8 and fn_env.cell == 8 and fn_env.shaping > 0
+    import jax
+
+    s = fn_env.reset(jax.random.PRNGKey(0))
+    assert fn_env.render(s).shape == (64, 64, 3)
+    vec = build_vec_env(cfg, seed=1)
+    assert vec.obs_shape == (64, 64, 3) and vec.action_dim == 5
