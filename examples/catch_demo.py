@@ -29,7 +29,10 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def demo_config(out: str, steps: int, actors: int, full: bool, env: str = "catch"):
+def demo_config(
+    out: str, steps: int, actors: int, full: bool, env: str = "catch",
+    size: int = 26,
+):
     from r2d2_tpu.config import R2D2Config, default_atari
 
     K = 16 if full else 8
@@ -60,18 +63,25 @@ def demo_config(out: str, steps: int, actors: int, full: bool, env: str = "catch
             target_net_update_interval=500,
             **common,
         )
+    # mid-scale recipe at a parameterized resolution (--size): episodes
+    # are size-2 steps, blocks round that up to the L=20 window grid —
+    # the SAME network/hyperparameters at growing obs scale is the
+    # difficulty-frontier axis (26 solves memory catch; where it breaks
+    # charts the scale frontier)
+    episode = size - 2
+    block = ((episode + 19) // 20) * 20
     return R2D2Config(
-        obs_shape=(26, 26, 1),
+        obs_shape=(size, size, 1),
         encoder="impala",
         impala_channels=(8, 16),
         hidden_dim=128,
-        max_episode_steps=24,
+        max_episode_steps=episode,
         updates_per_dispatch=8,
         burn_in_steps=10,
         learning_steps=20,
         forward_steps=5,
-        block_length=40,
-        buffer_capacity=80_000,
+        block_length=block,
+        buffer_capacity=2000 * block,
         learning_starts=10_000,
         gamma=0.99,
         target_net_update_interval=100,
@@ -86,6 +96,10 @@ def main():
     p.add_argument("--actors", type=int, default=64)
     p.add_argument("--full", action="store_true",
                    help="flagship Atari-scale config (needs --steps 50000+)")
+    p.add_argument("--size", type=int, default=26,
+                   help="mid-scale obs resolution (ignored with --full): "
+                        "26 is the solved baseline; 40/52 chart the scale "
+                        "frontier with the same recipe")
     p.add_argument("--env", default="catch",
                    help="catch | memory_catch[:K] — the flashing-cue memory "
                         "variant (ball visible only for the first K frames; "
@@ -98,6 +112,11 @@ def main():
                         "machinery's proof of life")
     p.add_argument("--resume", action="store_true",
                    help="continue from the checkpoints under --out")
+    p.add_argument("--eval-only", action="store_true",
+                   help="skip training: re-evaluate the checkpoint series "
+                        "under --out with the current --eval-episodes "
+                        "(pass the SAME --env/--steps/--full/--size/--set "
+                        "the run used so the config matches)")
     p.add_argument("--eval-episodes", type=int, default=4,
                    help="episodes per eval slot per checkpoint (16 slots, "
                         "so the default is 64 episodes per point — the "
@@ -132,7 +151,9 @@ def main():
     from r2d2_tpu.train import Trainer
     from r2d2_tpu.utils.supervision import WorkerStalledError, exit_for_stall
 
-    cfg = demo_config(args.out, args.steps, args.actors, args.full, env=args.env)
+    cfg = demo_config(
+        args.out, args.steps, args.actors, args.full, env=args.env, size=args.size
+    )
     if args.mode == "fused":
         # pace collection to the threaded run's observed consumed:inserted
         # ratio instead of collecting every dispatch
@@ -143,22 +164,37 @@ def main():
         from r2d2_tpu.config import parse_overrides
 
         cfg = cfg.replace(**parse_overrides(args.set))
-    trainer = Trainer(cfg, resume=args.resume)
-    try:
-        if args.mode == "fused":
-            trainer.run_fused()
-        else:
-            trainer.run_threaded()
-    except WorkerStalledError as e:
-        # wedged runtime: exit promptly with the restart-with---resume code
-        # (same CLI contract as r2d2_tpu.train.main)
-        exit_for_stall(e)
+    if args.eval_only:
+        # same net/eval machinery as the post-training path, no Trainer —
+        # used to re-emit headline curves at higher episode counts
+        import jax
+
+        from r2d2_tpu.learner import init_train_state
+
+        net, _ = init_train_state(cfg, jax.random.PRNGKey(0))
+
+        class _NetOnly:
+            pass
+
+        trainer = _NetOnly()
+        trainer.net = net
+    else:
+        trainer = Trainer(cfg, resume=args.resume)
+        try:
+            if args.mode == "fused":
+                trainer.run_fused()
+            else:
+                trainer.run_threaded()
+        except WorkerStalledError as e:
+            # wedged runtime: exit promptly with the restart-with---resume
+            # code (same CLI contract as r2d2_tpu.train.main)
+            exit_for_stall(e)
 
     h = cfg.obs_shape[0]
     params_kw = catch_params(cfg.env_name)
     reward_fn = None
-    if args.full:
-        # host-driven eval pays a device round trip per step; at 82-step
+    if args.full or args.size > 26:
+        # host-driven eval pays a device round trip per step; at long
         # episodes use the device-side evaluator (one dispatch/checkpoint)
         from r2d2_tpu.envs.catch import CatchEnv
         from r2d2_tpu.evaluate import evaluate_params_device, make_eval_collect_fn

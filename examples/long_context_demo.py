@@ -32,11 +32,22 @@ def main():
     p.add_argument("--steps", type=int, default=12000)
     p.add_argument("--actors", type=int, default=8)
     p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--env", default=None,
+                   help="catch-family env overriding the preset's "
+                        "memory_catch:8:12 — e.g. memory_catch:8:4 (328-"
+                        "step episodes: ONE 512-step window covers the "
+                        "episode, the solvable span of the difficulty "
+                        "ladder; the training seq stays 581)")
+    p.add_argument("--eval-episodes", type=int, default=2,
+                   help="episodes per eval slot per checkpoint (16 slots)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--ablate-zero-state", action="store_true",
                    help="zero-state replay ablation (burn_in=0): window 1 "
                         "of every block loses the stored state that carries "
                         "the cue")
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   help="override any R2D2Config field on top of the demo "
+                        "config (repeatable, typed by the field)")
     args = p.parse_args()
     os.makedirs(args.out, exist_ok=True)
 
@@ -48,7 +59,8 @@ def main():
 
     K = 2
     steps = max(args.steps // K, 1) * K
-    cfg = long_context().replace(
+    cfg = long_context(args.env) if args.env else long_context()
+    cfg = cfg.replace(
         num_actors=args.actors,
         batch_size=args.batch,
         # one-chip demo budget: 200 block slots ~= 1.5 GB obs store; each
@@ -72,6 +84,10 @@ def main():
     )
     if args.ablate_zero_state:
         cfg = cfg.replace(burn_in_steps=0, zero_state_replay=True)
+    if args.set:
+        from r2d2_tpu.config import parse_overrides
+
+        cfg = cfg.replace(**parse_overrides(args.set))
 
     trainer = Trainer(cfg, resume=args.resume)
     try:
@@ -83,11 +99,12 @@ def main():
     fn_env = CatchEnv(height=h, width=h, **catch_params(cfg.env_name))
     collect_fn = make_eval_collect_fn(cfg, trainer.net, fn_env, num_envs=16)
     reward_fn = lambda net, p: evaluate_params_device(
-        cfg, net, p, fn_env, num_envs=16, seed=1234, collect_fn=collect_fn
+        cfg, net, p, fn_env, num_envs=16, seed=1234, collect_fn=collect_fn,
+        episodes_per_slot=args.eval_episodes,
     )
     rows = evaluate_series(
         cfg, None, out_path=os.path.join(args.out, "eval.jsonl"), reward_fn=reward_fn,
-        episodes_per_checkpoint=16,
+        episodes_per_checkpoint=16 * args.eval_episodes,
     )
     if rows:
         plot_series(rows, os.path.join(args.out, "curve.jpg"))

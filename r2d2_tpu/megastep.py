@@ -394,6 +394,7 @@ def make_sharded_megastep(
     chunk_len: int,
     num_updates: int,
     donate: bool = True,
+    is_from_priorities: bool = False,
 ):
     """The multi-chip megastep: ONE shard_map dispatch over the mesh's dp
     axis runs, PER DEVICE,
@@ -420,7 +421,12 @@ def make_sharded_megastep(
     starts (dp,) the per-shard LOCAL first slot reserved via
     _reserve_advance, and env_state/epsilons are sharded over dp on their
     leading E axis. Ordering semantics are identical to the single-chip
-    megastep (SSA: update gathers read pre-scatter store contents)."""
+    megastep (SSA: update gathers read pre-scatter store contents).
+
+    is_from_priorities=True: w carries RAW sampled tree priorities,
+    normalized per update with a pmin over dp inside the scan
+    (make_multi_update_core) — the multihost runner's path, where hosts
+    only know their local shards' priorities."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
@@ -429,7 +435,10 @@ def make_sharded_megastep(
         raise ValueError(f"num_envs {num_envs} not divisible by dp {dp}")
     E_local = num_envs // dp
     collect_core = make_collect_core(cfg, net, fn_env, E_local, chunk_len)
-    multi_core = make_multi_update_core(cfg, net, num_updates, axis_name="dp")
+    multi_core = make_multi_update_core(
+        cfg, net, num_updates, axis_name="dp",
+        is_from_priorities=is_from_priorities,
+    )
 
     def body(state, stores, env_state, epsilons, keys, b, s, w, starts):
         # local views: stores (nb/dp, ...), env_state/epsilons (E/dp, ...),
@@ -573,3 +582,182 @@ class ShardedFusedRunner(_DeferredDrainRunner):
 
     def _apply_priorities(self, d, row) -> None:
         self.replay.update_priorities(d.idxes, row, d.old_ptrs, d.old_advances)
+
+
+class MultiHostFusedRunner(_DeferredDrainRunner):
+    """The fused megastep over a GLOBAL (possibly multi-process) mesh —
+    the sharded runner's protocol on MultiHostShardedReplay. Every
+    process calls step() in lockstep (the dispatch is SPMD-collective);
+    everything host-side is LOCAL:
+
+    - draws come from replay.sample_global_k (per-LOCAL-shard, raw
+      priorities -> in-step pmin IS normalization);
+    - slot reservation, chunk accounting, and the deferred priority
+      drain each touch only this host's shards, read through the global
+      arrays' addressable pieces;
+    - env slots are pinned per shard (the sharded megastep's rule): this
+      host materializes env states and epsilon rows only for its local
+      shards, assembled zero-copy into the global (E, ...) views the
+      dispatch consumes.
+
+    cfg.num_actors is the GLOBAL env count (E/dp per shard, like
+    ShardedFusedRunner). samples_per_insert pacing is converted to a
+    deterministic every-n-dispatches cadence at construction: the ratio
+    pacer runs on host-local counters, and hosts disagreeing about
+    collect on the same step would dispatch mismatched collective
+    programs. Validated end to end on the single-process multi-device
+    mesh (tests + dryrun phase 6); the host-side plumbing uses only
+    addressable-shard APIs so a physical multi-host run has the correct
+    per-process structure."""
+
+    def __init__(
+        self,
+        cfg: R2D2Config,
+        net: R2D2Network,
+        fn_env,
+        replay,
+        epsilons,
+        key: jax.Array,
+        mesh,
+        collect_every: int = 1,
+        chunk_len: Optional[int] = None,
+        sample_rng: Optional[np.random.Generator] = None,
+        samples_per_insert: float = 0.0,
+    ):
+        from jax.sharding import PartitionSpec as P
+
+        from r2d2_tpu.learner import make_sharded_fused_multi_train_step
+
+        self.mesh = mesh
+        dp = replay.dp
+        self.dp = dp
+        E = cfg.num_actors
+        if E % dp:
+            raise ValueError(f"num_actors {E} not divisible by dp {dp}")
+        self.E_local = E // dp
+        if samples_per_insert > 0:
+            # ratio pacing runs on host-LOCAL insert counters, so on a
+            # multi-process mesh different hosts could decide collect
+            # differently on the same step and dispatch MISMATCHED
+            # collective programs (SPMD deadlock). Convert the target
+            # ratio ONCE into a deterministic every-n-dispatches cadence
+            # every process computes identically: n = spi * (steps one
+            # chunk inserts, upper bound) / (steps K updates consume).
+            chunk0 = int(chunk_len or default_chunk_len(cfg))
+            consumed = cfg.updates_per_dispatch * cfg.batch_size * cfg.learning_steps
+            collect_every = max(1, round(samples_per_insert * E * chunk0 / consumed))
+            samples_per_insert = 0.0
+        self._init_protocol(
+            cfg, replay, collect_every, samples_per_insert, sample_rng,
+            chunk_len, ring_slots=replay.blocks_per_shard, ring_envs=self.E_local,
+        )
+        self._dev_to_g = {d: g for g, d in replay._shard_device.items()}
+
+        # per-LOCAL-shard env slots, epsilon rows, and PRNG streams,
+        # assembled into global views (shard g owns env rows
+        # [g*E/dp, (g+1)*E/dp) — the pinned-slot rule)
+        eps_np = np.asarray(epsilons, np.float32)
+        if len(eps_np) != E:
+            raise ValueError(f"epsilons must be the GLOBAL (E={E},) ladder")
+        per_eps, per_env, per_key = {}, {}, {}
+        for g in replay.local_ids:
+            dev = replay._shard_device[g]
+            rows = slice(g * self.E_local, (g + 1) * self.E_local)
+            per_eps[g] = jax.device_put(eps_np[rows], dev)
+            env_g = jax.vmap(fn_env.reset)(
+                jax.random.split(jax.random.fold_in(key, g), self.E_local)
+            )
+            per_env[g] = jax.device_put(env_g, dev)
+            per_key[g] = jax.device_put(
+                jax.random.fold_in(key, 10_000 + g)[None], dev
+            )
+        self.epsilons = replay._assemble(per_eps, (E,), P("dp"))
+        self.env_state = self._assemble_tree(per_env, E)
+        self.keys = self._assemble_tree(per_key, dp)
+        self._mega = make_sharded_megastep(
+            cfg, net, fn_env, mesh, E, self.chunk, self.K,
+            is_from_priorities=True,
+        )
+        self._multi = make_sharded_fused_multi_train_step(
+            cfg, net, mesh, self.K, is_from_priorities=True
+        )
+
+    # ------------------------------------------------------------ helpers
+
+    def _assemble_tree(self, per_g, leading: int):
+        """Per-local-shard pytrees (leaves (E/dp, ...) or (1, ...)) ->
+        global pytree with every leaf (leading, ...) sharded P('dp')."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        replay = self.replay
+        trees = [per_g[g] for g in replay.local_ids]
+
+        def comb(*leaves):
+            shape = (leading,) + tuple(leaves[0].shape[1:])
+            return jax.make_array_from_single_device_arrays(
+                shape, NamedSharding(self.mesh, P("dp")), list(leaves)
+            )
+
+        return jax.tree.map(comb, *trees)
+
+    # ----------------------------------------------------------- protocol
+
+    def _dispatch(self, state: TrainState, collect: bool):
+        from jax.sharding import PartitionSpec as P
+
+        replay = self.replay
+        starts_d = chunk_host = None
+        with replay.lock:
+            if collect:
+                starts_d, per_start = {}, {}
+                for g in replay.local_ids:
+                    sh = replay.shards[g]
+                    with sh.lock:
+                        starts_d[g] = sh._reserve_advance(self.E_local)
+                    per_start[g] = jax.device_put(
+                        np.asarray([starts_d[g]], np.int32),
+                        replay._shard_device[g],
+                    )
+                starts = replay._assemble(per_start, (self.dp,), P("dp"))
+            (b, s, w), draws = replay.sample_global_k(self.K)
+            if collect:
+                (state, new_stores, m, prios, chunk_host,
+                 self.env_state, self.keys) = self._mega(
+                    state, replay.global_stores(), self.env_state,
+                    self.epsilons, self.keys, b, s, w, starts,
+                )
+                replay.install_global_stores(new_stores)
+            else:
+                state, m, prios = self._multi(
+                    state, replay.global_stores(), b, s, w
+                )
+        return state, m, prios, draws, starts_d, chunk_host
+
+    def _drain_chunk(self, pending) -> int:
+        """Install a deferred chunk's accounting per LOCAL shard, reading
+        only the global bookkeeping arrays' addressable pieces (the base
+        class's np.asarray would touch non-addressable shards on a
+        multi-process mesh)."""
+        starts_d, chunk_host = pending
+        replay = self.replay
+        per_g = {g: [None] * len(chunk_host) for g in replay.local_ids}
+        for fi, field in enumerate(chunk_host):
+            for piece in field.addressable_shards:
+                per_g[self._dev_to_g[piece.device]][fi] = np.asarray(piece.data)
+        recorded = 0
+        for g in replay.local_ids:
+            chunk_prios, num_seq, sizes, dones, ep_rewards = per_g[g]
+            with replay.shards[g].lock:
+                replay.shards[g]._account_blocks_at(
+                    int(starts_d[g]), num_seq, sizes, chunk_prios,
+                    ep_rewards, dones,
+                )
+            recorded += int(sizes.sum())
+        self.total_env_steps += recorded
+        return recorded
+
+    def _drain(self, pending) -> None:
+        # the store's deferred-drain applier handles an explicit pending
+        # pair: addressable pieces only, row i under draw i's per-shard
+        # staleness window + lap stamp
+        self.replay.drain_pending(pending)

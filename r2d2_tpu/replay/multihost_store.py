@@ -228,6 +228,20 @@ class MultiHostShardedReplay:
             for k in self._global_field_shape
         }
 
+    def install_global_stores(self, new_stores: Dict[str, jnp.ndarray]) -> None:
+        """Re-point the per-shard store buffers at a dispatch's returned
+        global arrays (the multihost fused megastep donates the old
+        buffers and hands back P('dp')-sharded replacements): each host
+        keeps only its addressable pieces — zero-copy single-device
+        views. Caller holds self.lock."""
+        dev_to_g = {d: g for g, d in self._shard_device.items()}
+        fresh: Dict[int, Dict[str, jnp.ndarray]] = {g: {} for g in self.local_ids}
+        for k, arr in new_stores.items():
+            for piece in arr.addressable_shards:
+                fresh[dev_to_g[piece.device]][k] = piece.data
+        for g in self.local_ids:
+            self.stores[g] = fresh[g]
+
     def sample_global(self):
         """Draw B/dp sequences per LOCAL shard and assemble the global
         (dp, B/dp) coordinate arrays for the shard_map step.

@@ -899,10 +899,13 @@ class Trainer:
         samples_per_insert == 0 collects every dispatch. An explicit
         collect_every overrides both."""
         cfg = self.cfg
-        if cfg.collector != "device" or cfg.replay_plane not in ("device", "sharded"):
+        if cfg.collector != "device" or cfg.replay_plane not in (
+            "device", "sharded", "multihost"
+        ):
             raise ValueError(
                 "run_fused needs collector='device' and replay_plane="
-                f"'device'/'sharded' (got {cfg.collector!r}, {cfg.replay_plane!r})"
+                f"'device'/'sharded'/'multihost' (got {cfg.collector!r}, "
+                f"{cfg.replay_plane!r})"
             )
         self._start_time = time.time()
         # main-thread watchdog: this loop has no worker threads, so a
@@ -915,7 +918,11 @@ class Trainer:
 
     def _run_fused_body(self, sup: Supervisor, collect_every: Optional[int]) -> None:
         cfg = self.cfg
-        from r2d2_tpu.megastep import FusedSystemRunner, ShardedFusedRunner
+        from r2d2_tpu.megastep import (
+            FusedSystemRunner,
+            MultiHostFusedRunner,
+            ShardedFusedRunner,
+        )
 
         self.warmup(beat=sup.main_beat)
         common = dict(
@@ -924,7 +931,15 @@ class Trainer:
             sample_rng=self.sample_rng,
             samples_per_insert=cfg.samples_per_insert if collect_every is None else 0.0,
         )
-        if cfg.replay_plane == "sharded":
+        if cfg.replay_plane == "multihost":
+            # collective megastep over the GLOBAL mesh: the runner builds
+            # its own per-local-shard env slots (pinned-slot rule); the
+            # warmup collector's episodes end here
+            runner = MultiHostFusedRunner(
+                cfg, self.net, self.fn_env, self.replay,
+                self.actor.epsilons, self.actor.key, self.mesh, **common,
+            )
+        elif cfg.replay_plane == "sharded":
             runner = ShardedFusedRunner(
                 cfg, self.net, self.fn_env, self.replay,
                 self.actor.epsilons, self.actor.env_state, self.actor.key,

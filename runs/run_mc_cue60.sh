@@ -1,0 +1,20 @@
+#!/bin/bash
+# Single best-reasoned flagship shot: cue 60 = blind span 22 (>= the
+# verdict's 20-step bar) with 22 CONTROLLABLE steps/episode — mid-scale
+# signal density — warm-started from solved plain catch, with the
+# mid-scale-proven hyperparameter class (gamma .99, sync 250, L=20).
+cd /root/repo
+run_with_retry() {
+  local tries=0
+  python examples/catch_demo.py "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    python examples/catch_demo.py "$@"; rc=$?
+  done
+  return $rc
+}
+run_with_retry --out runs/mc84_cue60 --env memory_catch:60 --full --mode fused --resume \
+  --steps 140000 --set gamma=0.99 --set target_net_update_interval=250 \
+  --set learning_steps=20 --set burn_in_steps=20 --set save_interval=5000
+echo "=== CUE60 EXIT: $? ==="

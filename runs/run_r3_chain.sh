@@ -1,0 +1,68 @@
+#!/bin/bash
+# Round-3 serialized TPU run chain. Waits for the cue-60 flagship shot to
+# finish, then runs, in value order:
+#   1. scale frontier: the SOLVED 26x26 memory-catch recipe at 40x40 and
+#      52x52 (same net/hypers, blind fraction ~0.58 throughout) — charts
+#      where and why the recipe breaks between 26 and 84
+#   2. procmaze_shaped: the IMPALA config with potential-based shaping,
+#      vs the measured random-walk baseline (12.3% success on 16x16)
+#   3. long-context solvable span: memory_catch:8:4 (328-step episodes,
+#      one 512-step window per episode, training seq stays 581)
+#   4. re-emit the mid-scale memory curves at n=64 episodes/checkpoint
+cd /root/repo
+while ! grep -q "CUE60 EXIT" runs/mc84_cue60.log 2>/dev/null; do sleep 60; done
+
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+# --- 1. scale frontier (blind fraction ~0.58: cue 16/38 at 40, 21/50 at 52)
+run_with_retry python examples/catch_demo.py --out runs/mc_frontier40 \
+  --env memory_catch:16 --size 40 --steps 48000 --mode fused
+echo "=== FRONTIER40 EXIT: $? ==="
+run_with_retry python examples/catch_demo.py --out runs/mc_frontier52 \
+  --env memory_catch:21 --size 52 --steps 48000 --mode fused
+echo "=== FRONTIER52 EXIT: $? ==="
+
+# --- 2. shaped procmaze under the IMPALA preset (random-walk baseline
+#        measured by runs/measure_random_baseline.py -> baseline.json)
+mkdir -p runs/procmaze_shaped
+python runs/measure_random_baseline.py --env procmaze_shaped --episodes 2048 \
+  --out runs/procmaze_shaped/baseline.json
+run_with_retry python -m r2d2_tpu.train --preset procgen_impala --env procmaze_shaped \
+  --mode fused --steps 30000 --updates-per-dispatch 16 \
+  --set checkpoint_dir=runs/procmaze_shaped/ckpt \
+  --set metrics_path=runs/procmaze_shaped/metrics.jsonl \
+  --set buffer_capacity=200000 --set learning_starts=30000 \
+  --set samples_per_insert=15.0 --set save_interval=3750 \
+  --set target_net_update_interval=500 --set forward_steps=20 --set num_actors=16
+echo "=== PROCMAZE_SHAPED TRAIN EXIT: $? ==="
+python -m r2d2_tpu.evaluate --preset procgen_impala --env procmaze_shaped --episodes 4 \
+  --out runs/procmaze_shaped/eval.jsonl --plot runs/procmaze_shaped/curve.jpg \
+  --set checkpoint_dir=runs/procmaze_shaped/ckpt
+echo "=== PROCMAZE_SHAPED EVAL EXIT: $? ==="
+
+# --- 3. long-context solvable span (one 512-window covers the episode;
+#        block 512 so the store holds full episodes without 3x padding)
+run_with_retry python examples/long_context_demo.py --out runs/long_context_solve \
+  --env memory_catch:8:4 --steps 30000 \
+  --set block_length=512 --set buffer_capacity=204800 --set learning_starts=40000
+echo "=== LONG_CONTEXT_SOLVE EXIT: $? ==="
+
+# --- 4. headline mid-scale curves at reference-class episode counts
+#        (--eval-only rebuilds the run's exact demo config; 4/slot x 16
+#        slots = 64 episodes per checkpoint)
+python examples/catch_demo.py --out runs/mc_mid_main --env memory_catch:10 \
+  --steps 48000 --mode fused --eval-only --eval-episodes 4
+echo "=== MID MAIN REEVAL EXIT: $? ==="
+python examples/catch_demo.py --out runs/mc_mid_zerostate --env memory_catch:10 \
+  --steps 48000 --mode fused --ablate-zero-state --eval-only --eval-episodes 4
+echo "=== MID ZEROSTATE REEVAL EXIT: $? ==="
+echo R3_CHAIN_ALL_DONE

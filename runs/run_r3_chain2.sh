@@ -1,0 +1,49 @@
+#!/bin/bash
+# Round-3 chain 2: the scale-frontier DIAGNOSTIC. Six flagship (Nature
+# trunk, 512-LSTM, 84x84) memory-catch configurations failed to learn
+# while the 26x26 IMPALA-small/128 recipe solves the same task class.
+# Discriminating experiment: run 84x84 with the MID-SCALE recipe. If it
+# learns where the flagship net did not, the binding factor is the big
+# network's optimization (capacity/hyperparameters), not the resolution;
+# if it also fails, the factor is spatial scale itself. Runs after chain
+# 1 so the frontier points at 40 and 52 bracket the answer.
+cd /root/repo
+while ! grep -q R3_CHAIN_ALL_DONE runs/r3_chain.log 2>/dev/null; do sleep 60; done
+
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+last_eval() { python - "$1" <<'PY'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+print(rows[-1]["mean_reward"] if rows else -9)
+PY
+}
+
+# 84x84, blind span 22 (the verdict bar is >= 20), mid-scale recipe
+run_with_retry python examples/catch_demo.py --out runs/mc84_small_cue60 \
+  --env memory_catch:60 --size 84 --steps 60000 --mode fused
+echo "=== MC84_SMALL_CUE60 EXIT: $? ==="
+EV=$(last_eval runs/mc84_small_cue60/eval.jsonl)
+echo "=== MC84_SMALL_CUE60 EVAL: $EV ==="
+if python -c "import sys; sys.exit(0 if float('$EV') >= 0.5 else 1)"; then
+  # positive at flagship scale: run the zero-state ablation at the SAME
+  # config/budget — the verdict's "done" pair
+  run_with_retry python examples/catch_demo.py --out runs/mc84_small_cue60_zerostate \
+    --env memory_catch:60 --size 84 --steps 60000 --mode fused --ablate-zero-state
+  echo "=== MC84_SMALL_ZEROSTATE EXIT: $? ==="
+else
+  # negative: extend the run once before calling it
+  run_with_retry python examples/catch_demo.py --out runs/mc84_small_cue60 \
+    --env memory_catch:60 --size 84 --steps 100000 --mode fused --resume
+  echo "=== MC84_SMALL_CUE60_EXT EXIT: $? ==="
+fi
+echo R3_CHAIN2_ALL_DONE

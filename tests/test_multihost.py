@@ -429,3 +429,36 @@ def test_trainer_multihost_plane_k_dispatch(tmp_path):
     trainer.run_inline()
     assert int(trainer.state.step) == 8
     assert trainer.plane.replay._pending is None  # final drain happened
+
+
+def test_trainer_multihost_fused_megastep(tmp_path):
+    """run_fused on the multihost plane: the collective megastep (K
+    updates + per-shard collection + local slab writes in ONE shard_map
+    dispatch over the global mesh) drives training end to end, with the
+    deferred chunk/priority drains landing on local shards only."""
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.train import Trainer
+
+    cfg = tiny_test().replace(
+        env_name="catch",
+        obs_shape=(12, 12, 1),
+        action_dim=3,
+        replay_plane="multihost",
+        collector="device",
+        num_actors=8,
+        batch_size=8,
+        updates_per_dispatch=2,
+        block_length=16,
+        buffer_capacity=16 * 16 * 8,
+        learning_starts=64,
+        max_episode_steps=10,
+        training_steps=8,
+        save_interval=4,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    trainer = Trainer(cfg)
+    trainer.run_fused()
+    assert int(trainer.state.step) == 8
+    assert trainer.replay.env_steps > 0
+    n_ep, r_sum = trainer.replay.episode_totals()
+    assert n_ep > 0 and np.isfinite(r_sum)
