@@ -172,14 +172,9 @@ def main():
         from r2d2_tpu.learner import init_train_state
 
         net, _ = init_train_state(cfg, jax.random.PRNGKey(0))
-
-        class _NetOnly:
-            pass
-
-        trainer = _NetOnly()
-        trainer.net = net
     else:
         trainer = Trainer(cfg, resume=args.resume)
+        net = trainer.net
         try:
             if args.mode == "fused":
                 trainer.run_fused()
@@ -200,7 +195,7 @@ def main():
         from r2d2_tpu.evaluate import evaluate_params_device, make_eval_collect_fn
 
         fn_env = CatchEnv(height=h, width=h, **params_kw)
-        collect_fn = make_eval_collect_fn(cfg, trainer.net, fn_env, num_envs=16)
+        collect_fn = make_eval_collect_fn(cfg, net, fn_env, num_envs=16)
         reward_fn = lambda net, p: evaluate_params_device(
             cfg, net, p, fn_env, num_envs=16, seed=1234, collect_fn=collect_fn,
             episodes_per_slot=args.eval_episodes,

@@ -156,18 +156,17 @@ class R2D2Config:
 
     # --- derived ----------------------------------------------------------
     @property
-    def plain_jit_plane(self) -> bool:
-        """Plain-jit learner planes (GSPMD partitions from shardings alone)
-        vs shard_map planes (replicated params declared in the specs)."""
-        return self.replay_plane in ("host", "device")
-
-    @property
     def tp_shards_params(self) -> bool:
-        """True when tp>1 actually shards the LSTM kernels via GSPMD — the
-        plain-jit planes only (the rule lives here ONCE: config validation,
-        the model's LSTM backend resolution, and the Trainer's state
-        placement all read it)."""
-        return self.tp_size > 1 and self.plain_jit_plane
+        """True when tp>1 actually shards the LSTM kernels via GSPMD (the
+        rule lives here ONCE: config validation, the model's LSTM backend
+        resolution, and the Trainer's state placement all read it).
+
+        Plain-jit planes: GSPMD partitions from the param shardings alone.
+        The "sharded" shard_map plane composes the same way — its maps are
+        manual over dp ONLY (axis_names={"dp"}), leaving tp GSPMD-auto, so
+        tp-sharded params partition the per-dp-shard update body (learner.
+        make_sharded_fused_*). Only the multihost plane pins tp=1."""
+        return self.tp_size > 1 and self.replay_plane != "multihost"
 
     @property
     def seq_len(self) -> int:
@@ -212,10 +211,10 @@ class R2D2Config:
             raise ValueError(f"unknown lstm_backend {self.lstm_backend!r}")
         if self.tp_shards_params and self.lstm_backend == "pallas":
             raise ValueError(
-                "tp_size > 1 on the host/device planes shards the LSTM "
-                "kernels via GSPMD, which cannot partition the Pallas "
-                "unroll; use lstm_backend='scan' (or 'auto', which "
-                "resolves to scan there)"
+                "tp_size > 1 shards the LSTM kernels via GSPMD, which "
+                "cannot partition the Pallas unroll; use "
+                "lstm_backend='scan' (or 'auto', which resolves to scan "
+                "there)"
             )
         if self.replay_plane not in ("host", "device", "sharded", "multihost"):
             raise ValueError(f"unknown replay_plane {self.replay_plane!r}")

@@ -204,7 +204,10 @@ def evaluate_series(
         }
         rows.append(row)
         print(json.dumps(row))
-    if out_path:
+    if out_path and rows:
+        # no rows -> leave out_path untouched: an eval over a run whose
+        # checkpoints are gone must not truncate previously recorded
+        # results to an empty file
         with open(out_path, "w") as fh:
             for row in rows:
                 fh.write(json.dumps(row) + "\n")

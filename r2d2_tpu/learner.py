@@ -353,12 +353,17 @@ def make_sharded_fused_multi_train_step(
         return state, metrics, prios[:, None]
 
     # P("dp") is a PREFIX spec for the stores dict: it applies to every
-    # field array (same idiom as make_sharded_fused_train_step)
+    # field array (same idiom as make_sharded_fused_train_step).
+    # axis_names={"dp"}: the map is MANUAL over dp only — the mesh's tp
+    # axis stays GSPMD-auto, so params arriving with tp NamedShardings
+    # (parallel/mesh.train_state_shardings) are Megatron-partitioned
+    # inside the per-dp-shard body by the compiler, composing dp×tp.
     sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P("dp"), P(None, "dp"), P(None, "dp"), P(None, "dp")),
         out_specs=(P(), P(), P(None, "dp")),
+        axis_names={"dp"},
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
@@ -403,6 +408,7 @@ def make_sharded_gather_step(cfg: R2D2Config, mesh):
         mesh=mesh,
         in_specs=(P("dp"), P("dp"), P("dp"), P("dp")),
         out_specs=DeviceBatch(*([P("dp")] * len(DeviceBatch._fields))),
+        axis_names={"dp"},
         check_vma=False,
     )
     return jax.jit(gathered)
@@ -458,11 +464,14 @@ def make_sharded_fused_train_step(
         new_state, metrics, priorities = raw(state, batch)
         return new_state, metrics, priorities[None, :]
 
+    # manual over dp only; tp stays GSPMD-auto (see
+    # make_sharded_fused_multi_train_step) so tp-sharded params compose
     sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P("dp"), P("dp"), P("dp"), P("dp")),
         out_specs=(P(), P(), P("dp")),
+        axis_names={"dp"},
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())

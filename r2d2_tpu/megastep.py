@@ -463,7 +463,11 @@ def make_sharded_megastep(
         )
 
     # P("dp") entries are PREFIX specs: one spec covers every leaf of the
-    # stores dict / env-state pytree / bookkeeping tuple
+    # stores dict / env-state pytree / bookkeeping tuple.
+    # axis_names={"dp"}: manual over dp only — the tp axis stays
+    # GSPMD-auto, so tp-sharded params (train_state_shardings) partition
+    # the update's matmuls inside each dp shard (collection math is
+    # tp-replicated: its env/obs operands carry no tp sharding).
     mega = shard_map(
         body,
         mesh=mesh,
@@ -474,6 +478,7 @@ def make_sharded_megastep(
         out_specs=(
             P(), P("dp"), P(), P(None, "dp"), P("dp"), P("dp"), P("dp"),
         ),
+        axis_names={"dp"},
         check_vma=False,
     )
     return jax.jit(mega, donate_argnums=(0, 1) if donate else ())
@@ -651,7 +656,7 @@ class MultiHostFusedRunner(_DeferredDrainRunner):
             cfg, replay, collect_every, samples_per_insert, sample_rng,
             chunk_len, ring_slots=replay.blocks_per_shard, ring_envs=self.E_local,
         )
-        self._dev_to_g = {d: g for g, d in replay._shard_device.items()}
+        self._dev_to_g = replay._dev_to_g
 
         # per-LOCAL-shard env slots, epsilon rows, and PRNG streams,
         # assembled into global views (shard g owns env rows

@@ -3,11 +3,12 @@
 Axes:
   dp — data parallel: the learner batch splits across this axis; gradient
        all-reduce (psum) is inserted by XLA because params are replicated.
-  tp — tensor parallel: on the plain-jit planes (host/device replay) the
-       LSTM's wide kernels shard their 4H axis over tp via the GSPMD
-       annotations from `train_state_shardings` below; the shard_map
-       planes (sharded/multihost replay) declare replicated params and
-       keep tp=1 (SURVEY.md section 2.3 TP row).
+  tp — tensor parallel: the LSTM's wide kernels shard their 4H axis over
+       tp via the GSPMD annotations from `train_state_shardings` below.
+       Plain-jit planes (host/device replay) partition directly from the
+       shardings; the "sharded" shard_map plane composes dp×tp because
+       its maps are manual over dp ONLY (axis_names={"dp"}) with tp left
+       GSPMD-auto. The multihost plane pins tp=1 (config.validate).
 
 Batches shard their leading (batch) dimension over dp; everything else is
 replicated. With params replicated and batch sharded, jit emits a psum over
@@ -74,12 +75,15 @@ def train_state_shardings(state, mesh: Mesh):
       the MXU. The convs' FLOPs share is also dominated by the batched
       seq dimension, which dp already covers.
 
-    Scope: the plain-jit learner paths (host/device planes) — XLA/GSPMD
-    partitions the matmuls and inserts the tp collectives from these
-    annotations alone (compile-level partitioning is pinned by
-    tests/test_learner.py). The shard_map paths (sharded/multihost
-    planes) keep params replicated per their P() in_specs; they are
-    dp-scaling designs.
+    Scope: everywhere except multihost. On the plain-jit learner paths
+    (host/device planes) XLA/GSPMD partitions the matmuls and inserts the
+    tp collectives from these annotations alone (compile-level
+    partitioning is pinned by tests/test_learner.py). The "sharded"
+    shard_map paths are manual over dp only (axis_names={"dp"}), so
+    inside each dp shard the SAME annotations partition the update body
+    over the GSPMD-auto tp axis (dp×tp parity pinned by
+    tests/test_sharded_replay.py / test_sharded_megastep.py). The
+    multihost plane keeps params replicated per its P() in_specs.
 
     Adam's mu/nu mirror the param tree structure, so the same path rule
     shards them consistently (optimizer math is elementwise)."""

@@ -83,6 +83,9 @@ class MultiHostShardedReplay:
         self._shard_device = {
             g: np.take(mesh.devices, g, axis=axis).ravel()[0] for g in self.local_ids
         }
+        # fixed for the life of the store; hot paths (install_global_stores,
+        # update_priorities, drain_pending) map output shards back by device
+        self._dev_to_g = {d: g for g, d in self._shard_device.items()}
 
         specs = store_field_specs(cfg)
         nbs = self.blocks_per_shard
@@ -234,7 +237,7 @@ class MultiHostShardedReplay:
         buffers and hands back P('dp')-sharded replacements): each host
         keeps only its addressable pieces — zero-copy single-device
         views. Caller holds self.lock."""
-        dev_to_g = {d: g for g, d in self._shard_device.items()}
+        dev_to_g = self._dev_to_g
         fresh: Dict[int, Dict[str, jnp.ndarray]] = {g: {} for g in self.local_ids}
         for k, arr in new_stores.items():
             for piece in arr.addressable_shards:
@@ -302,7 +305,7 @@ class MultiHostShardedReplay:
         window AND lap stamp (a full ring lap between draw and apply wraps
         the pointer back into the window mask's blind spot — the stamp is
         the only guard, control_plane.update_priorities)."""
-        dev_to_g = {d: g for g, d in self._shard_device.items()}
+        dev_to_g = self._dev_to_g
         for shard_piece in priorities.addressable_shards:
             g = dev_to_g[shard_piece.device]
             row = np.asarray(shard_piece.data)[0]
@@ -417,7 +420,7 @@ class MultiHostShardedReplay:
         if pending is None:
             return
         prios, draws = pending
-        dev_to_g = {d: g for g, d in self._shard_device.items()}
+        dev_to_g = self._dev_to_g
         for piece in prios.addressable_shards:
             g = dev_to_g[piece.device]
             data = np.asarray(piece.data)  # (K, 1, B/dp)

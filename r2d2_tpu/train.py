@@ -445,16 +445,19 @@ class Trainer:
 
         self.net, self.state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
         if self.mesh is not None:
-            if cfg.plain_jit_plane:
-                # plain-jit planes: LSTM kernels shard over tp (GSPMD
-                # inserts the collectives); tp=1 degenerates to replicated
+            if cfg.replay_plane != "multihost":
+                # LSTM/encoder kernels shard over tp; tp=1 degenerates to
+                # replicated. Plain-jit planes: GSPMD partitions from
+                # these shardings alone. The "sharded" shard_map plane is
+                # manual over dp only (axis_names={"dp"}), so the same tp
+                # shardings partition the per-dp-shard body.
                 from r2d2_tpu.parallel.mesh import train_state_shardings
 
                 self.state = jax.device_put(
                     self.state, train_state_shardings(self.state, self.mesh)
                 )
             else:
-                # shard_map planes declare P() (replicated) param in_specs
+                # multihost declares P() (dp-replicated) params and tp=1
                 self.state = jax.device_put(self.state, replicated_sharding(self.mesh))
         self.env_steps_offset = 0
         self.wall_minutes_offset = 0.0

@@ -1,4 +1,10 @@
 #!/bin/bash
+# SUPERSEDED by run_r3b_chain.sh: this chain's wait condition references a
+# log that never materialized (the session writing it ended first), and
+# step 4's --eval-only re-evals need checkpoints that left with the
+# round-2 container — run_r3b_chain.sh re-runs those as mc_mid_*_n64.
+# Kept for the experiment rationale in the comments below.
+#
 # Round-3 serialized TPU run chain. Waits for the cue-60 flagship shot to
 # finish, then runs, in value order:
 #   1. scale frontier: the SOLVED 26x26 memory-catch recipe at 40x40 and

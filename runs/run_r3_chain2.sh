@@ -1,4 +1,8 @@
 #!/bin/bash
+# SUPERSEDED by run_r3b_chain.sh, which runs this diagnostic FIRST (its
+# wait condition references run_r3_chain.sh's log, which never
+# materialized). Kept for the experiment rationale below.
+#
 # Round-3 chain 2: the scale-frontier DIAGNOSTIC. Six flagship (Nature
 # trunk, 512-LSTM, 84x84) memory-catch configurations failed to learn
 # while the 26x26 IMPALA-small/128 recipe solves the same task class.

@@ -1,0 +1,106 @@
+#!/bin/bash
+# Round-3 session-B serialized TPU queue (one v5e chip). Value order:
+#   1. DISCRIMINATING EXPERIMENT: 84x84 memory catch (blind span 22)
+#      with the mid-scale recipe (IMPALA-small, 128-LSTM) that solves the
+#      26x26 task. Learns => binding factor at flagship was the big
+#      net's optimization, and we run the zero-state ablation at the
+#      same scale (the verdict's "done" pair). Fails => factor is
+#      spatial scale; extend once, then the frontier points decide.
+#   2. Scale frontier: the same recipe at 40x40 and 52x52 (blind
+#      fraction ~0.58 throughout) to bracket where it breaks.
+#   3. procmaze_shaped (potential-based shaping) vs measured
+#      random-walk baseline under the IMPALA preset.
+#   4. Long-context solvable span: memory_catch:8:4 (328-step episodes,
+#      one 512-window covers the episode; training seq stays 581).
+#   5. Re-run the mid-scale headline ablation pair with n=64
+#      episodes/checkpoint (reference protocol: >=5; old ckpts are
+#      gone with the container, so re-emit = re-run).
+cd /root/repo
+
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+last_eval() { python - "$1" <<'PY'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+print(rows[-1]["mean_reward"] if rows else -9)
+PY
+}
+
+# --- 1. discriminating experiment: 84x84, blind span 22, mid-scale recipe
+run_with_retry python examples/catch_demo.py --out runs/mc84_small_cue60 \
+  --env memory_catch:60 --size 84 --steps 60000 --mode fused
+echo "=== MC84_SMALL_CUE60 EXIT: $? ==="
+EV=$(last_eval runs/mc84_small_cue60/eval.jsonl)
+echo "=== MC84_SMALL_CUE60 EVAL: $EV ==="
+if ! python -c "import sys; sys.exit(0 if float('$EV') >= 0.5 else 1)"; then
+  run_with_retry python examples/catch_demo.py --out runs/mc84_small_cue60 \
+    --env memory_catch:60 --size 84 --steps 120000 --mode fused --resume
+  echo "=== MC84_SMALL_CUE60_EXT EXIT: $? ==="
+  EV=$(last_eval runs/mc84_small_cue60/eval.jsonl)
+  echo "=== MC84_SMALL_CUE60 EVAL2: $EV ==="
+fi
+if python -c "import sys; sys.exit(0 if float('$EV') >= 0.5 else 1)"; then
+  # positive at 84x84: zero-state ablation at the SAME config/budget
+  STEPS=$(python - runs/mc84_small_cue60/eval.jsonl <<'PY'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+print(rows[-1]["step"] if rows else 60000)
+PY
+)
+  run_with_retry python examples/catch_demo.py --out runs/mc84_small_cue60_zerostate \
+    --env memory_catch:60 --size 84 --steps "$STEPS" --mode fused --ablate-zero-state
+  echo "=== MC84_SMALL_ZEROSTATE EXIT: $? ==="
+fi
+
+# --- 2. scale frontier (blind fraction ~0.58: cue 16/38 at 40, 21/50 at 52)
+run_with_retry python examples/catch_demo.py --out runs/mc_frontier40 \
+  --env memory_catch:16 --size 40 --steps 48000 --mode fused
+echo "=== FRONTIER40 EXIT: $? ==="
+run_with_retry python examples/catch_demo.py --out runs/mc_frontier52 \
+  --env memory_catch:21 --size 52 --steps 48000 --mode fused
+echo "=== FRONTIER52 EXIT: $? ==="
+
+# --- 3. shaped procmaze under the IMPALA preset
+mkdir -p runs/procmaze_shaped
+python runs/measure_random_baseline.py --env procmaze_shaped --episodes 2048 \
+  --out runs/procmaze_shaped/baseline.json
+echo "=== PROCMAZE_BASELINE EXIT: $? ==="
+run_with_retry python -m r2d2_tpu.train --preset procgen_impala --env procmaze_shaped \
+  --mode fused --steps 30000 --updates-per-dispatch 16 \
+  --set checkpoint_dir=runs/procmaze_shaped/ckpt \
+  --set metrics_path=runs/procmaze_shaped/metrics.jsonl \
+  --set buffer_capacity=200000 --set learning_starts=30000 \
+  --set samples_per_insert=15.0 --set save_interval=3750 \
+  --set target_net_update_interval=500 --set forward_steps=20 --set num_actors=16
+echo "=== PROCMAZE_SHAPED TRAIN EXIT: $? ==="
+python -m r2d2_tpu.evaluate --preset procgen_impala --env procmaze_shaped --episodes 4 \
+  --out runs/procmaze_shaped/eval.jsonl --plot runs/procmaze_shaped/curve.jpg \
+  --set checkpoint_dir=runs/procmaze_shaped/ckpt
+echo "=== PROCMAZE_SHAPED EVAL EXIT: $? ==="
+
+# --- 4. long-context solvable span
+run_with_retry python examples/long_context_demo.py --out runs/long_context_solve \
+  --env memory_catch:8:4 --steps 30000 \
+  --set block_length=512 --set buffer_capacity=204800 --set learning_starts=40000
+echo "=== LONG_CONTEXT_SOLVE EXIT: $? ==="
+
+# --- 5. mid-scale headline ablation pair at n=64 episodes/checkpoint
+#        (fresh dirs: the round-2 evidence in mc_mid_main/_zerostate is
+#        kept; these are the re-emitted reference-protocol curves)
+run_with_retry python examples/catch_demo.py --out runs/mc_mid_main_n64 \
+  --env memory_catch:10 --steps 48000 --mode fused --eval-episodes 4
+echo "=== MID MAIN EXIT: $? ==="
+run_with_retry python examples/catch_demo.py --out runs/mc_mid_zerostate_n64 \
+  --env memory_catch:10 --steps 48000 --mode fused --ablate-zero-state --eval-episodes 4
+echo "=== MID ZEROSTATE EXIT: $? ==="
+
+echo R3B_CHAIN_ALL_DONE

@@ -235,3 +235,67 @@ def test_sharded_plane_multi_update_threaded(tmp_path):
     assert tr._step >= cfg.training_steps
     assert int(np.asarray(tr.state.step)) == tr._step
     assert tr.plane._pending is None  # final in-flight drain applied
+
+
+def test_sharded_megastep_tp2_matches_tp1(setup):
+    """dpxtp composition on the fused megastep: the SAME megastep inputs
+    run over a (dp=4, tp=1) and a (dp=4, tp=2) mesh must produce
+    identical updates, priorities, store writes, and collection streams —
+    tp partitions the update body's matmuls (manual-dp shard_map, tp
+    GSPMD-auto, params Megatron-sharded) without touching numerics. The
+    updated params must come back still tp-sharded."""
+    cfg_1, mesh_1, fn_env, net, state = setup
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from r2d2_tpu.parallel.mesh import train_state_shardings
+
+    cfg = cfg_1.replace(tp_size=2, lstm_backend="scan")
+    mesh_2 = make_mesh(dp=DP, tp=2, devices=jax.devices()[:8])
+
+    E, Bl = cfg.num_actors, cfg.batch_size // DP
+    chunk = min(cfg.block_length, cfg.max_episode_steps)
+    bps = cfg.num_blocks // DP
+
+    replay, col = _filled_sharded_replay(cfg_1, mesh_1, net, state, fn_env, seed=31)
+    stores_host = {k: np.asarray(v) for k, v in replay.stores.items()}
+
+    rng = np.random.default_rng(17)
+    draws = [replay.sample_indices(rng) for _ in range(K)]
+    b = np.stack([d.b for d in draws])
+    s = np.stack([d.s for d in draws])
+    w = np.stack([d.is_weights for d in draws])
+    keys = np.asarray(jax.random.split(jax.random.PRNGKey(7), DP))
+    eps = np.asarray(epsilon_ladder(E, cfg.base_eps, cfg.eps_alpha), np.float32)
+    kr = jax.random.split(jax.random.PRNGKey(3), E)
+    env_state = jax.tree.map(np.asarray, jax.vmap(fn_env.reset)(kr))
+    starts = np.asarray([1 % bps] * DP, np.int32)
+
+    def run(mesh, tp_state):
+        shd = NamedSharding(mesh, P("dp"))
+        mega = make_sharded_megastep(
+            cfg, net, fn_env, mesh, E, chunk, K, donate=False
+        )
+        return mega(
+            tp_state,
+            {k: jax.device_put(v, shd) for k, v in stores_host.items()},
+            jax.device_put(jax.tree.map(jnp.asarray, env_state), shd),
+            jax.device_put(jnp.asarray(eps), shd),
+            jax.device_put(jnp.asarray(keys), shd),
+            jnp.asarray(b), jnp.asarray(s), jnp.asarray(w),
+            jax.device_put(jnp.asarray(starts), shd),
+        )
+
+    out_1 = run(mesh_1, state)
+    state_tp = jax.device_put(state, train_state_shardings(state, mesh_2))
+    out_2 = run(mesh_2, state_tp)
+
+    names = ("state", "stores", "metrics", "priorities", "chunk", "env", "keys")
+    for name, a, bb in zip(names, out_1, out_2):
+        jax.tree.map(
+            lambda x, y: np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=2e-4, atol=2e-5,
+                err_msg=f"megastep output {name!r} diverged between tp=1 and tp=2",
+            ),
+            a, bb,
+        )
+    wi = out_2[0].params["params"]["core"]["wi"]
+    assert wi.sharding.spec[-1] == "tp"

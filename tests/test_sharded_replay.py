@@ -289,3 +289,77 @@ def test_sharded_add_blocks_batch_post_wrap_tail_retirement():
     # net: the n new blocks evict n occupied slots (wash) and the
     # retirement removes dp*tail occupied blocks outright
     assert len(sh) == size_before - dp * tail * steps_per_block
+
+
+def test_sharded_step_tp2_matches_single_device():
+    """dp=4 x tp=2 on the 8-device mesh: the shard_map step is manual over
+    dp ONLY (axis_names={"dp"}), the tp axis stays GSPMD-auto, and the
+    Megatron param shardings (parallel/mesh.train_state_shardings)
+    partition the per-dp-shard update body over tp. Loss, priorities, and
+    the updated params must match the single-device step on the
+    equivalently assembled global batch, and the updated params must
+    RETAIN their tp shardings (real dpxtp composition, not replication)."""
+    from r2d2_tpu.parallel.mesh import train_state_shardings
+
+    cfg = sharded_cfg(dp_size=4, tp_size=2, replay_plane="sharded")
+    mesh = make_mesh(dp=4, tp=2, devices=jax.devices()[:8])
+    replay = ShardedDeviceReplay(cfg, mesh)
+    fill(replay, cfg)
+
+    net, state0 = init_train_state(cfg, jax.random.PRNGKey(3))
+    state_tp = jax.device_put(state0, train_state_shardings(state0, mesh))
+    sharded_step = make_sharded_fused_train_step(cfg, net, mesh, donate=False)
+    si = replay.sample_indices(np.random.default_rng(1))
+
+    new_state, metrics, prio_sharded = replay.run_with_stores(
+        lambda stores: sharded_step(
+            state_tp, stores, jnp.asarray(si.b), jnp.asarray(si.s),
+            jnp.asarray(si.is_weights),
+        )
+    )
+    assert prio_sharded.shape == (4, 4)
+
+    # reference: the SAME batch assembled on host, single-device step
+    host = {k: np.asarray(v) for k, v in replay.stores.items()}
+    L, T = cfg.learning_steps, cfg.seq_len
+    gb = (np.arange(4)[:, None] * replay.blocks_per_shard + si.b).reshape(-1)
+    s = si.s.reshape(-1)
+    burn = host["burn_in"][gb, s]
+    first_burn = host["burn_in"][gb, 0]
+    start = first_burn + s * L
+    rows = np.clip(
+        (start - burn)[:, None] + np.arange(T)[None, :], 0, cfg.block_slot_len - 1
+    )
+    lrow = s[:, None] * L + np.arange(L)[None, :]
+    batch = DeviceBatch(
+        obs=jnp.asarray(host["obs"][gb[:, None], rows]),
+        last_action=jnp.asarray(host["last_action"][gb[:, None], rows]),
+        last_reward=jnp.asarray(host["last_reward"][gb[:, None], rows]),
+        hidden=jnp.asarray(host["hidden"][gb, s]),
+        action=jnp.asarray(host["action"][gb[:, None], lrow]),
+        n_step_reward=jnp.asarray(host["n_step_reward"][gb[:, None], lrow]),
+        gamma=jnp.asarray(host["gamma"][gb[:, None], lrow]),
+        burn_in_steps=jnp.asarray(burn),
+        learning_steps=jnp.asarray(host["learning"][gb, s]),
+        forward_steps=jnp.asarray(host["forward"][gb, s]),
+        is_weights=jnp.asarray(si.is_weights.reshape(-1)),
+    )
+    ref_step = make_train_step(cfg, net, donate=False)
+    ref_state, ref_metrics, ref_prio = ref_step(state0, batch)
+
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(ref_metrics["loss"]), rtol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(prio_sharded).reshape(-1), np.asarray(ref_prio), rtol=2e-4
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        ),
+        new_state.params,
+        ref_state.params,
+    )
+    # the tp shardings survive the update (donated in, sharded out)
+    wi = new_state.params["params"]["core"]["wi"]
+    assert wi.sharding.spec[-1] == "tp"
