@@ -118,6 +118,12 @@ class R2D2Config:
     # LSTM unroll backend: "auto" = fused Pallas kernel on TPU, lax.scan
     # elsewhere; "scan"/"pallas" force one (ops/pallas_lstm.py)
     lstm_backend: str = "auto"
+    # recurrent core family: "lstm" (reference parity, sequential unroll)
+    # or "lru" (models/lru.py — diagonal linear recurrence whose unroll is
+    # ONE associative_scan: O(log T) depth over time, the long-context
+    # core). Both share the (B, 2, H) stored-state contract, so replay /
+    # burn-in / zero-state machinery is identical.
+    recurrent_core: str = "lstm"
 
     # --- infra ------------------------------------------------------------
     seed: int = 0
@@ -209,6 +215,14 @@ class R2D2Config:
             raise ValueError(f"unknown encoder {self.encoder!r}")
         if self.lstm_backend not in ("auto", "scan", "pallas"):
             raise ValueError(f"unknown lstm_backend {self.lstm_backend!r}")
+        if self.recurrent_core not in ("lstm", "lru"):
+            raise ValueError(f"unknown recurrent_core {self.recurrent_core!r}")
+        if self.recurrent_core == "lru" and self.lstm_backend == "pallas":
+            raise ValueError(
+                "lstm_backend='pallas' is the fused LSTM kernel; the lru "
+                "core has no pallas backend (its associative_scan unroll "
+                "is already time-parallel) — use lstm_backend='auto'"
+            )
         if self.tp_shards_params and self.lstm_backend == "pallas":
             raise ValueError(
                 "tp_size > 1 shards the LSTM kernels via GSPMD, which "

@@ -53,6 +53,8 @@ class R2D2Network(nn.Module):
     impala_channels: Tuple[int, ...] = (16, 32, 32)
     scan_chunk: int | None = None
     lstm_backend: str = "auto"
+    # "lstm" (reference parity) or "lru" (models/lru.py time-parallel core)
+    recurrent_core: str = "lstm"
 
     @classmethod
     def from_config(cls, cfg: R2D2Config) -> "R2D2Network":
@@ -72,20 +74,26 @@ class R2D2Network(nn.Module):
             impala_channels=tuple(cfg.impala_channels),
             scan_chunk=cfg.scan_chunk,
             lstm_backend=backend,
+            recurrent_core=cfg.recurrent_core,
         )
 
     def setup(self):
         dtype = jnp.dtype(self.compute_dtype)
         self.enc = make_encoder(self.encoder, self.hidden_dim, dtype, self.impala_channels)
-        # LSTM input = concat(latent, one-hot action, reward) (model.py:59)
+        # core input = concat(latent, one-hot action, reward) (model.py:59)
         core_in = self.hidden_dim + self.action_dim + 1
-        self.core = LSTM(
-            self.hidden_dim,
-            in_dim=core_in,
-            dtype=dtype,
-            scan_chunk=self.scan_chunk,
-            backend=self.lstm_backend,
-        )
+        if self.recurrent_core == "lru":
+            from r2d2_tpu.models.lru import LRU
+
+            self.core = LRU(self.hidden_dim, in_dim=core_in, dtype=dtype)
+        else:
+            self.core = LSTM(
+                self.hidden_dim,
+                in_dim=core_in,
+                dtype=dtype,
+                scan_chunk=self.scan_chunk,
+                backend=self.lstm_backend,
+            )
         self.adv_hidden = nn.Dense(self.hidden_dim)
         self.adv_out = nn.Dense(self.action_dim)
         self.val_hidden = nn.Dense(self.hidden_dim)

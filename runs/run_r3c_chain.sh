@@ -1,0 +1,32 @@
+#!/bin/bash
+# Round-3 chain C: runs after run_r3b_chain.sh drains. LRU-core evidence
+# plus the core-unroll scaling microbench, then the round bench.
+#   1. core-unroll microbench: LSTM(pallas/scan) vs LRU forward unroll
+#      time at T=128..1024 on the real chip (the LRU's O(log T) claim)
+#   2. LRU learning evidence: the solved mid-scale memory-catch recipe
+#      with recurrent_core=lru — same task, same budget, different core;
+#      memory is load-bearing (cue task), so a positive shows the
+#      linear-recurrence state carries the cue end to end
+cd /root/repo
+while ! grep -q R3B_CHAIN_ALL_DONE runs/r3b_chain.log 2>/dev/null; do sleep 60; done
+
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+python runs/bench_core_unroll.py --out runs/core_unroll.jsonl
+echo "=== CORE_UNROLL EXIT: $? ==="
+
+run_with_retry python examples/catch_demo.py --out runs/mc_mid_lru \
+  --env memory_catch:10 --steps 48000 --mode fused --eval-episodes 4 \
+  --set recurrent_core=lru
+echo "=== MC_MID_LRU EXIT: $? ==="
+
+echo R3C_CHAIN_ALL_DONE
