@@ -40,6 +40,7 @@ import numpy as np
 
 from r2d2_tpu.config import R2D2Config
 from r2d2_tpu.models.encoders import make_encoder
+from r2d2_tpu.models.lru import LRU
 from r2d2_tpu.models.lstm import LSTM, Carry
 
 
@@ -83,10 +84,8 @@ class R2D2Network(nn.Module):
         # core input = concat(latent, one-hot action, reward) (model.py:59)
         core_in = self.hidden_dim + self.action_dim + 1
         if self.recurrent_core == "lru":
-            from r2d2_tpu.models.lru import LRU
-
             self.core = LRU(self.hidden_dim, in_dim=core_in, dtype=dtype)
-        else:
+        elif self.recurrent_core == "lstm":
             self.core = LSTM(
                 self.hidden_dim,
                 in_dim=core_in,
@@ -94,6 +93,8 @@ class R2D2Network(nn.Module):
                 scan_chunk=self.scan_chunk,
                 backend=self.lstm_backend,
             )
+        else:
+            raise ValueError(f"unknown recurrent_core {self.recurrent_core!r}")
         self.adv_hidden = nn.Dense(self.hidden_dim)
         self.adv_out = nn.Dense(self.action_dim)
         self.val_hidden = nn.Dense(self.hidden_dim)

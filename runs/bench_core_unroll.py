@@ -26,10 +26,9 @@ import numpy as np
 
 
 def bench_one(cfg, B, T, iters=20):
-    from r2d2_tpu.models.r2d2 import R2D2Network, init_params
+    from r2d2_tpu.models.r2d2 import init_params
 
-    net = R2D2Network.from_config(cfg)
-    _, params = init_params(jax.random.PRNGKey(0), cfg)
+    net, params = init_params(jax.random.PRNGKey(0), cfg)
     rng = np.random.default_rng(0)
     obs = jnp.asarray(rng.integers(0, 255, (B, T, *cfg.obs_shape), dtype=np.uint8))
     la = jnp.asarray(rng.integers(0, cfg.action_dim, (B, T)), jnp.int32)

@@ -139,3 +139,39 @@ def test_host_plane_with_mesh_auto_psum(tmp_path):
     assert int(tr.state.step) == 6
     leaf = jax.tree.leaves(tr.state.params)[0]
     assert leaf.sharding.is_fully_replicated
+
+
+def test_sharded_plane_tp_resume(tmp_path):
+    """Checkpoint -> resume on the dp x tp sharded plane: the restored
+    state must carry the SAME tp shardings as a fresh placement (restore
+    templates from the already-placed state), and training must continue
+    from the saved step."""
+    cfg = tiny_test().replace(
+        env_name="catch",
+        replay_plane="sharded",
+        dp_size=4,
+        tp_size=2,
+        batch_size=8,
+        buffer_capacity=16 * 40,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        training_steps=10,
+        save_interval=5,
+        learning_starts=48,
+    )
+    tr = run_trainer(cfg)
+    assert int(tr.state.step) == 10
+
+    resumed = Trainer(
+        cfg.replace(training_steps=12),
+        vec_env=CatchVecEnv(num_envs=cfg.num_actors, height=12, width=12, seed=1),
+        resume=True,
+    )
+    assert int(resumed.state.step) == 10
+    wi = resumed.state.params["params"]["core"]["wi"]
+    assert wi.sharding.spec[-1] == "tp", wi.sharding
+    for a, b in zip(
+        jax.tree.leaves(resumed.state.params), jax.tree.leaves(tr.state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    resumed.run_inline(env_steps_per_update=4)
+    assert int(resumed.state.step) == 12
