@@ -1,0 +1,67 @@
+"""Render the memory scale-frontier comparison figure.
+
+One panel, four eval series: the SAME mid-scale recipe (IMPALA-small,
+128-LSTM, stored-state + burn-in, blind fraction ~0.58) at 26/40/52/84
+resolution. 26 solves; everything wider sits at chance — the PARITY.md
+frontier table, as a picture.
+
+  python runs/plot_frontier.py --out runs/memory_scale_frontier.jpg
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+SERIES = [
+    # the n=64-episode re-run supersedes the round-2 n=8 series when present
+    ("26x26 (solved)", ("mc_mid_main_n64/eval.jsonl", "mc_mid_main/eval.jsonl"),
+     "tab:green"),
+    ("40x40", ("mc_frontier40/eval.jsonl",), "tab:orange"),
+    ("52x52", ("mc_frontier52/eval.jsonl",), "tab:red"),
+    ("84x84 (cue 60)", ("mc84_small_cue60/eval.jsonl",), "tab:purple"),
+]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=os.path.join(HERE, "memory_scale_frontier.jpg"))
+    args = p.parse_args()
+
+    fig, ax = plt.subplots(figsize=(7, 4.2))
+    for label, rels, color in SERIES:
+        path = next(
+            (p for rel in rels if os.path.exists(p := os.path.join(HERE, rel))),
+            None,
+        )
+        if path is None:
+            print(f"skip {label}: {rels} missing", file=sys.stderr)
+            continue
+        rows = [json.loads(l) for l in open(path) if l.strip()]
+        ax.plot(
+            [r["step"] / 1e3 for r in rows],
+            [r["mean_reward"] for r in rows],
+            marker="o", ms=3, color=color, label=label,
+        )
+    ax.axhline(1.0, color="gray", lw=0.6, ls="--")
+    ax.axhline(-1.0, color="gray", lw=0.6, ls="--")
+    ax.set_xlabel("updates (thousands)")
+    ax.set_ylabel("eval mean reward (ε=0.001)")
+    ax.set_title("Memory catch: same recipe, growing spatial scale")
+    ax.legend(loc="center right", fontsize=8)
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=130)
+    print(args.out)
+
+
+if __name__ == "__main__":
+    main()

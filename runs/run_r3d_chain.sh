@@ -1,0 +1,33 @@
+#!/bin/bash
+# Round-3 chain D: runs after chain C drains.
+#   1. Extend the 40x40 frontier run to 120k updates: at 48k it sits at
+#      chance while 26x26 solved at 42k — but 40's episodes are 1.6x
+#      longer, so budget-scaling must be ruled out before calling 40 the
+#      frontier break point (the same extend-once protocol as
+#      mc84_small_cue60).
+#   2. Re-run the flagship plain-catch headline (catch_full2 class) with
+#      n=64 episodes/checkpoint — the last headline curve still quoted
+#      at 16 episodes (round-2 checkpoints left with the container).
+cd /root/repo
+while ! grep -q R3C_CHAIN_ALL_DONE runs/r3c_chain.log 2>/dev/null; do sleep 60; done
+
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+run_with_retry python examples/catch_demo.py --out runs/mc_frontier40 \
+  --env memory_catch:16 --size 40 --steps 120000 --mode fused --resume
+echo "=== FRONTIER40_EXT EXIT: $? ==="
+
+run_with_retry python examples/catch_demo.py --out runs/catch_full3 \
+  --full --steps 100000 --mode fused --eval-episodes 4
+echo "=== CATCH_FULL3 EXIT: $? ==="
+
+echo R3D_CHAIN_ALL_DONE

@@ -1,0 +1,39 @@
+#!/bin/bash
+# Round-3 chain E: runs after chain D drains. The 16x16 shaped maze
+# hovered at its random-walk baseline through 30k updates, so this takes
+# the difficulty ladder's next rung down: an 8x8 maze (procmaze_shaped:8,
+# same 64x64x3 obs, same IMPALA preset) where the shaped signal plus a
+# ~4x denser success rate under random play should be learnable — the
+# BASELINE-config-4 positive, measured against ITS OWN random baseline.
+cd /root/repo
+while ! grep -q R3D_CHAIN_ALL_DONE runs/r3d_chain.log 2>/dev/null; do sleep 60; done
+
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+mkdir -p runs/procmaze_small
+python runs/measure_random_baseline.py --env procmaze_shaped:8 --episodes 2048 \
+  --out runs/procmaze_small/baseline.json
+echo "=== PROCMAZE8_BASELINE EXIT: $? ==="
+run_with_retry python -m r2d2_tpu.train --preset procgen_impala --env procmaze_shaped:8 \
+  --mode fused --steps 30000 --updates-per-dispatch 16 \
+  --set checkpoint_dir=runs/procmaze_small/ckpt \
+  --set metrics_path=runs/procmaze_small/metrics.jsonl \
+  --set buffer_capacity=200000 --set learning_starts=30000 \
+  --set samples_per_insert=15.0 --set save_interval=3750 \
+  --set target_net_update_interval=500 --set forward_steps=20 --set num_actors=16
+echo "=== PROCMAZE8 TRAIN EXIT: $? ==="
+python -m r2d2_tpu.evaluate --preset procgen_impala --env procmaze_shaped:8 --episodes 4 \
+  --out runs/procmaze_small/eval.jsonl --plot runs/procmaze_small/curve.jpg \
+  --set checkpoint_dir=runs/procmaze_small/ckpt
+echo "=== PROCMAZE8 EVAL EXIT: $? ==="
+
+echo R3E_CHAIN_ALL_DONE
