@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def bench_one(cfg, B, T, iters=20):
+def bench_one(cfg, B, T, iters=50):
     from r2d2_tpu.models.r2d2 import init_params
 
     net, params = init_params(jax.random.PRNGKey(0), cfg)
@@ -41,14 +41,17 @@ def bench_one(cfg, B, T, iters=20):
     @jax.jit
     def fn(params, obs, la, lr, hid, burn, learn, fwd):
         q, _, _ = net.apply(params, obs, la, lr, hid, burn, learn, fwd)
-        return q
+        # scalar output: the end-of-window sync is one float readback
+        # (np.asarray-style host sync is the only reliable barrier on the
+        # tunneled backend — block_until_ready returns at enqueue there)
+        return jnp.sum(q.astype(jnp.float32))
 
-    out = fn(params, obs, la, lr, hid, burn, learn, fwd)
-    jax.block_until_ready(out)
+    args = (params, obs, la, lr, hid, burn, learn, fwd)
+    float(fn(*args))  # compile + sync
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn(params, obs, la, lr, hid, burn, learn, fwd)
-    jax.block_until_ready(out)
+        out = fn(*args)
+    float(out)  # host readback = device sync
     return (time.perf_counter() - t0) / iters
 
 

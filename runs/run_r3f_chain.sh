@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-3 chain F: runs after chain E drains. The long-context LEARNING
+# experiment, re-aimed by the scale-frontier result: 84x84 memory catch
+# is unlearnable at ANY blind span within these budgets (see PARITY.md
+# frontier table), so a long-context positive must come from the scale
+# the recipe solves. memory_catch:10:12 at 26x26 is mc_mid_main's exact
+# spatial problem (cue 10 of 24 rows, 14 blind rows) stretched 12x in
+# time by the slow fall: 288-step episodes, seq 340 (64 burn-in + 256
+# learning + 20 forward), TWO learning windows per block with window 1
+# replayed from the stored recurrent state across the episode. A
+# positive here shows the long-context machinery (stored-state windows,
+# remat-chunked unroll) HELPING at 4x the reference's sequence length.
+cd /root/repo
+while ! grep -q R3E_CHAIN_ALL_DONE runs/r3e_chain.log 2>/dev/null; do sleep 60; done
+
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+run_with_retry python examples/long_context_demo.py --out runs/long_context_mid \
+  --env memory_catch:10:12 --steps 36000 \
+  --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+  --set hidden_dim=128 --set max_episode_steps=288 \
+  --set learning_steps=256 --set block_length=512 \
+  --set buffer_capacity=102400 --set learning_starts=40000 --set scan_chunk=85
+echo "=== LONG_CONTEXT_MID EXIT: $? ==="
+
+echo R3F_CHAIN_ALL_DONE

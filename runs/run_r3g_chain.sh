@@ -1,0 +1,10 @@
+#!/bin/bash
+# Round-3 chain G: after chain F, re-run the core-unroll microbench with
+# the readback-synced timing (the first pass timed dispatch, not
+# execution — block_until_ready returns at enqueue on the tunneled
+# backend; see bench.py's np.asarray sync idiom).
+cd /root/repo
+while ! grep -q R3F_CHAIN_ALL_DONE runs/r3f_chain.log 2>/dev/null; do sleep 60; done
+python runs/bench_core_unroll.py --out runs/core_unroll.jsonl
+echo "=== CORE_UNROLL2 EXIT: $? ==="
+echo R3G_CHAIN_ALL_DONE
