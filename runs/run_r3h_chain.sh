@@ -1,0 +1,51 @@
+#!/bin/bash
+# Round-3 chain H: after chain G. The LRU core vs the 84x84 memory wall.
+# Every LSTM attack on 84x84 memory catch failed (PARITY.md frontier
+# table) while the LRU solved the 26x26 task 7x faster than the LSTM
+# (runs/mc_mid_lru). Same discriminating-experiment setup as
+# mc84_small_cue60 (cue 60 -> 22 blind steps, mid-scale recipe) with
+# recurrent_core=lru. Learns => the flagship-scale memory positive at
+# the round-2 bar (blind span >= 20, eval >= +0.5), and the zero-state
+# ablation runs at the SAME scale to complete the "done" pair. Fails =>
+# the 40x40 frontier point charts the LRU's own frontier.
+cd /root/repo
+
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+last_eval() { python - "$1" <<'PY'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+print(rows[-1]["mean_reward"] if rows else -9)
+PY
+}
+
+while ! grep -q R3G_CHAIN_ALL_DONE runs/r3g_chain.log 2>/dev/null; do sleep 60; done
+
+run_with_retry python examples/catch_demo.py --out runs/mc84_lru \
+  --env memory_catch:60 --size 84 --steps 60000 --mode fused \
+  --set recurrent_core=lru
+echo "=== MC84_LRU EXIT: $? ==="
+EV=$(last_eval runs/mc84_lru/eval.jsonl)
+echo "=== MC84_LRU EVAL: $EV ==="
+if python -c "import sys; sys.exit(0 if float('$EV') >= 0.5 else 1)"; then
+  run_with_retry python examples/catch_demo.py --out runs/mc84_lru_zerostate \
+    --env memory_catch:60 --size 84 --steps 60000 --mode fused \
+    --set recurrent_core=lru --ablate-zero-state
+  echo "=== MC84_LRU_ZEROSTATE EXIT: $? ==="
+else
+  run_with_retry python examples/catch_demo.py --out runs/mc_frontier40_lru \
+    --env memory_catch:16 --size 40 --steps 48000 --mode fused \
+    --set recurrent_core=lru
+  echo "=== FRONTIER40_LRU EXIT: $? ==="
+fi
+
+echo R3H_CHAIN_ALL_DONE
