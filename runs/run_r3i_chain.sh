@@ -1,0 +1,12 @@
+#!/bin/bash
+# Round-3 chain I: after chain H. Confirmation eval of the 8x8 procmaze
+# positive at higher episode count (16/slot x 16 slots = 256 episodes on
+# the final checkpoint series) to put error bars under the
+# above-baseline claim.
+cd /root/repo
+while ! grep -q R3H_CHAIN_ALL_DONE runs/r3h_chain.log 2>/dev/null; do sleep 60; done
+python -m r2d2_tpu.evaluate --preset procgen_impala --env procmaze_shaped:8 --episodes 16 \
+  --out runs/procmaze_small/eval_n256.jsonl --plot runs/procmaze_small/curve_n256.jpg \
+  --set checkpoint_dir=runs/procmaze_small/ckpt
+echo "=== PROCMAZE8_N256 EXIT: $? ==="
+echo R3I_CHAIN_ALL_DONE
