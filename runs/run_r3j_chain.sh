@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-3 chain J: after chain I. long_context_mid showed the first
+# above-chance long-context signal (-0.19 at 9k, n=32, vs ~-0.9 random)
+# but regressed; the LRU core solved the fast version of the same task
+# 7x faster than the LSTM. Same long-context config, recurrent_core=lru.
+cd /root/repo
+while ! grep -q R3I_CHAIN_ALL_DONE runs/r3i_chain.log 2>/dev/null; do sleep 60; done
+
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+run_with_retry python examples/long_context_demo.py --out runs/long_context_mid_lru \
+  --env memory_catch:10:12 --steps 36000 \
+  --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+  --set hidden_dim=128 --set max_episode_steps=288 \
+  --set learning_steps=256 --set block_length=512 \
+  --set buffer_capacity=102400 --set learning_starts=40000 \
+  --set recurrent_core=lru
+echo "=== LONG_CONTEXT_MID_LRU EXIT: $? ==="
+echo R3J_CHAIN_ALL_DONE
