@@ -29,6 +29,8 @@ SERIES = [
     ("40x40", ("mc_frontier40/eval.jsonl",), "tab:orange"),
     ("52x52", ("mc_frontier52/eval.jsonl",), "tab:red"),
     ("84x84 (cue 60)", ("mc84_small_cue60/eval.jsonl",), "tab:purple"),
+    # the round-3 coda: same 84x84 task, LRU core — solved
+    ("84x84 LRU core (solved)", ("mc84_lru/eval.jsonl",), "tab:blue"),
 ]
 
 
@@ -56,7 +58,7 @@ def main():
     ax.axhline(-1.0, color="gray", lw=0.6, ls="--")
     ax.set_xlabel("updates (thousands)")
     ax.set_ylabel("eval mean reward (ε=0.001)")
-    ax.set_title("Memory catch: same recipe, growing spatial scale")
+    ax.set_title("Memory catch: LSTM recipe vs spatial scale; LRU coda")
     ax.legend(loc="center right", fontsize=8)
     fig.tight_layout()
     fig.savefig(args.out, dpi=130)
