@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 from typing import Optional
 
 import jax
@@ -211,6 +212,11 @@ def evaluate_series(
         with open(out_path, "w") as fh:
             for row in rows:
                 fh.write(json.dumps(row) + "\n")
+    elif out_path and os.path.exists(out_path):
+        print(
+            f"WARNING: no checkpoints evaluated; {out_path} left untouched "
+            "— its contents are from a PREVIOUS eval, not this one"
+        )
     return rows
 
 

@@ -109,3 +109,19 @@ def train_state_shardings(state, mesh: Mesh):
     return jtu.tree_map_with_path(
         lambda p, l: NamedSharding(mesh, spec_for(p, l)), state
     )
+
+
+def tp_probe_kernel(params):
+    """The leaf to assert tp-sharding on, independent of recurrent core.
+
+    With an LSTM core this is the gate kernel `core/wi` — the docstring
+    above calls it the hard case (the scan's per-step h re-gather), so
+    when it exists the checks keep probing it. The LRU core deliberately
+    carries none of the Megatron-annotated names (models/lru.py), so
+    there the probe falls back to the encoder's `Dense_0` kernel, which
+    is COLUMN-parallel under every encoder and every core."""
+    p = params["params"]
+    core = p.get("core", {})
+    if "wi" in core:
+        return core["wi"]
+    return p["enc"]["Dense_0"]["kernel"]

@@ -67,13 +67,19 @@ def test_unroll_split_consistency(lru_setup):
 
 def test_spectral_radius_below_one(lru_setup):
     """|lambda| < 1 by construction (exp(-exp(nu))): a 10x longer unroll
-    from a pure-state start cannot blow up."""
+    from a pure-state start cannot blow up. The guaranteed bound is on the
+    complex MODULUS |h| (elementwise |h_T| = |lambda|^T |h_0| <= |h_0|
+    under zero input); rotation freely trades magnitude between the real
+    and imaginary components, so per-component bounds would be
+    seed-brittle."""
     mod, params, xs, carry = lru_setup
     B, T, D = xs.shape
     long_xs = jnp.zeros((B, 120, D), jnp.float32)
     outs, final = mod.apply(params, long_xs, carry)
     assert np.isfinite(np.asarray(outs)).all()
-    assert np.abs(np.asarray(final[0])).max() <= np.abs(np.asarray(carry[0])).max() + 1e-5
+    mod_final = np.hypot(np.asarray(final[0]), np.asarray(final[1]))
+    mod_carry = np.hypot(np.asarray(carry[0]), np.asarray(carry[1]))
+    assert mod_final.max() <= mod_carry.max() + 1e-5
 
 
 def lru_cfg(**kw):

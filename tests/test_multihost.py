@@ -77,23 +77,17 @@ def test_multihost_store_single_process():
     assert np.isfinite(checksum)
 
 
-def test_two_process_run_matches_single_process():
-    """REAL multi-host: 2 jax.distributed processes (2 CPU devices each)
-    train the same blocks/draws as the single-process 4-device run and
-    must produce the same losses — the whole multi-host stack (local
-    stores, global array assembly, cross-process psum) end to end."""
+def _run_two_process_children(mode: str, timeout: int = 600):
+    """Spawn 2 real jax.distributed CPU children running multihost_child
+    in `mode` and harvest their CHILD_RESULT payloads. Children are
+    killed on any failure path: a hung collective (the SPMD-deadlock
+    class these tests exist to catch) must not leak processes holding
+    the coordinator port into the rest of the pytest session."""
     import json
     import os
+    import socket
     import subprocess
     import sys as _sys
-
-    from multihost_child import build_and_run
-    from r2d2_tpu.parallel.multihost import make_global_mesh
-
-    mesh = make_global_mesh(dp=4, tp=1, devices=jax.devices()[:4])
-    ref_losses, ref_checksum = build_and_run(mesh)
-
-    import socket
 
     with socket.socket() as sock:  # OS-assigned free port, no collisions
         sock.bind(("localhost", 0))
@@ -105,21 +99,41 @@ def test_two_process_run_matches_single_process():
     script = os.path.join(os.path.dirname(__file__), "multihost_child.py")
     procs = [
         subprocess.Popen(
-            [_sys.executable, script, str(pid), "2", str(port)],
+            [_sys.executable, script, str(pid), "2", str(port), mode],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
         for pid in range(2)
     ]
     results = {}
-    for p in procs:
-        out, err = p.communicate(timeout=300)
-        assert p.returncode == 0, f"child failed:\n{out}\n{err[-2000:]}"
-        for line in out.splitlines():
-            if line.startswith("CHILD_RESULT "):
-                r = json.loads(line[len("CHILD_RESULT "):])
-                results[r["pid"]] = r
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, f"child failed:\n{out}\n{err[-2000:]}"
+            for line in out.splitlines():
+                if line.startswith("CHILD_RESULT "):
+                    r = json.loads(line[len("CHILD_RESULT "):])
+                    results[r["pid"]] = r
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     assert set(results) == {0, 1}
-    for r in results.values():
+    return results
+
+
+def test_two_process_run_matches_single_process():
+    """REAL multi-host: 2 jax.distributed processes (2 CPU devices each)
+    train the same blocks/draws as the single-process 4-device run and
+    must produce the same losses — the whole multi-host stack (local
+    stores, global array assembly, cross-process psum) end to end."""
+    from multihost_child import build_and_run
+    from r2d2_tpu.parallel.multihost import make_global_mesh
+
+    mesh = make_global_mesh(dp=4, tp=1, devices=jax.devices()[:4])
+    ref_losses, ref_checksum = build_and_run(mesh)
+
+    for r in _run_two_process_children("basic").values():
         np.testing.assert_allclose(r["losses"], ref_losses, atol=1e-4)
         np.testing.assert_allclose(r["checksum"], ref_checksum, rtol=1e-5)
 
@@ -429,6 +443,27 @@ def test_trainer_multihost_plane_k_dispatch(tmp_path):
     trainer.run_inline()
     assert int(trainer.state.step) == 8
     assert trainer.plane.replay._pending is None  # final drain happened
+
+
+def test_two_process_fused_runner_matches_single_process():
+    """REAL multi-host coverage of MultiHostFusedRunner (round-3 verdict
+    item 3): 2 jax.distributed processes drive the fused megastep runner
+    — collective K-update + collection dispatches plus the HOST-LOCAL
+    plumbing (per-shard slot reservation, addressable-piece chunk drain,
+    stamped priority drain, deterministic collect cadence) — and must
+    produce exactly the single-process 4-device run's losses, global env
+    accounting, and tree mass."""
+    from multihost_child import build_and_run_fused
+    from r2d2_tpu.parallel.multihost import make_global_mesh
+
+    mesh = make_global_mesh(dp=4, tp=1, devices=jax.devices()[:4])
+    ref_losses, ref_checksum, ref_steps = build_and_run_fused(mesh)
+    assert all(np.isfinite(l) for l in ref_losses) and ref_steps > 0
+
+    for r in _run_two_process_children("fused").values():
+        np.testing.assert_allclose(r["losses"], ref_losses, atol=1e-4)
+        np.testing.assert_allclose(r["checksum"], ref_checksum, rtol=1e-5)
+        assert r["env_steps"] == ref_steps
 
 
 def test_trainer_multihost_fused_megastep(tmp_path):

@@ -297,5 +297,7 @@ def test_sharded_megastep_tp2_matches_tp1(setup):
             ),
             a, bb,
         )
-    wi = out_2[0].params["params"]["core"]["wi"]
+    from r2d2_tpu.parallel.mesh import tp_probe_kernel
+
+    wi = tp_probe_kernel(out_2[0].params)
     assert wi.sharding.spec[-1] == "tp"

@@ -53,11 +53,14 @@ def test_sharded_plane_end_to_end(tmp_path):
     assert tr.mesh is not None and tr.mesh.shape == {"dp": 4, "tp": 2}
     assert int(tr.state.step) == 10
     assert all(s.tree.total > 0 for s in tr.replay.shards)
-    # tp=2 on the sharded plane is REAL tensor parallelism now: the LSTM
-    # gate kernel keeps its Megatron column sharding through 10 updates
-    # (manual-dp shard_map with the tp axis GSPMD-auto), while the
-    # params stay dp-replicated
-    wi = tr.state.params["params"]["core"]["wi"]
+    # tp=2 on the sharded plane is REAL tensor parallelism now: the
+    # core-agnostic probe kernel (encoder Dense_0 — tp_probe_kernel)
+    # keeps its Megatron column sharding through 10 updates (manual-dp
+    # shard_map with the tp axis GSPMD-auto), while the params stay
+    # dp-replicated
+    from r2d2_tpu.parallel.mesh import tp_probe_kernel
+
+    wi = tp_probe_kernel(tr.state.params)
     assert wi.sharding.spec[-1] == "tp"
     assert all(
         "dp" not in str(l.sharding.spec) for l in jax.tree.leaves(tr.state.params)
@@ -167,7 +170,9 @@ def test_sharded_plane_tp_resume(tmp_path):
         resume=True,
     )
     assert int(resumed.state.step) == 10
-    wi = resumed.state.params["params"]["core"]["wi"]
+    from r2d2_tpu.parallel.mesh import tp_probe_kernel
+
+    wi = tp_probe_kernel(resumed.state.params)
     assert wi.sharding.spec[-1] == "tp", wi.sharding
     for a, b in zip(
         jax.tree.leaves(resumed.state.params), jax.tree.leaves(tr.state.params)
