@@ -213,9 +213,13 @@ def evaluate_series(
             for row in rows:
                 fh.write(json.dumps(row) + "\n")
     elif out_path and os.path.exists(out_path):
+        # stderr: stdout carries only the JSONL rows
+        import sys
+
         print(
             f"WARNING: no checkpoints evaluated; {out_path} left untouched "
-            "— its contents are from a PREVIOUS eval, not this one"
+            "— its contents are from a PREVIOUS eval, not this one",
+            file=sys.stderr,
         )
     return rows
 

@@ -83,9 +83,19 @@ class DeviceBatch(NamedTuple):
 
 
 def make_optimizer(cfg: R2D2Config) -> optax.GradientTransformation:
+    if cfg.lr_schedule == "cosine":
+        # decays over training_steps then HOLDS at lr*lr_final_frac (a
+        # resumed run past the horizon keeps the floor, it does not
+        # re-warm). Position comes from adam's own update count, which
+        # is part of the checkpointed opt_state.
+        lr = optax.cosine_decay_schedule(
+            cfg.lr, max(cfg.training_steps, 1), alpha=cfg.lr_final_frac
+        )
+    else:
+        lr = cfg.lr
     return optax.chain(
         optax.clip_by_global_norm(cfg.grad_norm),
-        optax.adam(cfg.lr, eps=cfg.adam_eps),
+        optax.adam(lr, eps=cfg.adam_eps),
     )
 
 

@@ -1,0 +1,150 @@
+"""Where the LRU's unroll time goes: projection vs scan vs readout.
+
+Round-3 verdict item 8: the readback-synced microbench has the LRU core
+SLOWER per step than the scan-LSTM at trained shapes (0.677 vs 0.534
+us/step/seq at T=1024) despite ~40% fewer matmul FLOPs — so either the
+readout matmuls or the f32 associative scan is the offender, and nobody
+measured which. This times the three pieces of models/lru.py's unroll in
+isolation (same math, raw arrays — see lru.py for the module source of
+truth) plus the whole core, at the trained width (H=512, D=516):
+
+- project_in: (B,T,D) bf16 @ (D,H) x2 -> f32, gamma-scaled  [MXU]
+- scan: associative_scan of the 4-tuple complex affine elements [VPU/HBM:
+  ~log2(T) sweeps over 4 f32 (B,T,H) arrays — the bandwidth suspect]
+- readout: h @ (H,H) x2 + gelu + skip matmul               [MXU]
+
+Prints one JSON line per (T, component). The scan row carrying most of
+the time = the O(log T) depth is real but each sweep pays full HBM
+traffic; the fix would be a chunked formulation (scan across chunk
+boundaries only), not faster matmuls.
+
+    python runs/bench_lru_breakdown.py --out runs/lru_breakdown.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def time_fn(fn, args, iters):
+    out = fn(*args)
+    float(out)  # compile + host readback = the only reliable device sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    float(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--hidden", type=int, default=512)
+    p.add_argument("--in-dim", type=int, default=516,
+                   help="core input width: latent 512 + one-hot A=3 + reward")
+    p.add_argument("--lens", default="512,1024")
+    p.add_argument("--iters", type=int, default=30)
+    args = p.parse_args()
+
+    B, H, D = args.batch, args.hidden, args.in_dim
+    rng = np.random.default_rng(0)
+    dt_c = jnp.bfloat16
+
+    # params mirroring lru.py setup() shapes/scales
+    in_re = jnp.asarray(rng.normal(size=(D, H)).astype(np.float32) / np.sqrt(D), dt_c)
+    in_im = jnp.asarray(rng.normal(size=(D, H)).astype(np.float32) / np.sqrt(D), dt_c)
+    out_re = jnp.asarray(rng.normal(size=(H, H)).astype(np.float32) / np.sqrt(H), dt_c)
+    out_im = jnp.asarray(rng.normal(size=(H, H)).astype(np.float32) / np.sqrt(H), dt_c)
+    skip = jnp.asarray(rng.normal(size=(D, H)).astype(np.float32) / np.sqrt(D), dt_c)
+    mod = jnp.asarray(rng.uniform(0.9, 0.999, H).astype(np.float32))
+    theta = jnp.asarray(rng.uniform(0.0, 6.283, H).astype(np.float32))
+    lam_re = mod * jnp.cos(theta)
+    lam_im = mod * jnp.sin(theta)
+    gamma = jnp.sqrt(1.0 - mod * mod)
+
+    def combine(e1, e2):
+        a1r, a1i, b1r, b1i = e1
+        a2r, a2i, b2r, b2i = e2
+        return (
+            a2r * a1r - a2i * a1i,
+            a2r * a1i + a2i * a1r,
+            a2r * b1r - a2i * b1i + b2r,
+            a2r * b1i + a2i * b1r + b2i,
+        )
+
+    @jax.jit
+    def project_in(xs):
+        u_re = (xs @ in_re).astype(jnp.float32) * gamma
+        u_im = (xs @ in_im).astype(jnp.float32) * gamma
+        return jnp.sum(u_re) + jnp.sum(u_im)
+
+    @jax.jit
+    def scan_only(u_re, u_im):
+        shape = u_re.shape
+        a_re = jnp.broadcast_to(lam_re, shape)
+        a_im = jnp.broadcast_to(lam_im, shape)
+        A_re, A_im, B_re, B_im = jax.lax.associative_scan(
+            combine, (a_re, a_im, u_re, u_im), axis=1
+        )
+        return jnp.sum(B_re) + jnp.sum(B_im) + jnp.sum(A_re[:, -1]) + jnp.sum(A_im[:, -1])
+
+    @jax.jit
+    def readout(h_re, h_im, xs):
+        y = h_re.astype(dt_c) @ out_re - h_im.astype(dt_c) @ out_im
+        outs = jax.nn.gelu(y) + xs @ skip
+        return jnp.sum(outs.astype(jnp.float32))
+
+    @jax.jit
+    def full(xs):
+        u_re = (xs @ in_re).astype(jnp.float32) * gamma
+        u_im = (xs @ in_im).astype(jnp.float32) * gamma
+        shape = u_re.shape
+        a_re = jnp.broadcast_to(lam_re, shape)
+        a_im = jnp.broadcast_to(lam_im, shape)
+        A_re, A_im, B_re, B_im = jax.lax.associative_scan(
+            combine, (a_re, a_im, u_re, u_im), axis=1
+        )
+        y = B_re.astype(dt_c) @ out_re - B_im.astype(dt_c) @ out_im
+        outs = jax.nn.gelu(y) + xs @ skip
+        return jnp.sum(outs.astype(jnp.float32)) + jnp.sum(A_re[:, -1])
+
+    rows = []
+    for T in [int(x) for x in args.lens.split(",")]:
+        xs = jnp.asarray(rng.normal(size=(B, T, D)).astype(np.float32), dt_c)
+        u_re = jnp.asarray(rng.normal(size=(B, T, H)).astype(np.float32))
+        u_im = jnp.asarray(rng.normal(size=(B, T, H)).astype(np.float32))
+        h_re = jnp.asarray(rng.normal(size=(B, T, H)).astype(np.float32))
+        h_im = jnp.asarray(rng.normal(size=(B, T, H)).astype(np.float32))
+        for name, fn, fargs in (
+            ("project_in", project_in, (xs,)),
+            ("scan", scan_only, (u_re, u_im)),
+            ("readout", readout, (h_re, h_im, xs)),
+            ("full_lru_core", full, (xs,)),
+        ):
+            dt = time_fn(fn, fargs, args.iters)
+            row = {
+                "component": name, "T": T, "B": B, "H": H, "D": D,
+                "ms": round(dt * 1e3, 3),
+                "us_per_step_per_seq": round(dt * 1e6 / T / B, 4),
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    if args.out:
+        with open(args.out, "w") as fh:
+            for row in rows:
+                fh.write(json.dumps(row) + "\n")
+
+
+if __name__ == "__main__":
+    main()

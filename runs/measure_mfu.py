@@ -1,0 +1,195 @@
+"""MFU: what fraction of the chip the learner dispatch actually uses.
+
+Round-3 verdict item 6: BENCH proves the system is fast vs the
+reference's implied rate (17.2x), but never states utilization vs the
+HARDWARE. This measures it for the exact dispatch bench.py's headline
+times — make_fused_multi_train_step (K prioritized double-Q updates in
+one jitted scan) against a synthetically filled HBM replay:
+
+- FLOPs per dispatch from XLA's own cost model
+  (`jitted.lower(...).compile().cost_analysis()["flops"]`) — the
+  compiler's count for the program it actually runs;
+- wall time per dispatch with the readback sync bench.py uses
+  (block_until_ready returns at enqueue on the tunneled backend);
+- MFU = achieved FLOP/s / peak. Peak defaults to 197e12 (TPU v5e
+  bf16 per chip, public spec); override with --peak-tflops.
+
+Also prints an ANALYTIC per-component forward-FLOP table (Nature conv
+trunk layer by layer, recurrent core, dueling heads) so the dominant
+kernel is named, not guessed — the conv trunk's share decides whether
+chasing the encoder (verdict item 7) has headroom.
+
+    python runs/measure_mfu.py --out runs/mfu.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def nature_encoder_flops_per_frame(obs_hw=(84, 84), latent=512):
+    """Analytic MACs*2 for the Nature trunk at VALID padding (the exact
+    geometry of models/encoders.py NatureEncoder; reference model.py:47-57).
+    Returns (total, rows) with one row per layer."""
+    H, W = obs_hw
+    rows = []
+    cin = 1
+    total = 0
+    for name, k, s, cout in (("conv1", 8, 4, 32), ("conv2", 4, 2, 64), ("conv3", 3, 1, 64)):
+        H = (H - k) // s + 1
+        W = (W - k) // s + 1
+        f = H * W * cout * (k * k * cin) * 2
+        rows.append({"layer": name, "out": f"{H}x{W}x{cout}", "mflops_per_frame": round(f / 1e6, 2)})
+        total += f
+        cin = cout
+    dense = H * W * cin * latent * 2
+    rows.append({"layer": "enc_dense", "out": f"{latent}", "mflops_per_frame": round(dense / 1e6, 2)})
+    total += dense
+    return total, rows
+
+
+def core_flops_per_step(cfg):
+    """Matmul MACs*2 per sequence step for the configured recurrent core
+    (elementwise recurrence work excluded — it is bandwidth, not MXU)."""
+    H = cfg.hidden_dim
+    D = H + cfg.action_dim + 1  # concat(latent, one-hot action, reward)
+    if cfg.recurrent_core == "lru":
+        # in_re/in_im (D,H) + out_re/out_im (H,H) + skip (D,H)
+        return 2 * (2 * D * H + 2 * H * H + D * H)
+    # LSTM: wi (D,4H) + wh (H,4H)
+    return 2 * (D + H) * 4 * H
+
+
+def heads_flops_per_step(cfg):
+    H, A = cfg.hidden_dim, cfg.action_dim
+    return 2 * (H * H + H * H + H * A + H)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None)
+    p.add_argument("--K", type=int, default=16)
+    p.add_argument("--seconds", type=float, default=15.0)
+    p.add_argument("--peak-tflops", type=float, default=197.0,
+                   help="chip peak dense TFLOP/s for the MFU denominator "
+                        "(197 = TPU v5e bf16)")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes + 2s window: plumbing check on CPU "
+                        "(the MFU number itself is meaningless off-chip)")
+    args = p.parse_args()
+
+    from bench import synth_block
+    from r2d2_tpu.config import default_atari
+    from r2d2_tpu.learner import init_train_state, make_fused_multi_train_step
+    from r2d2_tpu.replay.device_store import DeviceReplayBuffer
+
+    cfg = default_atari().replace(
+        compute_dtype="bfloat16", buffer_capacity=100_000,
+    )
+    if args.smoke:
+        cfg = cfg.replace(
+            obs_shape=(84, 84, 1), batch_size=4, buffer_capacity=8_000,
+            learning_starts=2_000, num_actors=2,
+        )
+        args.K = min(args.K, 2)
+        args.seconds = min(args.seconds, 2.0)
+    K = args.K
+    rng = np.random.default_rng(0)
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})", file=sys.stderr)
+
+    replay = DeviceReplayBuffer(cfg)
+    for _ in range(cfg.learning_starts // cfg.block_length + 5):
+        replay.add_block(
+            synth_block(cfg, rng),
+            rng.uniform(0.5, 2.0, size=cfg.seqs_per_block).astype(np.float32),
+            None,
+        )
+    assert replay.can_sample()
+
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    multi_step = make_fused_multi_train_step(cfg, net, K, donate=False)
+    sample_rng = np.random.default_rng(1)
+    draws = [replay.sample_indices(sample_rng) for _ in range(K)]
+    b = jax.device_put(np.stack([d.b for d in draws]))
+    s = jax.device_put(np.stack([d.s for d in draws]))
+    w = jax.device_put(np.stack([d.is_weights for d in draws]))
+
+    # XLA's own FLOP count for the compiled dispatch
+    lowered = multi_step.lower(state, replay.stores, b, s, w)
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    xla_flops_per_dispatch = float(ca.get("flops", float("nan")))
+
+    # timed window (state NOT donated so the same args re-dispatch)
+    out = multi_step(state, replay.stores, b, s, w)
+    _ = int(np.asarray(out[0].step))  # compile+sync
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < args.seconds:
+        out = multi_step(state, replay.stores, b, s, w)
+        n += 1
+    _ = int(np.asarray(out[0].step))
+    elapsed = time.perf_counter() - t0
+
+    dispatches_per_s = n / elapsed
+    updates_per_s = dispatches_per_s * K
+    achieved = xla_flops_per_dispatch * dispatches_per_s
+    peak = args.peak_tflops * 1e12
+    mfu = achieved / peak
+
+    # analytic forward breakdown: where the FLOPs are, per net evaluation
+    enc_total, enc_rows = nature_encoder_flops_per_frame(
+        cfg.obs_shape[:2], cfg.hidden_dim
+    )
+    core = core_flops_per_step(cfg)
+    heads = heads_flops_per_step(cfg)
+    per_step = enc_total + core + heads
+    breakdown = enc_rows + [
+        {"layer": f"core_{cfg.recurrent_core}", "mflops_per_frame": round(core / 1e6, 2)},
+        {"layer": "dueling_heads", "mflops_per_frame": round(heads / 1e6, 2)},
+    ]
+    for r in breakdown:
+        r["share"] = round(float(r["mflops_per_frame"]) * 1e6 / per_step, 3)
+    dominant = max(breakdown, key=lambda r: r["share"])
+    # 2 full-sequence evals per update (online w/ grad + target fwd-only):
+    # fwd_target + fwd_online + bwd_online(~2x fwd) = 4x one forward
+    analytic_per_update = 4 * cfg.batch_size * cfg.seq_len * per_step
+
+    row = {
+        "metric": "learner_mfu",
+        "updates_per_sec": round(updates_per_s, 2),
+        "xla_flops_per_dispatch": xla_flops_per_dispatch,
+        "achieved_tflops": round(achieved / 1e12, 2),
+        "peak_tflops": args.peak_tflops,
+        "mfu": round(mfu, 4),
+        "analytic_flops_per_update": analytic_per_update,
+        "analytic_vs_xla": round(
+            analytic_per_update * K / xla_flops_per_dispatch, 3
+        ) if np.isfinite(xla_flops_per_dispatch) else None,
+        "dominant_component": dominant["layer"],
+        "forward_breakdown": breakdown,
+        "K": K,
+        "batch": cfg.batch_size,
+        "seq_len": cfg.seq_len,
+        "device": f"{dev.device_kind} ({dev.platform})",
+    }
+    print(json.dumps(row))
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(json.dumps(row) + "\n")
+
+
+if __name__ == "__main__":
+    main()

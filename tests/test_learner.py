@@ -216,3 +216,44 @@ def test_zero_state_replay_ablation_matches_manual_zeroing(cfg):
     # and it differs from the stored-state step (the flag is load-bearing)
     _, m3, _ = make_train_step(cfg, net, donate=False)(state, b)
     assert float(m3["loss"]) != float(m1["loss"])
+
+
+def test_cosine_lr_schedule_decays_updates():
+    """lr_schedule='cosine': the SAME gradient produces a much smaller
+    param step near training_steps than at step 0 (lr_final_frac=0 floors
+    at zero), while the default constant schedule does not; the schedule
+    position rides the checkpointed opt_state count."""
+    import pytest
+
+    from r2d2_tpu.config import tiny_test
+
+    base = tiny_test().replace(training_steps=10, lr_final_frac=0.0)
+    batch = random_batch(base, seed=3)
+
+    def step_sizes(cfg):
+        net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+        step = make_train_step(cfg, net, donate=False)
+        sizes = []
+        for _ in range(10):
+            prev = state.params
+            state, _, _ = step(state, batch)
+            sizes.append(
+                float(
+                    sum(
+                        np.abs(np.asarray(a) - np.asarray(b)).sum()
+                        for a, b in zip(
+                            jax.tree.leaves(state.params), jax.tree.leaves(prev)
+                        )
+                    )
+                )
+            )
+        return sizes
+
+    cos = step_sizes(base.replace(lr_schedule="cosine"))
+    const = step_sizes(base)
+    # cosine: final step ~cos^2(pi/2 * 9.5/10) of the first; constant: flat
+    assert cos[-1] < 0.05 * cos[0], (cos[0], cos[-1])
+    assert const[-1] > 0.3 * const[0], (const[0], const[-1])
+
+    with pytest.raises(ValueError, match="lr_schedule"):
+        tiny_test().replace(lr_schedule="warmup")

@@ -361,7 +361,7 @@ def test_sharded_step_tp2_matches_single_device():
         ref_state.params,
     )
     # the tp shardings survive the update (donated in, sharded out);
-    # probe the core-agnostic leaf (encoder Dense_0), not the LSTM path
+    # core-agnostic probe (LSTM wi when present, encoder Dense_0 under lru)
     from r2d2_tpu.parallel.mesh import tp_probe_kernel
 
     wi = tp_probe_kernel(new_state.params)
