@@ -133,6 +133,12 @@ class R2D2Config:
     # core). Both share the (B, 2, H) stored-state contract, so replay /
     # burn-in / zero-state machinery is identical.
     recurrent_core: str = "lstm"
+    # lru only: > 0 switches the unroll from one associative scan
+    # (bandwidth-bound: ~log2 T full sweeps over four f32 (B,T,H)
+    # arrays) to per-chunk causal triangular matmuls on the MXU with a
+    # T/chunk carry scan — same math, different summation order
+    # (models/lru.py LRU.chunk). 0 keeps the scan.
+    lru_chunk: int = 0
 
     # --- infra ------------------------------------------------------------
     seed: int = 0
@@ -226,6 +232,13 @@ class R2D2Config:
             raise ValueError(f"unknown lstm_backend {self.lstm_backend!r}")
         if self.recurrent_core not in ("lstm", "lru"):
             raise ValueError(f"unknown recurrent_core {self.recurrent_core!r}")
+        if self.lru_chunk < 0:
+            raise ValueError("lru_chunk must be >= 0")
+        if self.lru_chunk > 0 and self.recurrent_core != "lru":
+            raise ValueError(
+                "lru_chunk is the LRU core's unroll formulation; set "
+                "recurrent_core='lru' (or leave lru_chunk=0)"
+            )
         if self.lr_schedule not in ("constant", "cosine"):
             raise ValueError(f"unknown lr_schedule {self.lr_schedule!r}")
         if not 0.0 <= self.lr_final_frac <= 1.0:

@@ -81,6 +81,17 @@ class LRU(nn.Module):
     r_min: float = 0.9          # eigenvalue ring: slowest-forgetting init
     r_max: float = 0.999
     max_phase: float = 6.283    # full circle of rotation frequencies
+    # chunk > 0: the MXU formulation of the same recurrence. The plain
+    # associative_scan is O(log T) DEPTH but each of its ~log2(T) sweeps
+    # reads+writes four f32 (B, T, H) arrays — HBM bandwidth, the
+    # measured reason the core trails scan-LSTM per step at trained
+    # shapes (runs/lru_breakdown.jsonl). With chunking, the within-chunk
+    # prefix B_t = sum_{s<=t} lambda^(t-s) u_s becomes a causal
+    # triangular matmul against precomputed lambda powers (per-feature
+    # (C, C, H) operator — batched GEMMs on the MXU), and only the
+    # Nc = T/C chunk-final states go through a sequential carry scan.
+    # Same math, same params, different summation order (f32 throughout).
+    chunk: int = 0
 
     def setup(self):
         H, D = self.hidden_dim, self.in_dim
@@ -94,12 +105,17 @@ class LRU(nn.Module):
         self.out_im = self.param("out_im", _uniform_init(s_h), (H, H))
         self.skip = self.param("skip", _uniform_init(s_in), (D, H))
 
+    def _polar(self):
+        """(|lambda|, arg lambda) — the ONE place the parameterization
+        exp(-exp(nu_log)) / exp(theta_log) is spelled out; both unroll
+        formulations derive from it."""
+        return jnp.exp(-jnp.exp(self.nu_log)), jnp.exp(self.theta_log)
+
     def _decay(self):
         """lambda = exp(-exp(nu_log) + i exp(theta_log)), |lambda| < 1 by
         construction; gamma = sqrt(1 - |lambda|^2) normalizes the input so
         the state variance is O(1) at every decay rate."""
-        mod = jnp.exp(-jnp.exp(self.nu_log))
-        theta = jnp.exp(self.theta_log)
+        mod, theta = self._polar()
         lam_re = mod * jnp.cos(theta)
         lam_im = mod * jnp.sin(theta)
         gamma = jnp.sqrt(jnp.maximum(1.0 - mod * mod, 1e-8))
@@ -121,19 +137,15 @@ class LRU(nn.Module):
         y = hr @ self.out_re.astype(self.dtype) - hi @ self.out_im.astype(self.dtype)
         return nn.gelu(y) + xs.astype(self.dtype) @ self.skip.astype(self.dtype)
 
-    def __call__(self, xs: jnp.ndarray, carry: Carry) -> Tuple[jnp.ndarray, Carry]:
-        """Time-parallel unroll over (B, T, D) from carry via ONE
-        associative scan; returns ((B, T, H), final carry)."""
-        B, T, _ = xs.shape
-        lam_re, lam_im, gamma = self._decay()
-        u_re, u_im = self._project_in(xs, gamma)  # (B, T, H) f32
-
-        # elements (a, b) of the recurrence h_t = a_t h_{t-1} + b_t with
-        # a_t = lambda (constant), combined under
-        #   (a1,b1) o (a2,b2) = (a2 a1, a2 b1 + b2)
-        # the scan's prefix (A_t, B_t) satisfies h_t = A_t h0 + B_t
-        a_re = jnp.broadcast_to(lam_re, (B, T, self.hidden_dim))
-        a_im = jnp.broadcast_to(lam_im, (B, T, self.hidden_dim))
+    def _scan_states(self, u_re, u_im, carry):
+        """All T states via ONE associative scan: elements (a, b) of the
+        recurrence h_t = a_t h_{t-1} + b_t with a_t = lambda (constant),
+        combined under (a1,b1) o (a2,b2) = (a2 a1, a2 b1 + b2); the
+        scan's prefix (A_t, B_t) satisfies h_t = A_t h0 + B_t."""
+        B, T, H = u_re.shape
+        lam_re, lam_im, _ = self._decay()
+        a_re = jnp.broadcast_to(lam_re, (B, T, H))
+        a_im = jnp.broadcast_to(lam_im, (B, T, H))
 
         def combine(e1, e2):
             a1r, a1i, b1r, b1i = e1
@@ -147,12 +159,85 @@ class LRU(nn.Module):
         A_re, A_im, B_re, B_im = jax.lax.associative_scan(
             combine, (a_re, a_im, u_re, u_im), axis=1
         )
-        h0_re, h0_im = carry
-        h0_re = h0_re.astype(jnp.float32)[:, None]
-        h0_im = h0_im.astype(jnp.float32)[:, None]
+        h0_re = carry[0].astype(jnp.float32)[:, None]
+        h0_im = carry[1].astype(jnp.float32)[:, None]
         h_re = A_re * h0_re - A_im * h0_im + B_re
         h_im = A_re * h0_im + A_im * h0_re + B_im
+        return h_re, h_im
 
+    def _chunked_states(self, u_re, u_im, carry):
+        """All T states via per-chunk causal triangular matmuls (MXU)
+        plus a length-T/C carry scan — the `chunk` docstring's
+        formulation. T is zero-padded up to a chunk multiple (padded
+        tail sliced off; zero inputs after T never reach a kept state)."""
+        C = self.chunk
+        B, T, H = u_re.shape
+        pad = (C - T % C) % C
+        if pad:
+            u_re = jnp.pad(u_re, ((0, 0), (0, pad), (0, 0)))
+            u_im = jnp.pad(u_im, ((0, 0), (0, pad), (0, 0)))
+        Nc = (T + pad) // C
+
+        # lambda^d for d = 0..C in polar form (elementwise per feature)
+        mod, theta = self._polar()
+        d = jnp.arange(C + 1, dtype=jnp.float32)[:, None]
+        P_re = (mod**d) * jnp.cos(theta * d)  # (C+1, H)
+        P_im = (mod**d) * jnp.sin(theta * d)
+        i = jnp.arange(C)
+        dm = i[:, None] - i[None, :]
+        causal = dm >= 0
+        dm = jnp.where(causal, dm, 0)
+        T_re = jnp.where(causal[:, :, None], P_re[dm], 0.0)  # (C, C, H)
+        T_im = jnp.where(causal[:, :, None], P_im[dm], 0.0)
+
+        ur = u_re.reshape(B, Nc, C, H)
+        ui = u_im.reshape(B, Nc, C, H)
+        # within-chunk prefix W_t = sum_{s<=t} lambda^(t-s) u_s, complex
+        # product spelled out over (re, im): 4 batched GEMMs over H
+        Wr = jnp.einsum("tsh,bnsh->bnth", T_re, ur) - jnp.einsum(
+            "tsh,bnsh->bnth", T_im, ui
+        )
+        Wi = jnp.einsum("tsh,bnsh->bnth", T_re, ui) + jnp.einsum(
+            "tsh,bnsh->bnth", T_im, ur
+        )
+
+        # cross-chunk carries: c_n = lambda^C c_{n-1} + W_last_n, scanned
+        # over the Nc chunk-final states only; emit the carry INTO chunk n
+        lamC_re, lamC_im = P_re[C], P_im[C]
+
+        def body(c, w):
+            cr, ci = c
+            wr, wi = w
+            nr = lamC_re * cr - lamC_im * ci + wr
+            ni = lamC_re * ci + lamC_im * cr + wi
+            return (nr, ni), (cr, ci)
+
+        h0 = (carry[0].astype(jnp.float32), carry[1].astype(jnp.float32))
+        _, (pr, pi) = jax.lax.scan(
+            body, h0,
+            (jnp.moveaxis(Wr[:, :, -1], 1, 0), jnp.moveaxis(Wi[:, :, -1], 1, 0)),
+        )
+        # h at offset t of chunk n: W_t + lambda^(t+1) * carry_in(n)
+        Q_re, Q_im = P_re[1:], P_im[1:]  # (C, H)
+        pr = jnp.moveaxis(pr, 0, 1)[:, :, None]  # (B, Nc, 1, H)
+        pi = jnp.moveaxis(pi, 0, 1)[:, :, None]
+        hr = Wr + Q_re[None, None] * pr - Q_im[None, None] * pi
+        hi = Wi + Q_re[None, None] * pi + Q_im[None, None] * pr
+        return (
+            hr.reshape(B, T + pad, H)[:, :T],
+            hi.reshape(B, T + pad, H)[:, :T],
+        )
+
+    def __call__(self, xs: jnp.ndarray, carry: Carry) -> Tuple[jnp.ndarray, Carry]:
+        """Time-parallel unroll over (B, T, D) from carry; returns
+        ((B, T, H), final carry). chunk selects the formulation (same
+        math): 0 = one associative scan, > 0 = chunked MXU matmuls."""
+        _, _, gamma = self._decay()
+        u_re, u_im = self._project_in(xs, gamma)  # (B, T, H) f32
+        if self.chunk > 0:
+            h_re, h_im = self._chunked_states(u_re, u_im, carry)
+        else:
+            h_re, h_im = self._scan_states(u_re, u_im, carry)
         outs = self._readout(h_re, h_im, xs)
         return outs, (h_re[:, -1], h_im[:, -1])
 

@@ -56,6 +56,7 @@ class R2D2Network(nn.Module):
     lstm_backend: str = "auto"
     # "lstm" (reference parity) or "lru" (models/lru.py time-parallel core)
     recurrent_core: str = "lstm"
+    lru_chunk: int = 0  # lru unroll formulation, see config.lru_chunk
 
     @classmethod
     def from_config(cls, cfg: R2D2Config) -> "R2D2Network":
@@ -76,6 +77,7 @@ class R2D2Network(nn.Module):
             scan_chunk=cfg.scan_chunk,
             lstm_backend=backend,
             recurrent_core=cfg.recurrent_core,
+            lru_chunk=cfg.lru_chunk,
         )
 
     def setup(self):
@@ -84,7 +86,10 @@ class R2D2Network(nn.Module):
         # core input = concat(latent, one-hot action, reward) (model.py:59)
         core_in = self.hidden_dim + self.action_dim + 1
         if self.recurrent_core == "lru":
-            self.core = LRU(self.hidden_dim, in_dim=core_in, dtype=dtype)
+            self.core = LRU(
+                self.hidden_dim, in_dim=core_in, dtype=dtype,
+                chunk=self.lru_chunk,
+            )
         elif self.recurrent_core == "lstm":
             self.core = LSTM(
                 self.hidden_dim,

@@ -78,6 +78,7 @@ def main():
             ("lstm-pallas", dict(recurrent_core="lstm", lstm_backend="pallas")),
             ("lstm-scan", dict(recurrent_core="lstm", lstm_backend="scan")),
             ("lru", dict(recurrent_core="lru")),
+            ("lru-c128", dict(recurrent_core="lru", lru_chunk=128)),
         ):
             cfg = R2D2Config(**base, **extra).validate()
             try:

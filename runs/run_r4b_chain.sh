@@ -42,6 +42,8 @@ python runs/measure_mfu.py --out runs/mfu.json
 echo "=== MFU EXIT: $? ==="
 python runs/bench_lru_breakdown.py --out runs/lru_breakdown.jsonl
 echo "=== LRU_BREAKDOWN EXIT: $? ==="
+python runs/bench_core_unroll.py --out runs/core_unroll_r4.jsonl
+echo "=== CORE_UNROLL_R4 EXIT: $? ==="
 
 run_with_retry python examples/long_context_demo.py --out runs/long_context_mid_lru2 \
   --env memory_catch:10:12 --steps 36000 --eval-episodes 4 \
