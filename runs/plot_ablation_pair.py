@@ -1,0 +1,65 @@
+"""Render a stored-state vs zero-state ablation pair as one figure.
+
+Generic two-series comparison (the memory_ablation_midscale.jpg shape):
+main run's eval series vs its zero-state ablation on the same axes, with
+the chance band annotated. Works for any pair of eval.jsonl files.
+
+  python runs/plot_ablation_pair.py \
+      --main runs/mc84_full_lru/eval.jsonl \
+      --ablation runs/mc84_full_lru_zerostate/eval.jsonl \
+      --title "84x84 memory catch, Nature/512 + LRU" \
+      --out runs/memory_ablation_fullnet.jpg
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+
+
+def load(path):
+    with open(path) as fh:
+        rows = [json.loads(l) for l in fh if l.strip()]
+    return [r["step"] for r in rows], [r["mean_reward"] for r in rows], rows
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--main", required=True)
+    p.add_argument("--ablation", required=True)
+    p.add_argument("--title", default="stored-state vs zero-state replay")
+    p.add_argument("--chance", type=float, default=None,
+                   help="chance-level mean reward to annotate (default: "
+                        "the ablation series' first value)")
+    p.add_argument("--out", required=True)
+    args = p.parse_args()
+
+    xs_m, ys_m, rows_m = load(args.main)
+    xs_a, ys_a, _ = load(args.ablation)
+    n = rows_m[-1].get("episodes")
+
+    fig, ax = plt.subplots(figsize=(7, 4.2))
+    ax.plot(xs_m, ys_m, "o-", color="tab:green",
+            label="stored state + burn-in (R2D2 recipe)")
+    ax.plot(xs_a, ys_a, "s--", color="tab:red",
+            label="zero-state replay ablation")
+    chance = args.chance if args.chance is not None else ys_a[0]
+    ax.axhline(chance, color="gray", lw=0.8, ls=":",
+               label=f"chance ≈ {chance:.2f}")
+    ax.set_xlabel("learner updates")
+    ax.set_ylabel(f"eval mean reward (ε=0.001{f', n={n}' if n else ''})")
+    ax.set_title(args.title)
+    ax.legend(loc="best", fontsize=8)
+    ax.grid(alpha=0.25)
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=140)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Round-4 chain C: measurement leftovers, after chain B drains.
+# - measure_mfu.py was wedged in chain B by the tunneled backend's AOT
+#   compile/cost RPC; the fixed script reads the pre-compile cost model
+#   in a CPU-pinned child and times the dispatch via the plain jit path.
+# - bench_core_unroll re-run gains the lru-c128 chunked-MXU row (the
+#   in-flight chain B script predated the insertion; bash reads scripts
+#   lazily, so the edit was skipped — never edit a running script).
+cd /root/repo
+while ! grep -q R4B_CHAIN_ALL_DONE runs/r4b_chain.log 2>/dev/null; do sleep 60; done
+
+python runs/measure_mfu.py --out runs/mfu.json
+echo "=== MFU EXIT: $? ==="
+python runs/bench_core_unroll.py --out runs/core_unroll_r4.jsonl
+echo "=== CORE_UNROLL_R4 EXIT: $? ==="
+
+echo R4C_CHAIN_ALL_DONE
