@@ -1,0 +1,56 @@
+#!/bin/bash
+# Round-4 chain D: the long-context BUDGET attack, after chain C.
+# Episode accounting across the three long_context_mid runs: 36k updates
+# over 288-step episodes sees ~17k episodes — 13x fewer than the ~230k
+# episodes the solved fast-task runs consumed (same spatial task, 24-step
+# episodes). Every n=64 checkpoint of the cosine-lr run sits above
+# chance (-0.28..-0.75 vs ~-0.9) without breaking out, which reads as
+# under-trained, not unstable. This arm runs 4x the budget (144k
+# updates, cosine horizon matched) with the otherwise-best-known recipe
+# (lru core, sync 250). Solves (>= +0.5) => run the zero-state control
+# at the same budget: window 1 of each block replays from the stored
+# state, so the ablation isolates exactly the long-context machinery.
+cd /root/repo
+while ! grep -q R4C_CHAIN_ALL_DONE runs/r4c_chain.log 2>/dev/null; do sleep 60; done
+
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+last_eval() { python - "$1" <<'PY'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+print(rows[-1]["mean_reward"] if rows else -9)
+PY
+}
+
+run_with_retry python examples/long_context_demo.py --out runs/long_context_mid_lru4 \
+  --env memory_catch:10:12 --steps 144000 --eval-episodes 4 \
+  --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+  --set hidden_dim=128 --set max_episode_steps=288 \
+  --set learning_steps=256 --set block_length=512 \
+  --set buffer_capacity=102400 --set learning_starts=40000 \
+  --set recurrent_core=lru --set lr_schedule=cosine
+echo "=== LONG_CONTEXT_MID_LRU4 EXIT: $? ==="
+EV=$(last_eval runs/long_context_mid_lru4/eval.jsonl)
+echo "=== LONG_CONTEXT_MID_LRU4 EVAL: $EV ==="
+if python -c "import sys; sys.exit(0 if float('$EV') >= 0.5 else 1)"; then
+  run_with_retry python examples/long_context_demo.py --out runs/long_context_mid_lru4_zs \
+    --env memory_catch:10:12 --steps 144000 --eval-episodes 4 \
+    --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+    --set hidden_dim=128 --set max_episode_steps=288 \
+    --set learning_steps=256 --set block_length=512 \
+    --set buffer_capacity=102400 --set learning_starts=40000 \
+    --set recurrent_core=lru --set lr_schedule=cosine \
+    --ablate-zero-state
+  echo "=== LONG_CONTEXT_MID_LRU4_ZS EXIT: $? ==="
+fi
+
+echo R4D_CHAIN_ALL_DONE
