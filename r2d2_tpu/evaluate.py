@@ -269,17 +269,67 @@ def main(argv=None):
                    help="override any R2D2Config field (repeatable, typed "
                         "by the field — must match the training run, e.g. "
                         "--set checkpoint_dir=runs/x/ckpt)")
+    p.add_argument("--evaluator", default="auto",
+                   choices=["auto", "host", "device"],
+                   help="host: vec-env rollout with one device round trip "
+                        "per step (works for any env). device: the jitted "
+                        "collector runs policy + env dynamics + episode "
+                        "accounting in one dispatch per chunk — pure-JAX "
+                        "envs only, ~two orders of magnitude fewer host "
+                        "syncs at long horizons. auto picks device when "
+                        "the env has a functional core")
     args = p.parse_args(argv)
     cfg = PRESETS[args.preset]()
     if args.env:
         cfg = cfg.replace(env_name=args.env)
     if args.set:
         cfg = cfg.replace(**parse_overrides(args.set))
-    vec_env = build_vec_env(cfg, seed=123)
-    cfg = cfg.replace(action_dim=vec_env.action_dim)
-    rows = evaluate_series(
-        cfg, vec_env, out_path=args.out, episodes_per_slot=args.episodes
-    )
+
+    fn_env = None
+    if args.evaluator in ("auto", "device"):
+        try:
+            from r2d2_tpu.train import build_fn_env
+
+            fn_env = build_fn_env(cfg)
+        except ValueError:
+            if args.evaluator == "device":
+                raise
+        if fn_env is not None and args.evaluator == "auto":
+            # the device evaluator truncates episodes at the collector's
+            # chunk length (partial returns) — auto must not silently
+            # change mean_reward semantics for long-episode envs; pass
+            # --evaluator device to accept the truncation knowingly
+            from r2d2_tpu.collect import default_chunk_len
+
+            if cfg.max_episode_steps > default_chunk_len(cfg):
+                fn_env = None
+    if fn_env is not None:
+        num_envs = 16  # device eval slots; 'episodes' rows annotate this
+        cfg = cfg.replace(action_dim=fn_env.NUM_ACTIONS)
+        collect_cache = {}
+
+        def reward_fn(net, params):
+            # evaluate_series passes the net it built; compile the eval
+            # collect fn once on first call
+            if "fn" not in collect_cache:
+                collect_cache["fn"] = make_eval_collect_fn(
+                    cfg, net, fn_env, num_envs=num_envs
+                )
+            return evaluate_params_device(
+                cfg, net, params, fn_env, num_envs=num_envs, seed=123,
+                collect_fn=collect_cache["fn"], episodes_per_slot=args.episodes,
+            )
+
+        rows = evaluate_series(
+            cfg, None, out_path=args.out, reward_fn=reward_fn,
+            episodes_per_checkpoint=num_envs * args.episodes,
+        )
+    else:
+        vec_env = build_vec_env(cfg, seed=123)
+        cfg = cfg.replace(action_dim=vec_env.action_dim)
+        rows = evaluate_series(
+            cfg, vec_env, out_path=args.out, episodes_per_slot=args.episodes
+        )
     if args.plot and rows:
         plot_series(rows, args.plot)
 
