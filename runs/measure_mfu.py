@@ -120,6 +120,7 @@ def main():
     dev = jax.devices()[0]
     print(f"device: {dev.device_kind} ({dev.platform})", file=sys.stderr)
 
+    print("filling replay...", file=sys.stderr, flush=True)
     replay = DeviceReplayBuffer(cfg)
     for _ in range(cfg.learning_starts // cfg.block_length + 5):
         replay.add_block(
@@ -128,6 +129,7 @@ def main():
             None,
         )
     assert replay.can_sample()
+    print("replay filled", file=sys.stderr, flush=True)
 
     net, state = init_train_state(cfg, jax.random.PRNGKey(0))
     multi_step = make_fused_multi_train_step(cfg, net, K, donate=False)
@@ -173,8 +175,10 @@ def main():
     xla_flops_per_dispatch = xla_flops_per_update * K
 
     # timed window (state NOT donated so the same args re-dispatch)
+    print("compiling timed dispatch...", file=sys.stderr, flush=True)
     out = multi_step(state, replay.stores, b, s, w)
     _ = int(np.asarray(out[0].step))  # compile+sync
+    print("compiled; timing...", file=sys.stderr, flush=True)
     n = 0
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < args.seconds:

@@ -1,0 +1,54 @@
+#!/bin/bash
+# Round-4 chain E: the corrected procmaze ladder, after chain D.
+# Chain B's 12x12 rung was structurally impossible: ProcMaze renders its
+# grid into the fixed 64x64 obs and 64 % 12 != 0 (envs/procmaze.py
+# raises at construction). The ladder's real rungs are 8 -> 16
+# (64 = 8*8 = 16*4). So: re-run the 8x8 confirmation eval at n=256
+# through the device evaluator (chain B's host-driven attempt was cut),
+# then warm-start 16x16 from the solved 8x8 policy (the transfer pattern
+# the round-3 verdict prescribed), 30k fresh updates, eval at n=64
+# against the 16x16 random baseline measured in round 3
+# (runs/procmaze_shaped/baseline.json: 0.137 mean shaped reward).
+cd /root/repo
+while ! grep -q R4D_CHAIN_ALL_DONE runs/r4d_chain.log 2>/dev/null; do sleep 60; done
+
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+python -m r2d2_tpu.evaluate --preset procgen_impala --env procmaze_shaped:8 \
+  --episodes 16 --evaluator device \
+  --out runs/procmaze_small/eval_n256.jsonl \
+  --plot runs/procmaze_small/curve_n256.jpg \
+  --set checkpoint_dir=runs/procmaze_small/ckpt
+echo "=== PROCMAZE8_N256 EXIT: $? ==="
+
+mkdir -p runs/procmaze16_warm/ckpt
+python runs/measure_random_baseline.py --env procmaze_shaped:16 --episodes 2048 \
+  --out runs/procmaze16_warm/baseline.json
+echo "=== PROCMAZE16_BASELINE EXIT: $? ==="
+if [ ! -d runs/procmaze16_warm/ckpt/step_30000 ]; then
+  cp -r runs/procmaze_small/ckpt/step_30000 runs/procmaze16_warm/ckpt/step_30000
+fi
+run_with_retry python -m r2d2_tpu.train --preset procgen_impala --env procmaze_shaped:16 \
+  --mode fused --steps 60000 --updates-per-dispatch 16 --resume \
+  --set checkpoint_dir=runs/procmaze16_warm/ckpt \
+  --set metrics_path=runs/procmaze16_warm/metrics.jsonl \
+  --set buffer_capacity=200000 --set learning_starts=30000 \
+  --set samples_per_insert=15.0 --set save_interval=3750 \
+  --set target_net_update_interval=500 --set forward_steps=20 --set num_actors=16
+echo "=== PROCMAZE16_WARM TRAIN EXIT: $? ==="
+python -m r2d2_tpu.evaluate --preset procgen_impala --env procmaze_shaped:16 \
+  --episodes 4 --evaluator device \
+  --out runs/procmaze16_warm/eval.jsonl --plot runs/procmaze16_warm/curve.jpg \
+  --set checkpoint_dir=runs/procmaze16_warm/ckpt
+echo "=== PROCMAZE16_WARM EVAL EXIT: $? ==="
+
+echo R4E_CHAIN_ALL_DONE

@@ -1,0 +1,12 @@
+#!/bin/bash
+# Round-4 chain F: the MFU measurement, after chain E drains.
+# measure_mfu wedged twice when sharing the tunneled chip with another
+# client; it runs here with the device to itself (progress prints added
+# so any further wedge localizes).
+cd /root/repo
+while ! grep -q R4E_CHAIN_ALL_DONE runs/r4e_chain.log 2>/dev/null; do sleep 60; done
+
+timeout 1200 python runs/measure_mfu.py --out runs/mfu.json
+echo "=== MFU EXIT: $? ==="
+
+echo R4F_CHAIN_ALL_DONE
