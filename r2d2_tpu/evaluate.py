@@ -249,6 +249,31 @@ def plot_series(rows, out_path: str) -> str:
     return out_path
 
 
+def pick_device_eval_env(cfg: R2D2Config, choice: str):
+    """Resolve --evaluator into a functional env for the device path, or
+    None for the host path. "device" demands a functional core (raises
+    otherwise) and accepts chunk-length episode truncation knowingly;
+    "auto" uses the device path only when full episodes fit one collector
+    chunk, so it can NEVER silently change mean_reward semantics from
+    exact full-episode returns to partial ones; "host" always None."""
+    if choice not in ("auto", "device"):
+        return None
+    try:
+        from r2d2_tpu.train import build_fn_env
+
+        fn_env = build_fn_env(cfg)
+    except ValueError:
+        if choice == "device":
+            raise
+        return None
+    if choice == "auto":
+        from r2d2_tpu.collect import default_chunk_len
+
+        if cfg.max_episode_steps > default_chunk_len(cfg):
+            return None
+    return fn_env
+
+
 def main(argv=None):
     from r2d2_tpu.train import build_vec_env
     from r2d2_tpu.utils.compilation_cache import enable_compilation_cache
@@ -285,24 +310,7 @@ def main(argv=None):
     if args.set:
         cfg = cfg.replace(**parse_overrides(args.set))
 
-    fn_env = None
-    if args.evaluator in ("auto", "device"):
-        try:
-            from r2d2_tpu.train import build_fn_env
-
-            fn_env = build_fn_env(cfg)
-        except ValueError:
-            if args.evaluator == "device":
-                raise
-        if fn_env is not None and args.evaluator == "auto":
-            # the device evaluator truncates episodes at the collector's
-            # chunk length (partial returns) — auto must not silently
-            # change mean_reward semantics for long-episode envs; pass
-            # --evaluator device to accept the truncation knowingly
-            from r2d2_tpu.collect import default_chunk_len
-
-            if cfg.max_episode_steps > default_chunk_len(cfg):
-                fn_env = None
+    fn_env = pick_device_eval_env(cfg, args.evaluator)
     if fn_env is not None:
         num_envs = 16  # device eval slots; 'episodes' rows annotate this
         cfg = cfg.replace(action_dim=fn_env.NUM_ACTIONS)

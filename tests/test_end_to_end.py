@@ -324,3 +324,37 @@ def test_evaluate_params_multi_episode_auto_reset():
     # catch episodes pay exactly +-1: a mean over 12 completed episodes
     # must be a multiple of 1/12 (it is NOT guaranteed for partial sums)
     assert abs(r3 * 12 - round(r3 * 12)) < 1e-9
+
+
+def test_pick_device_eval_env_gate():
+    """--evaluator resolution (evaluate.pick_device_eval_env): device for
+    pure-JAX envs whose episodes fit one collector chunk; host fallback
+    (None) when truncation would corrupt full-episode means or the env
+    has no functional core; explicit 'device' raises on the latter."""
+    import pytest
+
+    from r2d2_tpu.collect import default_chunk_len
+    from r2d2_tpu.config import default_atari, long_context, procgen_impala
+    from r2d2_tpu.evaluate import pick_device_eval_env
+
+    cfg = procgen_impala().replace(env_name="procmaze_shaped:8")
+    assert pick_device_eval_env(cfg, "auto") is not None
+    assert pick_device_eval_env(cfg, "host") is None
+
+    # slow-fall episodes (984) exceed the atari chunk (400): auto -> host
+    long_ep = default_atari().replace(
+        env_name="memory_catch:8:12", max_episode_steps=984
+    )
+    assert long_ep.max_episode_steps > default_chunk_len(long_ep)
+    assert pick_device_eval_env(long_ep, "auto") is None
+    assert pick_device_eval_env(long_ep, "device") is not None  # knowing opt-in
+
+    # the long_context preset sizes blocks to hold a full episode: device ok
+    lc = long_context()
+    assert pick_device_eval_env(lc, "auto") is not None
+
+    # no functional core: auto falls back, explicit device raises
+    ale = default_atari()  # env_name MsPacman, host-protocol only
+    assert pick_device_eval_env(ale, "auto") is None
+    with pytest.raises(ValueError):
+        pick_device_eval_env(ale, "device")
