@@ -1,0 +1,71 @@
+"""Render the long-context attack history: every arm on one axes.
+
+BASELINE config 5's task class (memory_catch:10:12 at 26x26 — 288-step
+episodes, seq 340, two learning windows per block, window 1 replayed
+from the stored recurrent state). One line per run:
+
+  lstm (const lr)      runs/long_context_mid       peak -0.19 @ 9k, regresses
+  lru  (const lr)      runs/long_context_mid_lru   peak -0.19 @ 13.5k, regresses
+  lru  (cosine)        runs/long_context_mid_lru2  above chance throughout, no breakout
+  lru  (cosine+sync500)runs/long_context_mid_lru3  same shape as lru2
+  lru  (cosine, 4x budget) runs/long_context_mid_lru4  the budget attack
+
+  python runs/plot_long_context.py --out runs/long_context_attacks.jpg
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+SERIES = [
+    ("LSTM, const lr (36k)", "long_context_mid/eval.jsonl", "tab:gray", "--"),
+    ("LRU, const lr (36k)", "long_context_mid_lru/eval.jsonl", "tab:orange", "--"),
+    ("LRU, cosine lr (36k)", "long_context_mid_lru2/eval.jsonl", "tab:red", "-"),
+    ("LRU, cosine + sync500 (36k)", "long_context_mid_lru3/eval.jsonl", "tab:purple", "-"),
+    ("LRU, cosine lr, 4x budget (144k)", "long_context_mid_lru4/eval.jsonl", "tab:green", "-"),
+]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=os.path.join(HERE, "long_context_attacks.jpg"))
+    p.add_argument("--chance", type=float, default=-0.9,
+                   help="random-policy mean reward (measured ~5%% catch)")
+    args = p.parse_args()
+
+    fig, ax = plt.subplots(figsize=(8, 4.5))
+    for label, rel, color, ls in SERIES:
+        path = os.path.join(HERE, rel)
+        if not os.path.exists(path):
+            print(f"skip {label}: {rel} absent")
+            continue
+        with open(path) as fh:
+            rows = [json.loads(l) for l in fh if l.strip()]
+        ax.plot(
+            [r["step"] for r in rows], [r["mean_reward"] for r in rows],
+            marker="o", ms=3, color=color, ls=ls, label=label,
+        )
+    ax.axhline(args.chance, color="black", lw=0.8, ls=":",
+               label=f"chance ≈ {args.chance}")
+    ax.set_xlabel("learner updates")
+    ax.set_ylabel("eval mean reward (ε=0.001)")
+    ax.set_title("Long-context memory catch (26×26 slow fall, seq 340, "
+                 "window 1 from stored state)")
+    ax.legend(loc="lower right", fontsize=7)
+    ax.grid(alpha=0.25)
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=140)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
