@@ -30,6 +30,10 @@ def main():
     p.add_argument("--platform", default="cpu",
                    help="cpu (default: keeps the TPU free) or leave empty "
                         "for the default backend")
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   help="override any R2D2Config field (repeatable; must "
+                        "match the training run's env geometry, e.g. "
+                        "--set obs_shape=26,26,1 --set max_episode_steps=288)")
     args = p.parse_args()
 
     import jax
@@ -43,6 +47,10 @@ def main():
     from r2d2_tpu.train import build_fn_env
 
     cfg = PRESETS[args.preset]().replace(env_name=args.env)
+    if args.set:
+        from r2d2_tpu.config import parse_overrides
+
+        cfg = cfg.replace(**parse_overrides(args.set))
     env = build_fn_env(cfg)
     N = args.episodes
     horizon = cfg.max_episode_steps

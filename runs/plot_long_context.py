@@ -38,8 +38,12 @@ SERIES = [
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--out", default=os.path.join(HERE, "long_context_attacks.jpg"))
-    p.add_argument("--chance", type=float, default=-0.9,
-                   help="random-policy mean reward (measured ~5%% catch)")
+    p.add_argument("--chance", type=float, default=-0.504,
+                   help="MEASURED random-policy mean reward at the run "
+                        "geometry (long_context_mid/baseline.json, n=2048: "
+                        "24.8%% catch — a random walk has ~270 blind steps "
+                        "to diffuse across 24 columns, so the slow-fall "
+                        "null is far above the fast task's)")
     args = p.parse_args()
 
     fig, ax = plt.subplots(figsize=(8, 4.5))
