@@ -174,16 +174,25 @@ def main():
         print("cost-only child timed out after 900s", file=sys.stderr)
     xla_flops_per_dispatch = xla_flops_per_update * K
 
-    # timed window (state NOT donated so the same args re-dispatch)
+    # timed window (state NOT donated so the same args re-dispatch).
+    # FIXED dispatch count, synced at the end: a wall-clock-bounded loop
+    # without backpressure enqueues free (dispatch returns at enqueue on
+    # this backend) and then drains for minutes — the wedge chains A-C
+    # hit. n is sized from a 3-dispatch calibration to fill ~args.seconds.
     print("compiling timed dispatch...", file=sys.stderr, flush=True)
     out = multi_step(state, replay.stores, b, s, w)
     _ = int(np.asarray(out[0].step))  # compile+sync
-    print("compiled; timing...", file=sys.stderr, flush=True)
-    n = 0
     t0 = time.perf_counter()
-    while time.perf_counter() - t0 < args.seconds:
+    for _ in range(3):
         out = multi_step(state, replay.stores, b, s, w)
-        n += 1
+    _ = int(np.asarray(out[0].step))
+    per = (time.perf_counter() - t0) / 3
+    n = max(int(args.seconds / per), 5)
+    print(f"calibrated {per*1e3:.0f} ms/dispatch; timing {n}...",
+          file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = multi_step(state, replay.stores, b, s, w)
     _ = int(np.asarray(out[0].step))
     elapsed = time.perf_counter() - t0
 
