@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-4 chain G: the TEMPORAL frontier's intermediate rung.
+# The spatial frontier (PARITY table) was charted by holding the recipe
+# and growing resolution; this charts the time axis the same way. The
+# solved fast task (fall_every=1: 24-step episodes, blind 14) and the
+# open slow task (fall_every=12: 288 steps, blind ~270) differ 12x in
+# blind span; fall_every=6 (144-step episodes, blind ~126) sits halfway
+# (log scale) with an almost identical measured random null (-0.516 vs
+# -0.504 — diffusion saturates the 24-column board by ~126 steps). Best
+# known recipe: lru core + cosine lr; window geometry scaled to the
+# episode (two 128-step learning windows per 256-block, window 1 from
+# stored state; seq 212).
+cd /root/repo
+
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+run_with_retry python examples/long_context_demo.py --out runs/long_context_mid6 \
+  --env memory_catch:10:6 --steps 36000 --eval-episodes 4 \
+  --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+  --set hidden_dim=128 --set max_episode_steps=144 \
+  --set learning_steps=128 --set block_length=256 \
+  --set buffer_capacity=102400 --set learning_starts=40000 \
+  --set recurrent_core=lru --set lr_schedule=cosine
+echo "=== LONG_CONTEXT_MID6 EXIT: $? ==="
+
+echo R4G_CHAIN_ALL_DONE

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-4 chain H: the zero-state control for the solved temporal rung.
+# long_context_mid6 reached eval 1.0/0.97/1.0 at its final checkpoints
+# (n=64, measured random -0.516): the first sustained long-context
+# learning positive with the full stored-state machinery (seq 212, two
+# 128-step windows per block, window 1 replayed from stored state,
+# blind span ~126). This arm reruns it with zero-state replay
+# (burn_in=0, window 1 loses the carried cue) at the identical budget —
+# the controlled pair that shows whether the machinery is load-bearing
+# at this memory horizon.
+cd /root/repo
+
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+run_with_retry python examples/long_context_demo.py --out runs/long_context_mid6_zs \
+  --env memory_catch:10:6 --steps 36000 --eval-episodes 4 \
+  --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+  --set hidden_dim=128 --set max_episode_steps=144 \
+  --set learning_steps=128 --set block_length=256 \
+  --set buffer_capacity=102400 --set learning_starts=40000 \
+  --set recurrent_core=lru --set lr_schedule=cosine \
+  --ablate-zero-state
+echo "=== LONG_CONTEXT_MID6_ZS EXIT: $? ==="
+
+echo R4H_CHAIN_ALL_DONE
