@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-4 chain I: tighten the temporal break point. Blind 126
+# (fall_every=6) solves with the stored-state machinery; blind ~270
+# (fall_every=12) does not separate from its null. This rung sits
+# between: memory_catch:10:9 — 216-step episodes, blind ~194, measured
+# random -0.479 (runs/long_context_mid9/baseline.json). Same recipe as
+# the solved rung (lru + cosine, two 128-step windows/block, window 1
+# from stored state; seq 212).
+cd /root/repo
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+run_with_retry python examples/long_context_demo.py --out runs/long_context_mid9 \
+  --env memory_catch:10:9 --steps 36000 --eval-episodes 4 \
+  --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+  --set hidden_dim=128 --set max_episode_steps=216 \
+  --set learning_steps=128 --set block_length=256 \
+  --set buffer_capacity=102400 --set learning_starts=40000 \
+  --set recurrent_core=lru --set lr_schedule=cosine
+echo "=== LONG_CONTEXT_MID9 EXIT: $? ==="
+echo R4I_CHAIN_ALL_DONE

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-4 chain J: the window-length hypothesis on the open rung.
+# Blind 194 (fall_every=9) solves with L=128 windows while blind ~270
+# (fall_every=12) plateaued — but the 12x runs used L=256 windows
+# (seq 340), the only config difference besides the horizon. This run
+# keeps the 288-step task and shrinks the windows to L=128 (block 512 =
+# FOUR windows per block, windows 1-3 replayed from stored state;
+# seq 212). Solves => the open rung's binding factor was WINDOW LENGTH
+# (optimization over 256-step windows), not the memory horizon — and
+# BASELINE config 5's task class is closed at every tested horizon.
+cd /root/repo
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+run_with_retry python examples/long_context_demo.py --out runs/long_context_mid12_L128 \
+  --env memory_catch:10:12 --steps 36000 --eval-episodes 4 \
+  --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+  --set hidden_dim=128 --set max_episode_steps=288 \
+  --set learning_steps=128 --set block_length=512 \
+  --set buffer_capacity=102400 --set learning_starts=40000 \
+  --set recurrent_core=lru --set lr_schedule=cosine
+echo "=== LONG_CONTEXT_MID12_L128 EXIT: $? ==="
+echo R4J_CHAIN_ALL_DONE
