@@ -207,6 +207,7 @@ def main():
         cfg, vec, out_path=os.path.join(args.out, "eval.jsonl"), reward_fn=reward_fn,
         episodes_per_slot=args.eval_episodes,
         episodes_per_checkpoint=16 * args.eval_episodes,
+        evaluator_label="device" if reward_fn else "host",
     )
     if not rows:
         print("no checkpoints to evaluate (steps < save_interval?)")

@@ -105,6 +105,7 @@ def main():
     rows = evaluate_series(
         cfg, None, out_path=os.path.join(args.out, "eval.jsonl"), reward_fn=reward_fn,
         episodes_per_checkpoint=16 * args.eval_episodes,
+        evaluator_label="device",
     )
     if rows:
         plot_series(rows, os.path.join(args.out, "curve.jpg"))

@@ -114,6 +114,7 @@ def evaluate_params_device(
     seed: int = 0,
     collect_fn=None,
     episodes_per_slot: int = 1,
+    return_stats: bool = False,
 ):
     """Device-side evaluation for pure-JAX envs: each of episodes_per_slot
     jitted chunks runs `num_envs` near-greedy episodes (policy + env
@@ -126,7 +127,12 @@ def evaluate_params_device(
 
     Episodes must fit the eval chunk (min(max_episode_steps, block_length),
     the collector's chunk rule): slots still running at the chunk end make
-    the score a partial-return estimate, reported with a warning."""
+    the score a partial-return estimate, reported with a warning.
+
+    return_stats=True additionally returns the truncated-episode count so
+    callers (the series evaluator) can annotate rows — a device-path mean
+    that folds partials in must be distinguishable from the host path's
+    completed-episode accounting in the output JSONL."""
     if collect_fn is None:
         collect_fn = make_eval_collect_fn(cfg, net, fn_env, num_envs)
     eps = jnp.full(num_envs, cfg.test_epsilon, jnp.float32)
@@ -150,7 +156,10 @@ def evaluate_params_device(
             "episodes within block_length for exact device-side eval)",
             stacklevel=2,
         )
-    return float(ep_rewards.mean())
+    mean = float(ep_rewards.mean())
+    if return_stats:
+        return mean, int((~dones).sum())
+    return mean
 
 
 def make_eval_collect_fn(cfg: R2D2Config, net, fn_env, num_envs: int):
@@ -169,15 +178,21 @@ def evaluate_series(
     reward_fn=None,
     episodes_per_slot: int = 1,
     episodes_per_checkpoint: Optional[int] = None,
+    evaluator_label: str = "host",
 ):
     """Reference test.py:14-58 equivalent over the orbax series.
 
-    reward_fn(net, params) -> float overrides the per-checkpoint
-    evaluation (e.g. a device-side evaluator for pure-JAX envs); default
-    is the host vec-env rollout of episodes_per_slot episodes per slot.
+    reward_fn(net, params) overrides the per-checkpoint evaluation (e.g.
+    a device-side evaluator for pure-JAX envs); it returns either a float
+    mean reward or a dict with a "mean_reward" key plus extra row fields
+    (the device path adds "truncated_episodes"). Default is the host
+    vec-env rollout of episodes_per_slot episodes per slot.
     episodes_per_checkpoint annotates each row with the sample size behind
     its mean (defaults to slots x episodes_per_slot when the default
-    evaluator runs; pass it explicitly with reward_fn)."""
+    evaluator runs; pass it explicitly with reward_fn). evaluator_label
+    tags every row ("host"/"device") so host- and device-produced means —
+    which differ in partial-episode accounting — are distinguishable in
+    the output JSONL."""
     net, template = init_train_state(cfg, jax.random.PRNGKey(0))
     policy = make_policy(net)
     if episodes_per_checkpoint is None and vec_env is not None:
@@ -185,8 +200,14 @@ def evaluate_series(
     rows = []
     for step in list_checkpoint_steps(cfg.checkpoint_dir):
         state, env_steps, wall_minutes = restore_checkpoint(cfg.checkpoint_dir, template, step)
+        extra = {}
         if reward_fn is not None:
-            reward = reward_fn(net, state.params)
+            result = reward_fn(net, state.params)
+            if isinstance(result, dict):
+                extra = dict(result)
+                reward = extra.pop("mean_reward")
+            else:
+                reward = result
         else:
             reward = evaluate_params(
                 cfg, net, state.params, vec_env, seed=seed, policy=policy,
@@ -202,6 +223,11 @@ def evaluate_series(
             # must state their episode counts; reference averaged 5 —
             # test.py:18,32)
             "episodes": episodes_per_checkpoint,
+            # which accounting produced the mean: "host" = completed
+            # episodes only; "device" = chunk-truncated partials folded in
+            # (with truncated_episodes reporting how many)
+            "evaluator": evaluator_label,
+            **extra,
         }
         rows.append(row)
         print(json.dumps(row))
@@ -323,14 +349,17 @@ def main(argv=None):
                 collect_cache["fn"] = make_eval_collect_fn(
                     cfg, net, fn_env, num_envs=num_envs
                 )
-            return evaluate_params_device(
+            mean, truncated = evaluate_params_device(
                 cfg, net, params, fn_env, num_envs=num_envs, seed=123,
                 collect_fn=collect_cache["fn"], episodes_per_slot=args.episodes,
+                return_stats=True,
             )
+            return {"mean_reward": mean, "truncated_episodes": truncated}
 
         rows = evaluate_series(
             cfg, None, out_path=args.out, reward_fn=reward_fn,
             episodes_per_checkpoint=num_envs * args.episodes,
+            evaluator_label="device",
         )
     else:
         vec_env = build_vec_env(cfg, seed=123)

@@ -90,7 +90,9 @@ class LRU(nn.Module):
     # triangular matmul against precomputed lambda powers (per-feature
     # (C, C, H) operator — batched GEMMs on the MXU), and only the
     # Nc = T/C chunk-final states go through a sequential carry scan.
-    # Same math, same params, different summation order (f32 throughout).
+    # Same math, same params, different summation order (f32 throughout —
+    # the chunk GEMMs run at Precision.HIGHEST so the MXU does not round
+    # the f32 operands to bf16; see _chunked_states for the cost note).
     chunk: int = 0
 
     def setup(self):
@@ -193,12 +195,19 @@ class LRU(nn.Module):
         ur = u_re.reshape(B, Nc, C, H)
         ui = u_im.reshape(B, Nc, C, H)
         # within-chunk prefix W_t = sum_{s<=t} lambda^(t-s) u_s, complex
-        # product spelled out over (re, im): 4 batched GEMMs over H
-        Wr = jnp.einsum("tsh,bnsh->bnth", T_re, ur) - jnp.einsum(
-            "tsh,bnsh->bnth", T_im, ui
+        # product spelled out over (re, im): 4 batched GEMMs over H.
+        # Precision.HIGHEST: the TPU MXU's default contraction rounds f32
+        # operands to bf16, which would break the module contract (f32
+        # recurrence throughout — long-horizon cumulative products). The
+        # cost is ~3 MXU passes per GEMM instead of 1; accepted, because
+        # correctness of the recurrence is the point of the f32 contract
+        # and the GEMMs are (C, C, H)-small relative to the encoder.
+        hi_p = jax.lax.Precision.HIGHEST
+        Wr = jnp.einsum("tsh,bnsh->bnth", T_re, ur, precision=hi_p) - jnp.einsum(
+            "tsh,bnsh->bnth", T_im, ui, precision=hi_p
         )
-        Wi = jnp.einsum("tsh,bnsh->bnth", T_re, ui) + jnp.einsum(
-            "tsh,bnsh->bnth", T_im, ur
+        Wi = jnp.einsum("tsh,bnsh->bnth", T_re, ui, precision=hi_p) + jnp.einsum(
+            "tsh,bnsh->bnth", T_im, ur, precision=hi_p
         )
 
         # cross-chunk carries: c_n = lambda^C c_{n-1} + W_last_n, scanned

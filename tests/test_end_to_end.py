@@ -237,6 +237,20 @@ def test_device_side_evaluation(trained):
     )
     assert len(rows) == 2 and all(np.isfinite(r["mean_reward"]) for r in rows)
 
+    # device rows must be distinguishable from host ones in the JSONL:
+    # evaluator label + truncated-partial count (ADVICE r4)
+    def reward_fn(net, p):
+        mean, truncated = evaluate_params_device(
+            cfg, net, p, env, num_envs=8, seed=5, collect_fn=fn,
+            return_stats=True)
+        return {"mean_reward": mean, "truncated_episodes": truncated}
+
+    rows = evaluate_series(cfg, None, reward_fn=reward_fn,
+                           evaluator_label="device")
+    assert all(r["evaluator"] == "device" for r in rows)
+    assert all(r["truncated_episodes"] == 0 for r in rows)  # episodes fit
+    assert all(np.isfinite(r["mean_reward"]) for r in rows)
+
 
 def test_samples_per_insert_throttles_collection(tmp_path):
     """With a samples-per-insert target, free-running actors yield once

@@ -54,7 +54,9 @@ def test_sharded_plane_end_to_end(tmp_path):
     assert int(tr.state.step) == 10
     assert all(s.tree.total > 0 for s in tr.replay.shards)
     # tp=2 on the sharded plane is REAL tensor parallelism now: the
-    # core-agnostic probe kernel (encoder Dense_0 — tp_probe_kernel)
+    # core-agnostic probe kernel (tp_probe_kernel — resolves to core/wi
+    # here since tiny_test uses the default LSTM core; it falls back to
+    # enc/Dense_0 only for the LRU core, whose params are tp-replicated)
     # keeps its Megatron column sharding through 10 updates (manual-dp
     # shard_map with the tp axis GSPMD-auto), while the params stay
     # dp-replicated
