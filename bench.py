@@ -63,7 +63,18 @@ def synth_block(cfg, rng: np.random.Generator) -> Block:
     )
 
 
-def _system_cfg(E: int = 256):
+def _core_overrides(core: str, lru_chunk: int) -> dict:
+    """--core/--lru-chunk -> config fields. 'lstm' is the headline default;
+    'lru' selects the time-parallel core (models/lru.py), with lru_chunk>0
+    picking its MXU triangular-matmul formulation — the round-4 MFU
+    verdict's declared lever (runs/core_unroll_r4.jsonl: lru-c128 fastest
+    at T=128, the closest measured row to the bench's T=85)."""
+    if core == "lstm" and lru_chunk:
+        raise SystemExit("--lru-chunk requires --core lru")
+    return {"recurrent_core": core, "lru_chunk": lru_chunk if core == "lru" else 0}
+
+
+def _system_cfg(E: int = 256, core: str = "lstm", lru_chunk: int = 0):
     """Shared full-system benchmark config: catch at Atari resolution
     (84x84, device-rendered; this image has no ALE and one host core —
     SURVEY.md section 2.4), full-size network."""
@@ -72,6 +83,7 @@ def _system_cfg(E: int = 256):
         action_dim=3,
         compute_dtype="bfloat16",
         num_actors=E,
+        **_core_overrides(core, lru_chunk),
         max_episode_steps=82,  # catch: ball lands after height-2 steps
         collector="device",
         replay_plane="device",
@@ -86,7 +98,7 @@ def _system_cfg(E: int = 256):
     )
 
 
-def fused_system_main(collect_every: int = 6):
+def fused_system_main(collect_every: int = 6, core: str = "lstm", lru_chunk: int = 0):
     """Full-system throughput via the fused megastep (megastep.py): ONE
     dispatch = K updates + a collection chunk every collect_every'th
     dispatch. No worker threads — the host only runs sum-tree bookkeeping
@@ -96,7 +108,7 @@ def fused_system_main(collect_every: int = 6):
     from r2d2_tpu.megastep import FusedSystemRunner
     from r2d2_tpu.train import Trainer
 
-    cfg = _system_cfg()
+    cfg = _system_cfg(core=core, lru_chunk=lru_chunk)
     trainer = Trainer(cfg)
     print(f"warmup: filling {cfg.learning_starts} transitions...", file=sys.stderr)
     t0 = time.time()
@@ -144,12 +156,13 @@ def fused_system_main(collect_every: int = 6):
                 "unit": "env_frames/s",
                 "vs_baseline": round(learner_fps / BASELINE_FRAMES_PER_SEC, 3),
                 "concurrent_collection_env_frames_per_sec": round(collect_fps, 1),
+                "core": cfg.recurrent_core + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
             }
         )
     )
 
 
-def system_main():
+def system_main(core: str = "lstm", lru_chunk: int = 0):
     """Full-system throughput: on-device collection (collect.py) and the
     K-update learner dispatch sharing ONE chip concurrently — the complete
     TPU-native R2D2 (actor + replay + learner) with no synthetic data.
@@ -160,7 +173,7 @@ def system_main():
     measured WHILE collection sustains its own rate on the same chip."""
     from r2d2_tpu.train import Trainer
 
-    cfg = _system_cfg()
+    cfg = _system_cfg(core=core, lru_chunk=lru_chunk)
     trainer = Trainer(cfg)
     print(f"warmup: filling {cfg.learning_starts} transitions...", file=sys.stderr)
     t0 = time.time()
@@ -210,6 +223,7 @@ def system_main():
                 "unit": "env_frames/s",
                 "vs_baseline": round(learner_fps / BASELINE_FRAMES_PER_SEC, 3),
                 "concurrent_collection_env_frames_per_sec": round(collect_fps, 1),
+                "core": cfg.recurrent_core + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
             }
         )
     )
@@ -221,14 +235,23 @@ def main(
     metric: str = "learner_env_frames_per_sec_per_chip",
     frame_multiplier: int = 4,
     baseline: float = BASELINE_FRAMES_PER_SEC,
+    core: str = "lstm",
+    lru_chunk: int = 0,
+    batch: int = 0,
 ):
     """frame_multiplier: env frames per env step — 4 for Atari (frameskip,
     reference test.py:28,36), 1 for envs without frameskip. baseline: the
-    denominator for vs_baseline."""
+    denominator for vs_baseline. core/lru_chunk select the recurrent core
+    (_core_overrides); batch > 0 overrides batch_size (the MFU
+    shape-granularity probe — frames/s scales with batch by construction,
+    so cross-batch rows compare updates/s x batch, not the headline)."""
     cfg = cfg or default_atari().replace(
         compute_dtype="bfloat16",
         buffer_capacity=100_000,  # 250 block slots ~= 0.77 GB HBM obs store
+        **_core_overrides(core, lru_chunk),
     )
+    if batch:
+        cfg = cfg.replace(batch_size=batch)
     rng = np.random.default_rng(0)
     dev = jax.devices()[0]
     print(f"device: {dev.device_kind} ({dev.platform})", file=sys.stderr)
@@ -361,6 +384,9 @@ def main(
                 "value": round(frames_per_sec, 1),
                 "unit": "env_frames/s",
                 "vs_baseline": round(frames_per_sec / baseline, 3),
+                "core": cfg.recurrent_core + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
+                "batch": cfg.batch_size,
+                "updates_per_sec": round(updates_per_sec, 2),
             }
         )
     )
@@ -410,12 +436,27 @@ if __name__ == "__main__":
         "--collect-every", type=int, default=6,
         help="fused mode: fold a collection chunk into every Nth dispatch",
     )
+    p.add_argument(
+        "--core", default="lstm", choices=["lstm", "lru"],
+        help="recurrent core for the benched network (learner/system/fused "
+             "modes). lru + --lru-chunk is the time-parallel MXU core",
+    )
+    p.add_argument(
+        "--lru-chunk", type=int, default=0,
+        help="LRU unroll formulation: 0 = associative scan, N > 0 = "
+             "chunked triangular matmuls on the MXU (requires --core lru)",
+    )
+    p.add_argument(
+        "--batch", type=int, default=0,
+        help="learner mode: override batch_size (shape-granularity probe; "
+             "0 = preset default 64)",
+    )
     args = p.parse_args()
     if args.mode == "system":
-        system_main()
+        system_main(args.core, args.lru_chunk)
     elif args.mode == "fused":
-        fused_system_main(args.collect_every)
+        fused_system_main(args.collect_every, args.core, args.lru_chunk)
     elif args.mode == "long_context":
         long_context_main()
     else:
-        main()
+        main(core=args.core, lru_chunk=args.lru_chunk, batch=args.batch)

@@ -67,7 +67,13 @@ def core_flops_per_step(cfg):
     D = H + cfg.action_dim + 1  # concat(latent, one-hot action, reward)
     if cfg.recurrent_core == "lru":
         # in_re/in_im (D,H) + out_re/out_im (H,H) + skip (D,H)
-        return 2 * (2 * D * H + 2 * H * H + D * H)
+        f = 2 * (2 * D * H + 2 * H * H + D * H)
+        if cfg.lru_chunk > 0:
+            # chunked formulation: 4 causal (C,C,H) einsums per chunk =
+            # 4*C*H MACs per step amortized (counting the masked zeros XLA
+            # actually multiplies)
+            f += 2 * 4 * cfg.lru_chunk * H
+        return f
     # LSTM: wi (D,4H) + wh (H,4H)
     return 2 * (D + H) * 4 * H
 
@@ -91,6 +97,12 @@ def main():
     p.add_argument("--cost-only", action="store_true",
                    help="internal: pin CPU, print the per-dispatch FLOP "
                         "count from the pre-compile cost model, exit")
+    p.add_argument("--core", default="lstm", choices=["lstm", "lru"],
+                   help="recurrent core of the measured dispatch")
+    p.add_argument("--lru-chunk", type=int, default=0,
+                   help="LRU formulation: 0 = scan, N > 0 = chunked MXU")
+    p.add_argument("--batch", type=int, default=0,
+                   help="override batch_size (0 = preset default)")
     args = p.parse_args()
 
     if args.cost_only:
@@ -103,7 +115,11 @@ def main():
 
     cfg = default_atari().replace(
         compute_dtype="bfloat16", buffer_capacity=100_000,
+        recurrent_core=args.core,
+        lru_chunk=args.lru_chunk if args.core == "lru" else 0,
     )
+    if args.batch:
+        cfg = cfg.replace(batch_size=args.batch)
     if args.smoke:
         cfg = cfg.replace(
             obs_shape=(84, 84, 1), batch_size=4, buffer_capacity=8_000,
@@ -158,7 +174,9 @@ def main():
     try:
         child = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--cost-only",
-             "--K", "1"] + (["--smoke"] if args.smoke else []),
+             "--K", "1", "--core", args.core,
+             "--lru-chunk", str(args.lru_chunk),
+             "--batch", str(args.batch)] + (["--smoke"] if args.smoke else []),
             capture_output=True, text=True, timeout=900,
         )
         for line in child.stdout.splitlines():
@@ -235,6 +253,7 @@ def main():
         ) if ok else None,
         "dominant_component": dominant["layer"],
         "forward_breakdown": breakdown,
+        "core": cfg.recurrent_core + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
         "K": K,
         "batch": cfg.batch_size,
         "seq_len": cfg.seq_len,
