@@ -139,6 +139,14 @@ class R2D2Config:
     # T/chunk carry scan — same math, different summation order
     # (models/lru.py LRU.chunk). 0 keeps the scan.
     lru_chunk: int = 0
+    # lru only: eigenvalue ring |lambda| ~ U(r_min, r_max) at init — the
+    # memory-horizon dial (time constant ~ 1/(1-r)). The 0.9/0.999
+    # default holds ~10..1000-step memories; push r_min/r_max toward 1
+    # (e.g. 0.98/0.9999) when the task's blind span exceeds ~1000 steps
+    # or when probing whether a plateau is a forgetting problem
+    # (models/lru.py _ring_init).
+    lru_r_min: float = 0.9
+    lru_r_max: float = 0.999
 
     # --- infra ------------------------------------------------------------
     seed: int = 0
@@ -238,6 +246,12 @@ class R2D2Config:
             raise ValueError(
                 "lru_chunk is the LRU core's unroll formulation; set "
                 "recurrent_core='lru' (or leave lru_chunk=0)"
+            )
+        if not 0.0 < self.lru_r_min <= self.lru_r_max < 1.0:
+            raise ValueError(
+                "lru eigenvalue ring needs 0 < lru_r_min <= lru_r_max < 1 "
+                f"(|lambda| < 1 is the stability guarantee), got "
+                f"[{self.lru_r_min}, {self.lru_r_max}]"
             )
         if self.lr_schedule not in ("constant", "cosine"):
             raise ValueError(f"unknown lr_schedule {self.lr_schedule!r}")

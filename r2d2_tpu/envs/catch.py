@@ -66,9 +66,9 @@ def catch_params(name: str) -> dict:
         return {"cue_steps": MEMORY_CATCH_DEFAULT_CUE}
     if n.startswith("memory_catch:"):
         parts = n.split(":")
-        if len(parts) > 3:
+        if len(parts) > 4:
             raise ValueError(
-                f"memory_catch takes at most cue:fall, got {name!r}"
+                f"memory_catch takes at most cue:fall:balls, got {name!r}"
             )
         cue = int(parts[1])
         if cue < 1:
@@ -79,6 +79,11 @@ def catch_params(name: str) -> dict:
             if fall < 1:
                 raise ValueError(f"memory_catch fall interval must be >= 1, got {fall}")
             out["fall_every"] = fall
+        if len(parts) > 3:
+            balls = int(parts[3])
+            if balls < 1:
+                raise ValueError(f"memory_catch balls must be >= 1, got {balls}")
+            out["balls"] = balls
         return out
     raise ValueError(f"not a catch family env name: {name!r}")
 
@@ -99,6 +104,7 @@ class CatchState(NamedTuple):
     paddle_x: jnp.ndarray # int32
     key: jnp.ndarray      # PRNG key
     t: jnp.ndarray        # int32 step counter (drives slow-fall variants)
+    balls_left: jnp.ndarray  # int32 landings remaining incl. current ball
 
 
 class CatchEnv:
@@ -114,6 +120,7 @@ class CatchEnv:
         ball_size: int = 3,
         cue_steps: Optional[int] = None,
         fall_every: int = 1,
+        balls: int = 1,
     ):
         self.h, self.w = height, width
         self.pw = paddle_width
@@ -131,6 +138,18 @@ class CatchEnv:
         if fall_every < 1:
             raise ValueError(f"fall_every must be >= 1, got {fall_every}")
         self.fall = fall_every
+        # multi-ball variant ("memory_catch:K:F:N"): the episode runs N
+        # landings — each landing pays its reward and (before the last)
+        # respawns a fresh ball with its own cue + blind phase, paddle
+        # position carried over. Episode length N*(h-2)*fall: segments
+        # whose cue falls in one learning window and whose landing falls
+        # in the next make stored-state replay load-bearing at the
+        # long_context preset's two-512-step-window block geometry
+        # (config.long_context; the reference's stored-state recipe —
+        # worker.py:574,640-647 — stretched far past its 80-step windows)
+        if balls < 1:
+            raise ValueError(f"balls must be >= 1, got {balls}")
+        self.balls = balls
 
     def reset(self, key: jax.Array) -> CatchState:
         key, kx, kp = jax.random.split(key, 3)
@@ -148,7 +167,10 @@ class CatchEnv:
             hi = jnp.minimum(ball_x + reach, self.w - 1)
             paddle_x = jax.random.randint(kp, (), lo, hi + 1)
         zero = jnp.zeros((), jnp.int32)
-        return CatchState(ball_x, zero, paddle_x, key, zero)
+        return CatchState(
+            ball_x, zero, paddle_x, key, zero,
+            jnp.full((), self.balls, jnp.int32),
+        )
 
     def render(self, s: CatchState) -> jnp.ndarray:
         """(H, W, 1) uint8 frame: ball block + paddle strip at 255. With
@@ -178,18 +200,50 @@ class CatchEnv:
             ball_y = s.ball_y + 1
         else:
             ball_y = s.ball_y + jnp.where(t % self.fall == 0, 1, 0)
-        done = ball_y >= self.h - 2
+        landed = ball_y >= self.h - 2
         caught = jnp.abs(s.ball_x - paddle_x) <= self.pw // 2
-        reward = jnp.where(done, jnp.where(caught, 1.0, -1.0), 0.0)
-        return CatchState(s.ball_x, ball_y, paddle_x, s.key, t), reward, done
+        reward = jnp.where(landed, jnp.where(caught, 1.0, -1.0), 0.0)
+        if self.balls == 1:
+            # single-ball program unchanged (static branch keeps compiled
+            # HLO identical to before the multi-ball variant existed)
+            return CatchState(s.ball_x, ball_y, paddle_x, s.key, t, s.balls_left), reward, landed
+        # multi-ball: a landing before the last pays out and respawns a
+        # fresh ball (own cue + blind phase; t rewinds to 0 so the fall
+        # cadence restarts cleanly), keeping the paddle where it stands.
+        # The respawn column mirrors reset's catchability cap, anchored at
+        # the CURRENT paddle: uniform over the columns the paddle can
+        # still reach during the new ball's blind phase.
+        balls_left = s.balls_left - jnp.where(landed, 1, 0).astype(jnp.int32)
+        done = landed & (balls_left <= 0)
+        key, kx = jax.random.split(s.key)
+        if self.cue is None:
+            new_x = jax.random.randint(kx, (), 0, self.w)
+        else:
+            reach = max(2 * (self.h - 2 - self.cue) * self.fall - 4, 1)
+            lo = jnp.maximum(paddle_x - reach, 0)
+            hi = jnp.minimum(paddle_x + reach, self.w - 1)
+            new_x = jax.random.randint(kx, (), lo, hi + 1)
+        respawn = landed & ~done
+        zero = jnp.zeros((), jnp.int32)
+        nxt = CatchState(
+            jnp.where(respawn, new_x, s.ball_x),
+            jnp.where(respawn, zero, ball_y),
+            paddle_x,
+            jnp.where(respawn, key, s.key),
+            jnp.where(respawn, zero, t),
+            balls_left,
+        )
+        return nxt, reward, done
 
 
 @functools.lru_cache(maxsize=None)
-def _host_fns(height: int, width: int, cue_steps: Optional[int], fall_every: int):
+def _host_fns(height: int, width: int, cue_steps: Optional[int], fall_every: int,
+              balls: int):
     """Jitted reset/step/render shared by every CatchHostEnv of the same
     geometry — a pool of N envs compiles each computation once, not N
     times."""
-    env = CatchEnv(height, width, cue_steps=cue_steps, fall_every=fall_every)
+    env = CatchEnv(height, width, cue_steps=cue_steps, fall_every=fall_every,
+                   balls=balls)
     return jax.jit(env.reset), jax.jit(env.step), jax.jit(env.render)
 
 
@@ -200,14 +254,15 @@ class CatchHostEnv:
 
     def __init__(
         self, height: int = 84, width: int = 84, seed: int = 0,
-        cue_steps: Optional[int] = None, fall_every: int = 1,
+        cue_steps: Optional[int] = None, fall_every: int = 1, balls: int = 1,
     ):
-        self.env = CatchEnv(height, width, cue_steps=cue_steps, fall_every=fall_every)
+        self.env = CatchEnv(height, width, cue_steps=cue_steps,
+                            fall_every=fall_every, balls=balls)
         self.action_dim = CatchEnv.NUM_ACTIONS
         self.obs_shape = (height, width, 1)
         self._key = jax.random.PRNGKey(seed)
         self._reset, self._step, self._render = _host_fns(
-            height, width, cue_steps, fall_every
+            height, width, cue_steps, fall_every, balls
         )
         self._state = None
 
@@ -229,9 +284,10 @@ class CatchVecEnv:
 
     def __init__(
         self, num_envs: int = 1, height: int = 84, width: int = 84, seed: int = 0,
-        cue_steps: Optional[int] = None, fall_every: int = 1,
+        cue_steps: Optional[int] = None, fall_every: int = 1, balls: int = 1,
     ):
-        self.env = CatchEnv(height, width, cue_steps=cue_steps, fall_every=fall_every)
+        self.env = CatchEnv(height, width, cue_steps=cue_steps,
+                            fall_every=fall_every, balls=balls)
         self.num_envs = num_envs
         self.action_dim = CatchEnv.NUM_ACTIONS
         self.obs_shape = (height, width, 1)

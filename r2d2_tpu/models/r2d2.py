@@ -57,6 +57,8 @@ class R2D2Network(nn.Module):
     # "lstm" (reference parity) or "lru" (models/lru.py time-parallel core)
     recurrent_core: str = "lstm"
     lru_chunk: int = 0  # lru unroll formulation, see config.lru_chunk
+    lru_r_min: float = 0.9   # lru eigenvalue ring, see config.lru_r_min
+    lru_r_max: float = 0.999
 
     @classmethod
     def from_config(cls, cfg: R2D2Config) -> "R2D2Network":
@@ -78,6 +80,8 @@ class R2D2Network(nn.Module):
             lstm_backend=backend,
             recurrent_core=cfg.recurrent_core,
             lru_chunk=cfg.lru_chunk,
+            lru_r_min=cfg.lru_r_min,
+            lru_r_max=cfg.lru_r_max,
         )
 
     def setup(self):
@@ -89,6 +93,7 @@ class R2D2Network(nn.Module):
             self.core = LRU(
                 self.hidden_dim, in_dim=core_in, dtype=dtype,
                 chunk=self.lru_chunk,
+                r_min=self.lru_r_min, r_max=self.lru_r_max,
             )
         elif self.recurrent_core == "lstm":
             self.core = LSTM(

@@ -1,0 +1,206 @@
+"""Linear probe: does the carried recurrent state still KNOW the cue?
+
+VERDICT r4 item 3: the blind-270 rung (`long_context_mid12*`) fails to
+learn across seven recipe arms, and the standing diagnosis — "memory
+horizon" — is by elimination only. This settles it by direct
+measurement: run the trained policy, snapshot the recurrent carry at
+fixed depths of the blind fall (just-blinded / mid-blind / end-of-blind,
+i.e. the step before the ball lands), and fit a multinomial logistic
+probe from the carry to the episode's cue column (ball_x).
+
+  state decodes ball_x at end-of-blind  => memory is INTACT, the failure
+                                           is credit assignment;
+  decoding decays to chance over depth  => the state FORGETS — a memory-
+                                           horizon failure (and the LRU
+                                           eigenvalue ring r_min/r_max,
+                                           config.lru_r_min, is the
+                                           designed dial to attack it).
+
+Run on a plateau checkpoint of the failing rung, with the SOLVED blind-194
+rung (`long_context_mid9`) as the positive control (its probe must read
+near-1.0 at end-of-blind, validating the instrument).
+
+Reference analogue: the stored-state recipe this frontier stresses
+(reference worker.py:574,640-647) — the reference never measures state
+content; this is the TPU repo's own evidence tooling.
+
+    python runs/probe_state.py --run runs/long_context_mid9 --step 36000 \
+        --env memory_catch:10:9 --out runs/long_context_mid9/probe.jsonl \
+        --set obs_shape=26,26,1 --set encoder=impala ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def collect_carries(cfg, net, params, env_name: str, num_envs: int, seed: int):
+    """One episode per env slot; snapshot the carry at three blind depths.
+
+    Returns (labels ball_x (E,), {milestone_name: (E, 2H) f32}, meta)."""
+    from r2d2_tpu.envs.catch import CatchVecEnv, catch_params
+
+    pk = catch_params(env_name)
+    h = cfg.obs_shape[0]
+    cue = pk.get("cue_steps", 0)
+    vec = CatchVecEnv(num_envs=num_envs, height=h, width=h, seed=seed, **pk)
+    E = num_envs
+    act = jax.jit(lambda p, o, la, lr, c: net.apply(p, o, la, lr, c, method=net.act))
+
+    obs = vec.reset_all()
+    labels = np.asarray(vec._state.ball_x).copy()
+    carry = (
+        jnp.zeros((E, cfg.hidden_dim), jnp.float32),
+        jnp.zeros((E, cfg.hidden_dim), jnp.float32),
+    )
+    last_action = np.zeros(E, np.int32)
+    last_reward = np.zeros(E, np.float32)
+    rng = np.random.default_rng(seed + 1)
+
+    # milestones by ball row: first row with the ball invisible, the
+    # middle of the blind fall, and the last row before landing
+    rows = {
+        "just_blinded": cue,
+        "mid_blind": cue + (h - 2 - cue) // 2,
+        "end_of_blind": h - 3,
+    }
+    snaps = {m: np.zeros((E, 2 * cfg.hidden_dim), np.float32) for m in rows}
+    captured = {m: np.zeros(E, bool) for m in rows}
+    finished = np.zeros(E, bool)
+    returns = np.zeros(E, np.float32)
+
+    for _ in range(cfg.max_episode_steps + 2):
+        q, carry = act(params, jnp.asarray(obs), jnp.asarray(last_action),
+                       jnp.asarray(last_reward), carry)
+        greedy = np.asarray(q).argmax(1)
+        explore = rng.random(E) < cfg.test_epsilon
+        actions = np.where(explore, rng.integers(0, cfg.action_dim, E), greedy)
+        actions = actions.astype(np.int32)
+        term_obs, rewards, dones, next_obs = vec.step(actions)
+        returns += np.where(finished, 0.0, rewards).astype(np.float32)
+        ball_y = np.asarray(vec._state.ball_y)
+        flat = np.concatenate([np.asarray(carry[0]), np.asarray(carry[1])], axis=1)
+        for m, row in rows.items():
+            newly = (ball_y >= row) & ~captured[m] & ~finished
+            # a done this step means the pre-landing carry was the LAST
+            # chance for end_of_blind; dones with uncaptured milestones
+            # take the current carry too (ball_y resets on auto-respawn)
+            newly |= dones & ~captured[m] & ~finished
+            snaps[m][newly] = flat[newly]
+            captured[m][newly] = True
+        finished |= dones
+        if finished.all():
+            break
+        obs = next_obs
+        d = jnp.asarray(dones)
+        carry = tuple(jnp.where(d[:, None], 0.0, c) for c in carry)
+        last_action = np.where(dones, 0, actions).astype(np.int32)
+        last_reward = np.where(dones, 0.0, rewards).astype(np.float32)
+
+    ok = finished & np.all([captured[m] for m in rows], axis=0)
+    meta = {"episodes": int(ok.sum()), "mean_reward": float(returns[ok].mean())}
+    return labels[ok], {m: s[ok] for m, s in snaps.items()}, rows, meta
+
+
+def fit_probe(X: np.ndarray, y: np.ndarray, seed: int = 0, reach: int = 3):
+    """Multinomial logistic probe, 70/30 split. Returns (test_acc,
+    within-reach acc, mean |column error|, shuffled-label control acc,
+    n_classes). within-reach counts predictions within `reach` columns —
+    the paddle half-width, i.e. "the state still holds enough to CATCH"
+    (exact-column accuracy is stricter than the task demands)."""
+    from sklearn.linear_model import LogisticRegression
+
+    rng = np.random.default_rng(seed)
+    n = len(y)
+    order = rng.permutation(n)
+    cut = int(n * 0.7)
+    tr, te = order[:cut], order[cut:]
+    # standardize on train stats (the carry's per-feature scales differ)
+    mu, sd = X[tr].mean(0), X[tr].std(0) + 1e-6
+    Xs = (X - mu) / sd
+
+    def fit(labels):
+        clf = LogisticRegression(max_iter=2000, C=1.0)
+        clf.fit(Xs[tr], labels[tr])
+        return clf.predict(Xs[te]), labels[te]
+
+    pred, true = fit(y)
+    err = np.abs(pred.astype(int) - true.astype(int))
+    shuffled = y.copy()
+    rng.shuffle(shuffled)
+    spred, strue = fit(shuffled)
+    shuf_acc = float((spred == strue).mean())
+    return (
+        float((err == 0).mean()),
+        float((err <= reach).mean()),
+        float(err.mean()),
+        shuf_acc,
+        int(len(np.unique(y))),
+    )
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--run", required=True, help="run dir with ckpt/")
+    p.add_argument("--step", type=int, required=True)
+    p.add_argument("--env", required=True, help="catch-family env name")
+    p.add_argument("--envs", type=int, default=512)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--out", default=None)
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   help="config overrides — must match the training run")
+    args = p.parse_args()
+
+    from r2d2_tpu.config import long_context, parse_overrides
+    from r2d2_tpu.learner import init_train_state
+    from r2d2_tpu.utils.checkpoint import restore_checkpoint
+    from r2d2_tpu.utils.compilation_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    # mirror examples/long_context_demo.py's config construction so the
+    # restored template matches the training run's param tree
+    cfg = long_context(args.env)
+    cfg = cfg.replace(checkpoint_dir=os.path.join(args.run, "ckpt"))
+    if args.set:
+        cfg = cfg.replace(**parse_overrides(args.set))
+
+    net, template = init_train_state(cfg, jax.random.PRNGKey(0))
+    state, env_steps, _ = restore_checkpoint(cfg.checkpoint_dir, template, args.step)
+    labels, snaps, rows, meta = collect_carries(
+        cfg, net, state.params, args.env, args.envs, args.seed
+    )
+    print(f"collected {meta['episodes']} episodes "
+          f"(mean reward {meta['mean_reward']:.3f})", file=sys.stderr)
+
+    out_rows = []
+    for m, row in rows.items():
+        acc, catchable, mean_err, shuf, ncls = fit_probe(
+            snaps[m], labels, seed=args.seed
+        )
+        out_rows.append({
+            "run": args.run, "step": args.step, "milestone": m,
+            "ball_row": int(row), "test_acc": round(acc, 4),
+            "within_paddle_acc": round(catchable, 4),
+            "mean_col_err": round(mean_err, 2),
+            "shuffled_acc": round(shuf, 4), "n_classes": ncls,
+            "episodes": meta["episodes"],
+            "policy_mean_reward": round(meta["mean_reward"], 4),
+        })
+        print(json.dumps(out_rows[-1]))
+    if args.out:
+        with open(args.out, "w") as fh:
+            for r in out_rows:
+                fh.write(json.dumps(r) + "\n")
+
+
+if __name__ == "__main__":
+    main()

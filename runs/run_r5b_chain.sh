@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-5 chain B: attack the blind-270 rung with the probe-backed lever,
+# and localize the temporal break (VERDICT r4 items 3 and 8).
+#
+# The linear probe (runs/probe_state.py) settled the blind-270 diagnosis
+# DIRECTLY: the cue is perfectly encoded at blinding (within-paddle-reach
+# decode 1.0 on both the solved blind-194 rung and the failing blind-270
+# rung) and decays over the blind fall — by end-of-blind the failing
+# rung's state supports a catch only 53% of the time (mean column error
+# 5.2) while the solved rung holds 100% (0.28). The state FORGETS: a
+# memory-horizon failure, not credit assignment.
+#
+# 1) The designed counter: widen the LRU eigenvalue ring from the default
+#    U(0.9, 0.999) (time constants ~10..1000 steps, most mass far below
+#    the 270-step horizon) to U(0.98, 0.9999) (~50..10000) — exactly the
+#    dial models/lru.py documents for this case (config.lru_r_min).
+# 2+3) The two rungs between solved-194 and failing-270 (fall_every 10,
+#    11 => blind ~216, ~243), same recipe as the solved mid9, to localize
+#    the break to one rung — each verdict against its own measured
+#    random-walk null (baseline.json, CPU-measured).
+cd /root/repo
+while ! grep -q R5A_CHAIN_ALL_DONE runs/r5a_chain.log 2>/dev/null; do sleep 60; done
+
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+run_with_retry python examples/long_context_demo.py --out runs/long_context_mid12_ring \
+  --env memory_catch:10:12 --steps 36000 --eval-episodes 4 \
+  --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+  --set hidden_dim=128 --set max_episode_steps=288 \
+  --set learning_steps=128 --set block_length=512 \
+  --set buffer_capacity=102400 --set learning_starts=40000 \
+  --set recurrent_core=lru --set lr_schedule=cosine \
+  --set lru_r_min=0.98 --set lru_r_max=0.9999
+echo "=== LONG_CONTEXT_MID12_RING EXIT: $? ==="
+
+run_with_retry python examples/long_context_demo.py --out runs/long_context_mid10 \
+  --env memory_catch:10:10 --steps 36000 --eval-episodes 4 \
+  --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+  --set hidden_dim=128 --set max_episode_steps=240 \
+  --set learning_steps=128 --set block_length=512 \
+  --set buffer_capacity=102400 --set learning_starts=40000 \
+  --set recurrent_core=lru --set lr_schedule=cosine
+echo "=== LONG_CONTEXT_MID10 EXIT: $? ==="
+
+run_with_retry python examples/long_context_demo.py --out runs/long_context_mid11 \
+  --env memory_catch:10:11 --steps 36000 --eval-episodes 4 \
+  --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+  --set hidden_dim=128 --set max_episode_steps=264 \
+  --set learning_steps=128 --set block_length=512 \
+  --set buffer_capacity=102400 --set learning_starts=40000 \
+  --set recurrent_core=lru --set lr_schedule=cosine
+echo "=== LONG_CONTEXT_MID11 EXIT: $? ==="
+
+echo R5B_CHAIN_ALL_DONE

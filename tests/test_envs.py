@@ -210,3 +210,53 @@ def test_slow_fall_memory_catch():
     cfg = long_context()
     assert cfg.env_name == "memory_catch:8:12"
     assert cfg.seqs_per_block == 2  # two 512-step windows per block
+
+
+def test_multi_ball_memory_catch():
+    """Multi-ball variant ("memory_catch:K:F:N"): N landings per episode,
+    each paying its own reward and respawning a fresh ball (own cue +
+    blind phase, paddle carried over, fall cadence restarted); done only
+    on the Nth landing. Single-ball (N=1) keeps the old program."""
+    from r2d2_tpu.envs.catch import catch_params
+
+    assert catch_params("memory_catch:10:8:4") == {
+        "cue_steps": 10, "fall_every": 8, "balls": 4}
+
+    env = CatchEnv(height=12, width=12, paddle_width=3, cue_steps=2,
+                   fall_every=3, balls=3)
+    s = env.reset(jax.random.PRNGKey(11))
+    assert int(s.balls_left) == 3
+    steps = 0
+    landings = 0
+    total = 0.0
+    done = False
+    while not done:
+        a = jnp.where(s.ball_x < s.paddle_x, 1, jnp.where(s.ball_x > s.paddle_x, 2, 0))
+        prev_left = int(s.balls_left)
+        s, r, done = env.step(s, a)
+        steps += 1
+        total += float(r)
+        if int(s.balls_left) < prev_left or done:
+            landings += 1
+            if not done:
+                # respawn: fresh ball at the top, cue phase restarted
+                assert int(s.ball_y) == 0 and int(s.t) == 0
+                assert float(r) != 0.0
+    assert landings == 3
+    assert steps == 3 * (12 - 2) * 3  # N * (h-2) * fall
+    assert total == 3.0  # greedy tracker catches every ball
+
+    # respawn columns stay within blind-phase paddle reach: every episode
+    # remains fully catchable (the reward ceiling is +N)
+    env2 = CatchEnv(height=12, width=12, paddle_width=3, cue_steps=8,
+                    fall_every=1, balls=2)
+    for seed in range(6):
+        s = env2.reset(jax.random.PRNGKey(seed))
+        done = False
+        total = 0.0
+        while not done:
+            a = jnp.where(s.ball_x < s.paddle_x, 1,
+                          jnp.where(s.ball_x > s.paddle_x, 2, 0))
+            s, r, done = env2.step(s, a)
+            total += float(r)
+        assert total == 2.0, f"seed {seed}: episode not fully catchable"

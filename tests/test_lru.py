@@ -199,3 +199,25 @@ def test_trainer_end_to_end_lru(tmp_path):
     tr = Trainer(cfg)
     tr.run_inline()
     assert int(tr.state.step) == 6
+
+
+def test_ring_init_config_fields():
+    """lru_r_min/lru_r_max reach _ring_init: |lambda| = exp(-exp(nu_log))
+    lands inside the configured ring, and a slower ring yields strictly
+    larger moduli (the memory-horizon dial, VERDICT r4 item 3)."""
+    from r2d2_tpu.config import R2D2Config
+
+    def moduli(r_min, r_max):
+        cfg = lru_cfg(lru_r_min=r_min, lru_r_max=r_max)
+        _, state = init_train_state(cfg, jax.random.PRNGKey(3))
+        nu = np.asarray(state.params["params"]["core"]["nu_log"])
+        return np.exp(-np.exp(nu))
+
+    m_default = moduli(0.9, 0.999)
+    assert (m_default >= 0.9 - 1e-6).all() and (m_default <= 0.999 + 1e-6).all()
+    m_slow = moduli(0.98, 0.9999)
+    assert (m_slow >= 0.98 - 1e-6).all() and (m_slow <= 0.9999 + 1e-6).all()
+    assert m_slow.min() > m_default.min()
+
+    with pytest.raises(ValueError, match="eigenvalue ring"):
+        tiny_test().replace(recurrent_core="lru", lru_r_min=0.99, lru_r_max=0.9)
