@@ -409,6 +409,19 @@ def long_context_main():
         compute_dtype="bfloat16",
         batch_size=32,  # 32 x 581 frames/update fits HBM alongside the store
         buffer_capacity=102_400,  # 200 slots x 512 ~= 0.8 GB obs store
+        # pin the benched shapes to the config-5 spec (84x84 Nature/512,
+        # seq 581) regardless of what game/geometry the preset's DEFAULT
+        # currently targets — the bench row must stay comparable across
+        # rounds even as the preset's default task moves with the
+        # learning-evidence frontier
+        obs_shape=(84, 84, 1),
+        encoder="nature",
+        hidden_dim=512,
+        burn_in_steps=64,
+        learning_steps=512,
+        forward_steps=5,
+        block_length=1024,
+        max_episode_steps=984,
     )
     main(
         cfg,
