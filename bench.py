@@ -392,7 +392,7 @@ def main(
     )
 
 
-def long_context_main():
+def long_context_main(core: str = "lstm", lru_chunk: int = 0):
     """Stretch configuration (BASELINE.json config 5): seq_len = 64 burn-in
     + 512 learning + 5 forward = 581 per sequence — at batch 32, ~3.4x the
     frame volume per update of the reference shape (32 x 581 vs 64 x 85).
@@ -422,6 +422,7 @@ def long_context_main():
         forward_steps=5,
         block_length=1024,
         max_episode_steps=984,
+        **_core_overrides(core, lru_chunk),
     )
     main(
         cfg,
@@ -480,6 +481,6 @@ if __name__ == "__main__":
     elif args.mode == "fused":
         fused_system_main(args.collect_every, args.core, args.lru_chunk)
     elif args.mode == "long_context":
-        long_context_main()
+        long_context_main(args.core, args.lru_chunk)
     else:
         main(core=args.core, lru_chunk=args.lru_chunk, batch=args.batch)

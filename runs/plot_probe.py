@@ -1,0 +1,51 @@
+"""Figure: linear-probe decode quality vs blind depth (probe_state.py).
+
+One panel per metric (exact-column accuracy; within-paddle-reach
+accuracy), one line per run — the solved rung's state holds the cue to
+the end of the blind fall, the failing rung's decays. The picture behind
+the round-5 memory-horizon verdict.
+
+    python runs/plot_probe.py --out runs/probe_decay.jpg \
+        runs/long_context_mid9/probe.jsonl runs/long_context_mid12_L128/probe.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("probes", nargs="+", help="probe.jsonl paths")
+    p.add_argument("--out", default="runs/probe_decay.jpg")
+    args = p.parse_args()
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(11, 4), sharex=True)
+    for path in args.probes:
+        rows = [json.loads(l) for l in open(path) if l.strip()]
+        label = os.path.basename(os.path.dirname(path))
+        xs = [r["ball_row"] for r in rows]
+        ax1.plot(xs, [r["test_acc"] for r in rows], marker="o", label=label)
+        ax2.plot(xs, [r["within_paddle_acc"] for r in rows], marker="o", label=label)
+        chance = 1.0 / rows[0]["n_classes"]
+    ax1.axhline(chance, ls=":", c="gray", label="chance")
+    ax1.set_ylabel("cue column decode accuracy (exact)")
+    ax2.set_ylabel("decode within paddle reach (catchable)")
+    for ax in (ax1, ax2):
+        ax.set_xlabel("ball row at probe time (deeper = longer blind)")
+        ax.set_ylim(0, 1.05)
+        ax.legend(fontsize=8)
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=120)
+    print(args.out)
+
+
+if __name__ == "__main__":
+    main()

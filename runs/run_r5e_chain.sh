@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-5 chain E: post-training measurements that need the chip IDLE
+# (timing windows under concurrent training dispatch are garbage).
+#
+# 1) The core lever in the LRU's own regime: the long_context bench
+#    (seq 581, batch 32) under lstm / lru / lru-c128. The headline-shape
+#    verdict (T=85: LSTM wins) does not decide this cell — the bare-core
+#    table showed the chunked LRU pulling even by T=1024, and at batch 32
+#    the LSTM's per-step matmuls fill only a quarter of the MXU's rows.
+# 2) The state probe on the ring-init arm (did widening the eigenvalue
+#    ring extend the memory horizon even if the task didn't solve?).
+cd /root/repo
+while ! grep -q R5D_CHAIN_ALL_DONE runs/r5d_chain.log 2>/dev/null; do sleep 60; done
+
+for args in "" "--core lru" "--core lru --lru-chunk 128"; do
+  python bench.py --mode long_context $args 2>bench_lc_err.tmp | tail -1 \
+    | tee -a runs/bench_longcontext_r5.jsonl
+  tail -2 bench_lc_err.tmp
+done
+rm -f bench_lc_err.tmp
+echo "=== LONG_CONTEXT_BENCH DONE ==="
+
+if [ -d runs/long_context_mid12_ring/ckpt ]; then
+  python runs/probe_state.py --run runs/long_context_mid12_ring --step 36000 \
+    --env memory_catch:10:12 --envs 384 \
+    --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+    --set hidden_dim=128 --set max_episode_steps=288 \
+    --set learning_steps=128 --set block_length=512 \
+    --set recurrent_core=lru --set lr_schedule=cosine \
+    --set lru_r_min=0.98 --set lru_r_max=0.9999 \
+    --out runs/long_context_mid12_ring/probe.jsonl
+  echo "=== RING_PROBE EXIT: $? ==="
+fi
+
+echo R5E_CHAIN_ALL_DONE
