@@ -158,12 +158,9 @@ def main():
         # pace collection to the threaded run's observed consumed:inserted
         # ratio instead of collecting every dispatch
         cfg = cfg.replace(samples_per_insert=15.0)
-    if args.ablate_zero_state:
-        cfg = cfg.replace(burn_in_steps=0, zero_state_replay=True)
-    if args.set:
-        from r2d2_tpu.config import parse_overrides
+    from r2d2_tpu.config import apply_cli_overrides
 
-        cfg = cfg.replace(**parse_overrides(args.set))
+    cfg = apply_cli_overrides(cfg, args.set, args.ablate_zero_state)
     if args.eval_only:
         # same net/eval machinery as the post-training path, no Trainer —
         # used to re-emit headline curves at higher episode counts

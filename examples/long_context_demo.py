@@ -82,12 +82,9 @@ def main():
         checkpoint_dir=os.path.join(args.out, "ckpt"),
         metrics_path=os.path.join(args.out, "metrics.jsonl"),
     )
-    if args.ablate_zero_state:
-        cfg = cfg.replace(burn_in_steps=0, zero_state_replay=True)
-    if args.set:
-        from r2d2_tpu.config import parse_overrides
+    from r2d2_tpu.config import apply_cli_overrides
 
-        cfg = cfg.replace(**parse_overrides(args.set))
+    cfg = apply_cli_overrides(cfg, args.set, args.ablate_zero_state)
 
     trainer = Trainer(cfg, resume=args.resume)
     try:

@@ -465,3 +465,17 @@ def parse_overrides(pairs) -> dict:
         else:  # str (and Optional[str]: pass through)
             out[key] = raw
     return out
+
+
+def apply_cli_overrides(cfg, set_pairs=None, ablate_zero_state=False):
+    """One resolution order for every demo/CLI: `--set` overrides first,
+    then the zero-state ablation flag — so the flag's documented contract
+    (burn_in=0 + zero_state_replay) always wins. Until round 5 the demos
+    applied the flag first, and `--set burn_in_steps=N --ablate-zero-state`
+    silently restored an N-step burn-in (the one affected artifact is
+    recorded in runs/README.md, mc84_full_lru_zerostate)."""
+    if set_pairs:
+        cfg = cfg.replace(**parse_overrides(set_pairs))
+    if ablate_zero_state:
+        cfg = cfg.replace(burn_in_steps=0, zero_state_replay=True)
+    return cfg

@@ -1,0 +1,32 @@
+# Shared helpers for runs/run_*.sh chain scripts. Source from a chain:
+#   . runs/lib.sh
+# Historical chains (r3*/r4*/r5a-e) carry inlined copies from before this
+# file existed; they are provenance artifacts and are not rewritten.
+
+# Retry a training command on the watchdog's stall exit code (86 =
+# STALL_EXIT_CODE, r2d2_tpu/utils/supervision.py) by appending --resume,
+# up to 3 resumes.
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+# Print the final mean_reward from an eval.jsonl, or -9 when the file is
+# missing/empty (a crashed run never writes eval.jsonl — the sentinel makes
+# the chain's >= threshold gates read a crash as a clean negative instead
+# of feeding float('') a blank).
+last_eval() { python - "$1" <<'PY'
+import json, os, sys
+path = sys.argv[1]
+rows = []
+if os.path.exists(path):
+    rows = [json.loads(l) for l in open(path) if l.strip()]
+print(rows[-1]["mean_reward"] if rows else -9)
+PY
+}

@@ -28,14 +28,20 @@ def main():
     import matplotlib.pyplot as plt
 
     fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(11, 4), sharex=True)
+    chances = set()
     for path in args.probes:
         rows = [json.loads(l) for l in open(path) if l.strip()]
+        if not rows:
+            raise SystemExit(f"{path}: no probe rows")
         label = os.path.basename(os.path.dirname(path))
         xs = [r["ball_row"] for r in rows]
         ax1.plot(xs, [r["test_acc"] for r in rows], marker="o", label=label)
         ax2.plot(xs, [r["within_paddle_acc"] for r in rows], marker="o", label=label)
-        chance = 1.0 / rows[0]["n_classes"]
-    ax1.axhline(chance, ls=":", c="gray", label="chance")
+        chances.add(1.0 / rows[0]["n_classes"])
+    # one dotted line per distinct class count, so comparing runs with
+    # different cue vocabularies doesn't inherit the last file's chance
+    for chance in sorted(chances):
+        ax1.axhline(chance, ls=":", c="gray", label=f"chance ({chance:.3f})")
     ax1.set_ylabel("cue column decode accuracy (exact)")
     ax2.set_ylabel("decode within paddle reach (catchable)")
     for ax in (ax1, ax2):

@@ -1,0 +1,51 @@
+#!/bin/bash
+# Round-5 chain A, resumed after a driver restart killed the original
+# run_r5a_chain.sh mid-chain. Arm 1 (mc84_full_lru_cue40) COMPLETED
+# before the restart: final eval -0.78 at 100k updates (n=64) — the
+# full Nature/512+LRU net does NOT solve the cue-40 geometry (blind
+# span 42 >> L=20), so per the chain's pre-registered branch the
+# fallback geometry runs: cue 60 (the KNOWN-solvable task, blind 22)
+# with L=B=10 windows, attacking the window-carry confound from the
+# window side (blind 22 >> L=10). Both arms. See run_r5a_chain.sh for
+# the full design rationale.
+cd /root/repo
+
+run_with_retry() {
+  local tries=0
+  "$@"
+  local rc=$?
+  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
+    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    "$@" --resume; rc=$?
+  done
+  return $rc
+}
+
+last_eval() { python - "$1" <<'PY'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+print(rows[-1]["mean_reward"] if rows else -9)
+PY
+}
+
+echo "=== MC84_FULL_LRU_CUE40 EVAL (pre-restart): $(last_eval runs/mc84_full_lru_cue40/eval.jsonl) (NEGATIVE => fallback) ==="
+
+run_with_retry python examples/catch_demo.py --out runs/mc84_full_lru_L10 \
+  --env memory_catch:60 --full --mode fused --steps 100000 \
+  --set recurrent_core=lru --set gamma=0.99 \
+  --set target_net_update_interval=250 \
+  --set learning_steps=10 --set burn_in_steps=10 --set save_interval=12500
+echo "=== MC84_FULL_LRU_L10 EXIT: $? ==="
+EV=$(last_eval runs/mc84_full_lru_L10/eval.jsonl)
+echo "=== MC84_FULL_LRU_L10 EVAL: $EV ==="
+if python -c "import sys; sys.exit(0 if float('$EV') >= 0.5 else 1)"; then
+  run_with_retry python examples/catch_demo.py --out runs/mc84_full_lru_L10_zs \
+    --env memory_catch:60 --full --mode fused --steps 100000 \
+    --set recurrent_core=lru --set gamma=0.99 \
+    --set target_net_update_interval=250 \
+    --set learning_steps=10 --set burn_in_steps=10 --set save_interval=12500 \
+    --ablate-zero-state
+  echo "=== MC84_FULL_LRU_L10_ZS EXIT: $? ==="
+fi
+
+echo R5A_CHAIN_ALL_DONE

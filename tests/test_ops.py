@@ -156,3 +156,19 @@ class TestConfigOverrides:
         out = parse_overrides(["scan_chunk=32", "metrics_path=/tmp/x.jsonl"])
         assert out == {"scan_chunk": 32, "metrics_path": "/tmp/x.jsonl"}
         assert parse_overrides(["scan_chunk=none"]) == {"scan_chunk": None}
+
+    def test_zero_state_flag_wins_over_set_overrides(self):
+        # regression: until round 5 the demos applied --ablate-zero-state
+        # BEFORE --set, so `--set burn_in_steps=20 --ablate-zero-state`
+        # silently restored a 20-step burn-in in the zero-state arm
+        # (runs/README.md, mc84_full_lru_zerostate)
+        from r2d2_tpu.config import apply_cli_overrides, tiny_test
+
+        cfg = apply_cli_overrides(
+            tiny_test(), ["burn_in_steps=4", "gamma=0.99"],
+            ablate_zero_state=True,
+        )
+        assert cfg.burn_in_steps == 0 and cfg.zero_state_replay
+        assert cfg.gamma == 0.99  # non-conflicting overrides still apply
+        plain = apply_cli_overrides(tiny_test(), ["burn_in_steps=4"])
+        assert plain.burn_in_steps == 4 and not plain.zero_state_replay
