@@ -1,0 +1,47 @@
+#!/bin/bash
+# Round-5 chain F (queued behind chain E's idle-chip measurements):
+#
+# 1) Component wall-clock decomposition of the headline update
+#    (runs/measure_update_breakdown.py) — four rounds argued encoder
+#    granularity vs LSTM serialization from FLOP ledgers; this measures
+#    the actual parts at the actual shapes on the idle chip.
+#
+# 2) The cue-50 middle rung of the full-scale (84x84, Nature/512+LRU)
+#    memory frontier: chain A measured cue-60 (blind 22) solving and
+#    cue-40 (blind 42) failing. Cue 50 => blind 32: (a) brackets the
+#    full-scale memory break to one rung, and (b) is PARTIALLY
+#    deconfounded — L=20 windows that contain any cue frame end >= 12
+#    steps before landing, so the whole final positioning phase is
+#    cue-blind in-window. If stored-state solves, the zero-state arm
+#    (true burn_in=0 after the round-5 ordering fix) completes a
+#    controlled pair at a geometry where within-window cue carry cannot
+#    cover the decision steps.
+cd /root/repo
+while ! grep -q R5E_CHAIN_ALL_DONE runs/r5e_chain.log 2>/dev/null; do sleep 60; done
+
+. runs/lib.sh
+
+python runs/measure_update_breakdown.py --iters 30 \
+  --out runs/update_breakdown_r5.jsonl > runs/update_breakdown_r5.log 2>&1
+echo "=== UPDATE_BREAKDOWN EXIT: $? ==="
+tail -12 runs/update_breakdown_r5.log
+
+run_with_retry python examples/catch_demo.py --out runs/mc84_full_lru_cue50 \
+  --env memory_catch:50 --full --mode fused --steps 100000 \
+  --set recurrent_core=lru --set gamma=0.99 \
+  --set target_net_update_interval=250 \
+  --set learning_steps=20 --set burn_in_steps=20 --set save_interval=12500
+echo "=== MC84_FULL_LRU_CUE50 EXIT: $? ==="
+EV=$(last_eval runs/mc84_full_lru_cue50/eval.jsonl)
+echo "=== MC84_FULL_LRU_CUE50 EVAL: $EV ==="
+if python -c "import sys; sys.exit(0 if float('$EV') >= 0.5 else 1)"; then
+  run_with_retry python examples/catch_demo.py --out runs/mc84_full_lru_cue50_zs \
+    --env memory_catch:50 --full --mode fused --steps 100000 \
+    --set recurrent_core=lru --set gamma=0.99 \
+    --set target_net_update_interval=250 \
+    --set learning_steps=20 --set save_interval=12500 \
+    --ablate-zero-state
+  echo "=== MC84_FULL_LRU_CUE50_ZS EXIT: $? ==="
+fi
+
+echo R5F_CHAIN_ALL_DONE
