@@ -1,0 +1,92 @@
+"""Render the temporal memory frontier: final eval vs blind span, each
+rung against its OWN measured random-walk null (runs/*/baseline.json,
+n=2048 through the same device collector).
+
+Rungs (26x26 slow-fall memory catch, identical recipe: IMPALA 8/16,
+hidden 128, LRU core, cosine lr, seq 212+, window-1-from-stored-state):
+
+  blind 126  long_context_mid6    solved, sustained (round 4)
+  blind 194  long_context_mid9    solved, sustained (round 4)
+  blind 216  long_context_mid10   solved 1.0 (round 5, chain B)
+  blind 243  long_context_mid11   0.72 and climbing at budget end (r5)
+  blind 270  long_context_mid12_L128  plateau at the null (round 4);
+             the ring-init arm (r 0.98/0.9999) also fails (round 5)
+
+    python runs/plot_temporal_frontier.py --out runs/temporal_frontier.jpg
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# (blind span, run dir, null source dir, short note)
+RUNGS = [
+    (126, "long_context_mid6", "long_context_mid6", "solved r4"),
+    (194, "long_context_mid9", "long_context_mid9", "solved r4"),
+    (216, "long_context_mid10", "long_context_mid10", "solved r5"),
+    (243, "long_context_mid11", "long_context_mid11", "climbing r5"),
+    (270, "long_context_mid12_L128", "long_context_mid", "plateau r4/r5"),
+]
+
+BLUE, GRAY, INK = "#1f77b4", "#7f7f7f", "#444444"
+
+
+def final_mean(run, k=3):
+    rows = [json.loads(l) for l in open(os.path.join(HERE, run, "eval.jsonl"))
+            if l.strip()]
+    vals = [r["mean_reward"] for r in rows[-k:]]
+    return sum(vals) / len(vals)
+
+
+def null_mean(run):
+    with open(os.path.join(HERE, run, "baseline.json")) as f:
+        return json.load(f)["random_mean_reward"]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=os.path.join(HERE, "temporal_frontier.jpg"))
+    args = p.parse_args()
+
+    xs = [r[0] for r in RUNGS]
+    evals = [final_mean(r[1]) for r in RUNGS]
+    nulls = [null_mean(r[2]) for r in RUNGS]
+
+    fig, ax = plt.subplots(figsize=(7.2, 4.2))
+    ax.plot(xs, nulls, color=GRAY, ls=":", lw=2, marker="s", ms=6,
+            label="measured random-walk null (n=2048)")
+    ax.plot(xs, evals, color=BLUE, lw=2, marker="o", ms=8,
+            label="trained, mean of final 3 checkpoints (n=64 each)")
+    for (x, run, _, note), y in zip(RUNGS, evals):
+        ax.annotate(note, (x, y), textcoords="offset points",
+                    xytext=(0, 9), ha="center", fontsize=8, color=INK)
+    # the ring-init arm at 270: distinct marker, direct-labeled
+    ring = final_mean("long_context_mid12_ring")
+    ax.plot([270], [ring], color=BLUE, marker="x", ms=9, mew=2, ls="none")
+    ax.annotate("ring-init arm r5", (270, ring), textcoords="offset points",
+                xytext=(4, -13), ha="right", fontsize=8, color=INK)
+
+    ax.set_xlabel("blind span (steps the state must carry the cue)")
+    ax.set_ylabel("eval mean reward")
+    ax.set_ylim(-1.05, 1.18)
+    ax.set_xticks(xs)
+    ax.grid(True, alpha=0.25)
+    ax.legend(fontsize=8, loc="center left")
+    ax.set_title("Temporal memory frontier: 26×26 slow-fall memory catch, "
+                 "stored-state recipe", fontsize=10)
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=120)
+    print(args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,53 +1,29 @@
 #!/bin/bash
-# Round-5 chain D: make the 16x16 procmaze rung decisive (VERDICT r4
-# item 5) and land the multi-env sweep artifact (item 6).
+# Round-5 chain D (rewritten before firing): make the 16x16 procmaze rung
+# decisive (VERDICT r4 item 5) and land the multi-env sweep artifact
+# (item 6).
 #
-# Procmaze: the round-4 warm-started run held +0.02..+0.038 over the
-# 0.137 baseline across its final five n=256 checkpoints but was read as
-# exploration-bound. This arm resumes from its step-60000 checkpoint
-# with DOUBLE the fresh budget (60k updates) and the exploration lever
-# pulled: eps_alpha 7 -> 3 flattens the Ape-X ladder so the actor fleet
-# spends far more of its time at epsilon 0.05..0.4 instead of
-# concentrating near the greedy floor. Verdict comes from
-# runs/eval_stats.py: per-episode returns, stderr, and a z-score against
-# the null distribution measured through the SAME device collector at
-# epsilon=1 — "final checkpoints >= baseline + 3 sigma" is now a number.
+# REWRITE NOTE: the original chain D warm-resumed from
+# runs/procmaze16_warm/ckpt/step_60000, but the round-4 checkpoint dirs
+# were cleaned between rounds and that checkpoint no longer exists —
+# and `--resume` on an empty dir silently starts FRESH, which would have
+# mislabeled a fresh run as warm-started. This version runs an honestly
+# fresh 16x16 arm at 120k updates (2x the round-4 16x16 budget) with the
+# exploration lever pulled: eps_alpha 7 -> 3 flattens the Ape-X ladder so
+# the actor fleet spends most of its time at epsilon 0.05..0.4 instead of
+# concentrating near the greedy floor. Verdict via runs/eval_stats.py:
+# per-episode returns, stderr, z-score against an epsilon=1 null measured
+# through the SAME device collector — "baseline + 3 sigma" becomes a
+# number.
 #
-# Sweep: one artifact per env family (obs geometries differ), both under
-# runs/sweep_r5/: the catch family at 84x84 through the atari preset and
-# procmaze through procgen_impala — converting sweep.py (BASELINE
-# config 3's driver, unit-tested but never driven) into a driven tool.
+# The sweeps run FIRST (minutes, and the artifact is judged): one
+# invocation per env family (obs geometries differ) under runs/sweep_r5/,
+# converting sweep.py (BASELINE config 3's driver, unit-tested but never
+# driven) into a driven tool.
 cd /root/repo
 while ! grep -q R5C_CHAIN_ALL_DONE runs/r5c_chain.log 2>/dev/null; do sleep 60; done
 
-run_with_retry() {
-  local tries=0
-  "$@"
-  local rc=$?
-  while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
-    tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
-    "$@" --resume; rc=$?
-  done
-  return $rc
-}
-
-mkdir -p runs/procmaze16_warm2/ckpt
-if [ ! -d runs/procmaze16_warm2/ckpt/step_60000 ]; then
-  cp -r runs/procmaze16_warm/ckpt/step_60000 runs/procmaze16_warm2/ckpt/step_60000
-fi
-run_with_retry python -m r2d2_tpu.train --preset procgen_impala --env procmaze_shaped:16 \
-  --mode fused --steps 120000 --updates-per-dispatch 16 --resume \
-  --set checkpoint_dir=runs/procmaze16_warm2/ckpt \
-  --set metrics_path=runs/procmaze16_warm2/metrics.jsonl \
-  --set buffer_capacity=200000 --set learning_starts=30000 \
-  --set samples_per_insert=15.0 --set save_interval=7500 \
-  --set target_net_update_interval=500 --set forward_steps=20 \
-  --set num_actors=16 --set eps_alpha=3.0
-echo "=== PROCMAZE16_WARM2 TRAIN EXIT: $? ==="
-python runs/eval_stats.py --preset procgen_impala --env procmaze_shaped:16 \
-  --ckpt runs/procmaze16_warm2/ckpt --episodes 512 --null-episodes 2048 \
-  --out runs/procmaze16_warm2/eval_stats.jsonl
-echo "=== PROCMAZE16_WARM2 STATS EXIT: $? ==="
+. runs/lib.sh
 
 python -m r2d2_tpu.sweep --games catch memory_catch memory_catch:60 \
   --allow-any-env --preset atari --root runs/sweep_r5/catch_family \
@@ -57,5 +33,21 @@ python -m r2d2_tpu.sweep --games procmaze_shaped procmaze_shaped:8 \
   --allow-any-env --preset procgen_impala --root runs/sweep_r5/procmaze \
   --steps 4000 --set learning_starts=20000 --set save_interval=2000
 echo "=== SWEEP_PROCMAZE EXIT: $? ==="
+
+mkdir -p runs/procmaze16_flat
+run_with_retry python -m r2d2_tpu.train --preset procgen_impala --env procmaze_shaped:16 \
+  --mode fused --steps 120000 --updates-per-dispatch 16 \
+  --set checkpoint_dir=runs/procmaze16_flat/ckpt \
+  --set metrics_path=runs/procmaze16_flat/metrics.jsonl \
+  --set buffer_capacity=200000 --set learning_starts=30000 \
+  --set samples_per_insert=15.0 --set save_interval=7500 \
+  --set target_net_update_interval=500 --set forward_steps=20 \
+  --set num_actors=16 --set eps_alpha=3.0
+echo "=== PROCMAZE16_FLAT TRAIN EXIT: $? ==="
+python runs/eval_stats.py --preset procgen_impala --env procmaze_shaped:16 \
+  --ckpt runs/procmaze16_flat/ckpt --episodes 512 --null-episodes 2048 \
+  --set forward_steps=20 --set num_actors=16 \
+  --out runs/procmaze16_flat/eval_stats.jsonl
+echo "=== PROCMAZE16_FLAT STATS EXIT: $? ==="
 
 echo R5D_CHAIN_ALL_DONE
