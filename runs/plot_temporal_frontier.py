@@ -28,14 +28,25 @@ import matplotlib.pyplot as plt
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
-# (blind span, run dir, null source dir, short note)
+# (blind span, run dir, null source dir) — status labels are computed
+# from the data at render time: chain r5f re-renders this figure after
+# the mid11 extension rewrites its eval.jsonl, so hard-coded notes could
+# contradict the plotted point
 RUNGS = [
-    (126, "long_context_mid6", "long_context_mid6", "solved r4"),
-    (194, "long_context_mid9", "long_context_mid9", "solved r4"),
-    (216, "long_context_mid10", "long_context_mid10", "solved r5"),
-    (243, "long_context_mid11", "long_context_mid11", "climbing r5"),
-    (270, "long_context_mid12_L128", "long_context_mid", "plateau r4/r5"),
+    (126, "long_context_mid6", "long_context_mid6"),
+    (194, "long_context_mid9", "long_context_mid9"),
+    (216, "long_context_mid10", "long_context_mid10"),
+    (243, "long_context_mid11", "long_context_mid11"),
+    (270, "long_context_mid12_L128", "long_context_mid"),
 ]
+
+
+def status(final, null):
+    if final >= 0.9:
+        return "solved"
+    if final >= null + 0.3:
+        return "above null"
+    return "at null"
 
 BLUE, GRAY, INK = "#1f77b4", "#7f7f7f", "#444444"
 
@@ -66,8 +77,9 @@ def main():
             label="measured random-walk null (n=2048)")
     ax.plot(xs, evals, color=BLUE, lw=2, marker="o", ms=8,
             label="trained, mean of final 3 checkpoints (n=64 each)")
-    for (x, run, _, note), y in zip(RUNGS, evals):
-        ax.annotate(note, (x, y), textcoords="offset points",
+    for (x, run, _), y, n in zip(RUNGS, evals, nulls):
+        ax.annotate(f"{status(y, n)} ({y:.2f})", (x, y),
+                    textcoords="offset points",
                     xytext=(0, 9), ha="center", fontsize=8, color=INK)
     # the ring-init arm at 270: distinct marker, direct-labeled
     ring = final_mean("long_context_mid12_ring")

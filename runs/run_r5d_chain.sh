@@ -21,7 +21,7 @@
 # converting sweep.py (BASELINE config 3's driver, unit-tested but never
 # driven) into a driven tool.
 cd /root/repo
-while ! grep -q R5C_CHAIN_ALL_DONE runs/r5c_chain.log 2>/dev/null; do sleep 60; done
+while ! grep -q R5E_CHAIN_ALL_DONE runs/r5e_chain.log 2>/dev/null; do sleep 60; done
 
 . runs/lib.sh
 

@@ -10,7 +10,7 @@
 # 2) The state probe on the ring-init arm (did widening the eigenvalue
 #    ring extend the memory horizon even if the task didn't solve?).
 cd /root/repo
-while ! grep -q R5D_CHAIN_ALL_DONE runs/r5d_chain.log 2>/dev/null; do sleep 60; done
+while ! grep -q R5C_CHAIN_ALL_DONE runs/r5c_chain.log 2>/dev/null; do sleep 60; done
 
 for args in "" "--core lru" "--core lru --lru-chunk 128"; do
   python bench.py --mode long_context $args 2>bench_lc_err.tmp | tail -1 \

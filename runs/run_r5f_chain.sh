@@ -17,7 +17,7 @@
 #    controlled pair at a geometry where within-window cue carry cannot
 #    cover the decision steps.
 cd /root/repo
-while ! grep -q R5E_CHAIN_ALL_DONE runs/r5e_chain.log 2>/dev/null; do sleep 60; done
+while ! grep -q R5D_CHAIN_ALL_DONE runs/r5d_chain.log 2>/dev/null; do sleep 60; done
 
 . runs/lib.sh
 
@@ -43,5 +43,28 @@ if python -c "import sys; sys.exit(0 if float('$EV') >= 0.5 else 1)"; then
     --ablate-zero-state
   echo "=== MC84_FULL_LRU_CUE50_ZS EXIT: $? ==="
 fi
+
+# Blind-243 budget extension: chain B left mid11 climbing monotonically
+# (0.47 -> 0.72) at its 36k budget end — resume to 72k to settle whether
+# the 243 rung SOLVES (sharpening the frontier to "break strictly
+# inside 243..270") or stalls short.
+#
+# PRE-REGISTERED FRAMING: resuming with --steps 72000 re-stretches the
+# cosine lr horizon, so at the resume point lr jumps from the 0.1x floor
+# back to ~0.55x — this is an SGDR-style WARM-RESTART extension, not a
+# schedule-pure budget doubling. A solve is still the existence claim
+# ("the recipe class solves blind-243"); a collapse-then-recovery or a
+# stall must be read with the lr spike in mind, and the runs/README row
+# must state the warm-restart explicitly either way.
+run_with_retry python examples/long_context_demo.py --out runs/long_context_mid11 \
+  --env memory_catch:10:11 --steps 72000 --eval-episodes 4 --resume \
+  --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+  --set hidden_dim=128 --set max_episode_steps=264 \
+  --set learning_steps=128 --set block_length=512 \
+  --set buffer_capacity=102400 --set learning_starts=40000 \
+  --set recurrent_core=lru --set lr_schedule=cosine
+echo "=== MID11_EXTENSION EXIT: $? ==="
+python runs/plot_temporal_frontier.py --out runs/temporal_frontier.jpg
+echo "=== FRONTIER_REPLOT EXIT: $? ==="
 
 echo R5F_CHAIN_ALL_DONE
