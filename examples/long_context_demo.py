@@ -1,14 +1,17 @@
 """Long-context learning demo: seq-581 stored-state burn-in, end to end.
 
 The long_context preset (BASELINE.json config 5) trains 512-step learning
-windows with 64-step burn-in on the slow-fall flashing-cue catch
-(envs/catch.py, 'memory_catch:8:12'): 984-step episodes at full Atari
-resolution where the ball is visible only for the first ~96 steps and the
-paddle must navigate blind from recurrent memory for ~880 steps. Each
-replay block holds TWO learning windows, so window 1 replays from a STORED
-recurrent state that must already carry the cue — the R2D2 stored-state +
-burn-in machinery exercised at ~6x the reference's sequence length
-(85 -> 581, reference config.py:27-30).
+windows with 64-step burn-in on the MULTI-BALL slow-fall flashing-cue
+catch (envs/catch.py, 'memory_catch:10:8:4', the round-5 re-target):
+768-step episodes at 26x26 of four balls, each visible only during its
+own 10-step cue before a ~170-step blind fall. Each replay block holds
+TWO learning windows, so window 1 replays from a STORED recurrent state —
+the R2D2 stored-state + burn-in machinery exercised at ~6x the
+reference's sequence length (85 -> 581, reference config.py:27-30). The
+round-4 84x84 single-ball stretch task remains available:
+--env memory_catch:8:12 --set obs_shape=84,84,4 --set
+max_episode_steps=984 (and nature/512 net overrides) works the open
+problem beyond the measured temporal frontier.
 
 Defaults are sized for one chip (~1 GB HBM replay, batch 16, K=2 fused
 dispatches). Artifacts match catch_demo: {out}/metrics.jsonl, eval.jsonl,
@@ -34,10 +37,11 @@ def main():
     p.add_argument("--batch", type=int, default=16)
     p.add_argument("--env", default=None,
                    help="catch-family env overriding the preset's "
-                        "memory_catch:8:12 — e.g. memory_catch:8:4 (328-"
-                        "step episodes: ONE 512-step window covers the "
-                        "episode, the solvable span of the difficulty "
-                        "ladder; the training seq stays 581)")
+                        "memory_catch:10:8:4 — e.g. memory_catch:10:8 "
+                        "(single ball, 192-step episodes: ONE 512-step "
+                        "window covers the episode; the training seq "
+                        "stays 581). Episode caps follow the preset's "
+                        "26x26 obs_shape")
     p.add_argument("--eval-episodes", type=int, default=2,
                    help="episodes per eval slot per checkpoint (16 slots)")
     p.add_argument("--resume", action="store_true")

@@ -270,6 +270,28 @@ class R2D2Config:
                 "lstm_backend='scan' (or 'auto', which resolves to scan "
                 "there)"
             )
+        # catch-family geometry: an episode cap shorter than the last
+        # ball's landing means NO reward signal ever fires — training
+        # proceeds silently on zeros (found via the long_context
+        # obs_shape re-target, round 5). Deferred import: envs.catch
+        # pulls jax; config stays import-light until first validate.
+        if self.env_name:
+            from r2d2_tpu.envs.catch import catch_params, is_catch_name
+
+            if is_catch_name(self.env_name):
+                p = catch_params(self.env_name)
+                need = (
+                    (self.obs_shape[0] - 2)
+                    * p.get("fall_every", 1)
+                    * p.get("balls", 1)
+                )
+                if self.max_episode_steps < need:
+                    raise ValueError(
+                        f"max_episode_steps={self.max_episode_steps} truncates "
+                        f"{self.env_name!r} at obs {self.obs_shape} before the "
+                        f"last ball lands (needs >= {need}): every episode "
+                        "would end reward-free"
+                    )
         if self.replay_plane not in ("host", "device", "sharded", "multihost"):
             raise ValueError(f"unknown replay_plane {self.replay_plane!r}")
         if self.replay_plane == "multihost":
@@ -361,30 +383,64 @@ def procgen_impala(game: str = "procmaze") -> R2D2Config:
     ).validate()
 
 
-def long_context(game: str = "memory_catch:8:12") -> R2D2Config:
+def long_context(
+    game: str = "memory_catch:10:8:4",
+    obs_shape: tuple = (26, 26, 1),
+) -> R2D2Config:
     """seq_len=581 stored-state burn-in stretch config (BASELINE.json
     config 5). The LSTM recurrence is sequential in time, so long sequences
     scale via remat-chunked lax.scan over time (SURVEY.md section 5.7), not
     sequence-dimension sharding.
 
-    The default env is the slow-fall flashing-cue catch
-    (envs/catch.py: cue 8 rows, ball falls every 12 steps -> 984-step
-    episodes at 84x84): each block holds TWO 512-step learning windows,
-    so the second window's burn-in starts from a stored recurrent state
-    that must carry the cue across ~450 blind steps — a genuine
-    long-context memory task, trained end to end by
-    examples/long_context_demo.py. Pass another game name to retarget
-    (e.g. a NetHack/Craftax-class env where one is installed) — the
-    catch-specific geometry below applies only to catch-family names."""
+    The default task (re-targeted in round 5, VERDICT r4 item 4) is the
+    MULTI-BALL slow-fall flashing-cue catch (envs/catch.py,
+    memory_catch:10:8:4): 768-step episodes of four balls, each with its
+    own 10-step cue and ~170-step blind fall — inside the measured
+    temporal frontier (runs/temporal_frontier.jpg: solves <= 216 blind
+    steps) — spanning TWO 512-step learning windows per block.
+    Demonstrated positive at the preset's own shape: stored-state 3.06
+    vs measured null -1.91 (ceiling +4, runs/long_context_mb/). The
+    zero-state control ALSO reaches 3.0 (noisier: 2.06-3.0 vs 2.88-3.06
+    over the final checkpoints, runs/long_context_mb_zs/) — the
+    within-window balls teach a cue-memory circuit that generalizes
+    across the window boundary at eval, the R2D2 paper's own
+    observation about when zero-state replay suffices; the load-bearing
+    demonstrations for the stored-state machinery stand at the
+    single-ball rungs (runs/long_context_mid6* pair). Net defaults
+    below are the demonstrated recipe (26x26 IMPALA, hidden 128, LRU
+    core, cosine lr).
+
+    The round-4 default, memory_catch:8:12 at 84x84 (blind ~880), sits
+    far BEYOND that frontier — it trains stably but no arm has separated
+    from its null (runs/long_context_attacks.jpg); pass it explicitly —
+    long_context("memory_catch:8:12", obs_shape=(84, 84, 4)) — to work
+    the open problem (episode geometry follows obs_shape, so the cap
+    comes out right: 82 rows x fall-12 = 984). Pass any other env name
+    to retarget (e.g. a NetHack/Craftax-class env where one is
+    installed) and override the net defaults per env; the catch-specific
+    geometry below applies only to catch-family names. bench.py's
+    long_context mode pins its own shapes to the config-5 spec, so this
+    default does not move the bench row's workload."""
     from r2d2_tpu.envs.catch import catch_params, is_catch_name
 
     kw = {}
     if is_catch_name(game):
-        fall = catch_params(game).get("fall_every", 1)
-        # episode length = (84-2) rows x fall steps/row
-        kw = dict(action_dim=3, max_episode_steps=82 * fall)
+        p = catch_params(game)
+        fall = p.get("fall_every", 1)
+        balls = p.get("balls", 1)
+        # per ball: (rows-2) fall rows x fall steps/row; balls land in turn
+        kw = dict(
+            action_dim=3,
+            max_episode_steps=(obs_shape[0] - 2) * fall * balls,
+        )
     return R2D2Config(
         env_name=game,
+        obs_shape=obs_shape,
+        encoder="impala",
+        impala_channels=(8, 16),
+        hidden_dim=128,
+        recurrent_core="lru",
+        lr_schedule="cosine",
         burn_in_steps=64,
         learning_steps=512,
         forward_steps=5,
