@@ -25,13 +25,27 @@ while ! grep -q R5E_CHAIN_ALL_DONE runs/r5e_chain.log 2>/dev/null; do sleep 60; 
 
 . runs/lib.sh
 
+# Sweep sizing note (second launch): the first attempt used
+# learning_starts=20000 through the default 8-env host pool — ~35 min of
+# warmup PER GAME over the tunneled device (observed: 22k transitions in
+# 35 min), i.e. ~3.5 h for five games, which the round's wall-clock
+# cannot afford. The artifact's purpose is driving the sweep CLI for
+# real (BASELINE config 3's driver), not a learning claim, so this
+# sizing collects with the 64-env vectorized pool, a 4096-transition
+# warmup, and unthrottled learner pacing — each game lands in minutes
+# and still exercises the full path (env factory -> threaded trainer ->
+# checkpoints -> summary.jsonl). The first attempt's partial game-1 dir
+# was removed.
+rm -rf runs/sweep_r5
 python -m r2d2_tpu.sweep --games catch memory_catch memory_catch:60 \
   --allow-any-env --preset atari --root runs/sweep_r5/catch_family \
-  --steps 4000 --set learning_starts=20000 --set save_interval=2000
+  --steps 2000 --set learning_starts=4096 --set num_actors=64 \
+  --set samples_per_insert=100000 --set save_interval=1000
 echo "=== SWEEP_CATCH EXIT: $? ==="
 python -m r2d2_tpu.sweep --games procmaze_shaped procmaze_shaped:8 \
   --allow-any-env --preset procgen_impala --root runs/sweep_r5/procmaze \
-  --steps 4000 --set learning_starts=20000 --set save_interval=2000
+  --steps 2000 --set learning_starts=4096 --set num_actors=64 \
+  --set samples_per_insert=100000 --set save_interval=1000
 echo "=== SWEEP_PROCMAZE EXIT: $? ==="
 
 mkdir -p runs/procmaze16_flat
