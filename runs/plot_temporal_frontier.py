@@ -30,13 +30,19 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 
 # (blind span, run dir, null source dir) — status labels are computed
 # from the data at render time: chain r5f re-renders this figure after
-# the mid11 extension rewrites its eval.jsonl, so hard-coded notes could
-# contradict the plotted point
+# the mid11 72k budget-doubling run lands, so hard-coded notes could
+# contradict the plotted point. The 243 rung prefers the fresh 72k run
+# (schedule-pure doubled budget) once its eval series exists; the 36k
+# chain-B series remains as the fallback.
+_MID11 = ("long_context_mid11_72k"
+          if os.path.exists(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                         "long_context_mid11_72k", "eval.jsonl"))
+          else "long_context_mid11")
 RUNGS = [
     (126, "long_context_mid6", "long_context_mid6"),
     (194, "long_context_mid9", "long_context_mid9"),
     (216, "long_context_mid10", "long_context_mid10"),
-    (243, "long_context_mid11", "long_context_mid11"),
+    (243, _MID11, "long_context_mid11"),
     (270, "long_context_mid12_L128", "long_context_mid"),
 ]
 

@@ -45,19 +45,19 @@ if python -c "import sys; sys.exit(0 if float('$EV') >= 0.5 else 1)"; then
 fi
 
 # Blind-243 budget extension: chain B left mid11 climbing monotonically
-# (0.47 -> 0.72) at its 36k budget end — resume to 72k to settle whether
-# the 243 rung SOLVES (sharpening the frontier to "break strictly
-# inside 243..270") or stalls short.
+# (0.47 -> 0.72) at its 36k budget end — double the budget to 72k to
+# settle whether the 243 rung SOLVES (sharpening the frontier to "break
+# strictly inside 243..270") or stalls short.
 #
-# PRE-REGISTERED FRAMING: resuming with --steps 72000 re-stretches the
-# cosine lr horizon, so at the resume point lr jumps from the 0.1x floor
-# back to ~0.55x — this is an SGDR-style WARM-RESTART extension, not a
-# schedule-pure budget doubling. A solve is still the existence claim
-# ("the recipe class solves blind-243"); a collapse-then-recovery or a
-# stall must be read with the lr spike in mind, and the runs/README row
-# must state the warm-restart explicitly either way.
-run_with_retry python examples/long_context_demo.py --out runs/long_context_mid11 \
-  --env memory_catch:10:11 --steps 72000 --eval-episodes 4 --resume \
+# SESSION-RESTART REWRITE: the original plan resumed the 36k checkpoint,
+# but checkpoint dirs were cleaned at the session boundary (and --resume
+# on an empty dir silently starts fresh), so this is an honestly FRESH
+# 72k run into its own directory. That is the cleaner experiment anyway:
+# the cosine lr horizon matches the full 72k from step 0 — a
+# schedule-pure budget doubling with no SGDR warm-restart confound. The
+# 36k chain-B run stands untouched in runs/long_context_mid11/.
+run_with_retry python examples/long_context_demo.py --out runs/long_context_mid11_72k \
+  --env memory_catch:10:11 --steps 72000 --eval-episodes 4 \
   --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
   --set hidden_dim=128 --set max_episode_steps=264 \
   --set learning_steps=128 --set block_length=512 \
