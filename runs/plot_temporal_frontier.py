@@ -8,7 +8,11 @@ hidden 128, LRU core, cosine lr, seq 212+, window-1-from-stored-state):
   blind 126  long_context_mid6    solved, sustained (round 4)
   blind 194  long_context_mid9    solved, sustained (round 4)
   blind 216  long_context_mid10   solved 1.0 (round 5, chain B)
-  blind 243  long_context_mid11   0.72 and climbing at budget end (r5)
+  blind 243  long_context_mid11   36k chain-B run (0.47->0.72 climbing);
+             superseded by long_context_mid11_72k (the schedule-pure
+             doubled budget) once that run COMPLETES — selection below
+             requires the 72k series to reach its final checkpoint, so
+             a crashed partial 72k run cannot displace the real point
   blind 270  long_context_mid12_L128  plateau at the null (round 4);
              the ring-init arm (r 0.98/0.9999) also fails (round 5)
 
@@ -32,12 +36,23 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 # from the data at render time: chain r5f re-renders this figure after
 # the mid11 72k budget-doubling run lands, so hard-coded notes could
 # contradict the plotted point. The 243 rung prefers the fresh 72k run
-# (schedule-pure doubled budget) once its eval series exists; the 36k
-# chain-B series remains as the fallback.
-_MID11 = ("long_context_mid11_72k"
-          if os.path.exists(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                         "long_context_mid11_72k", "eval.jsonl"))
-          else "long_context_mid11")
+# (schedule-pure doubled budget) only once its FINAL 72000-step
+# checkpoint exists — a crashed partial run (or a torn/partial final
+# line) must not displace the finished 36k chain-B point.
+
+
+def _mid11_run():
+    path = os.path.join(HERE, "long_context_mid11_72k", "eval.jsonl")
+    try:
+        rows = [json.loads(l) for l in open(path) if l.strip()]
+        if rows and rows[-1]["step"] >= 72000:
+            return "long_context_mid11_72k"
+    except (OSError, ValueError, KeyError):
+        pass
+    return "long_context_mid11"
+
+
+_MID11 = _mid11_run()
 RUNGS = [
     (126, "long_context_mid6", "long_context_mid6"),
     (194, "long_context_mid9", "long_context_mid9"),

@@ -1,0 +1,77 @@
+#!/bin/bash
+# Round-5 chain G (queued behind chain F): the blind-270 CREDIT attack.
+#
+# Where the evidence stands: the linear probe (chain E) dissociated the
+# blind-270 failure into two halves — the default-ring LRU state FORGETS
+# the cue by end-of-blind (decode 0.53), the widened eigenvalue ring
+# RETAINS it (0.86) yet the policy still collapses. So retention is
+# fixed by an init dial and the residual binding factor is credit
+# assignment through a ~270-step-delayed terminal reward. At n-step 20
+# (the baseline for every mid* arm: examples/long_context_demo.py pins
+# forward_steps=20 in its cfg.replace — config.py's preset value 5 is
+# the config-5 parity shape, overridden by the demo) that reward needs
+# ~270/20 = 13-14 bootstrap generations to reach the cue; each
+# generation costs a target-sync cycle of value regression.
+#
+# The designed counter: lengthen the n-step return to 80 so the chain
+# shortens to ~3-4 generations. R2D2/Ape-X use uncorrected n-step
+# returns, so n is a free dial (variance grows with policy stochasticity
+# only; slow-fall catch is deterministic and eval-time epsilon is tiny).
+# seq becomes 64 burn + 128 learn + 80 forward = 272 <= block 512.
+#
+# PRE-REGISTERED protocol:
+#   G1: widened ring (retention repaired) x n-step 80, the compound arm.
+#       Solve (>= 0.9 sustained) => the frontier's break moves past 270
+#       and the two-dial mechanism story is demonstrated; then run G2
+#       (default ring x n-step 80) for attribution — if G2 ALSO solves,
+#       the ring was not necessary and n-step was the whole story; if G2
+#       fails, both dials are load-bearing.
+#   G1 fails => probe its end-of-blind state (n=384): retention intact
+#       would keep the diagnosis credit-side with the n-80 lever now
+#       also measured insufficient; retention lost would mean long-n
+#       training destabilized the ring memory — either way the README
+#       row records a measured negative, not a shrug.
+cd /root/repo
+while ! grep -q R5F_CHAIN_ALL_DONE runs/r5f_chain.log 2>/dev/null; do sleep 60; done
+
+. runs/lib.sh
+
+run_with_retry python examples/long_context_demo.py --out runs/long_context_mid12_ring_n80 \
+  --env memory_catch:10:12 --steps 36000 --eval-episodes 4 \
+  --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+  --set hidden_dim=128 --set max_episode_steps=288 \
+  --set learning_steps=128 --set block_length=512 \
+  --set buffer_capacity=102400 --set learning_starts=40000 \
+  --set recurrent_core=lru --set lr_schedule=cosine \
+  --set lru_r_min=0.98 --set lru_r_max=0.9999 --set forward_steps=80
+echo "=== MID12_RING_N80 EXIT: $? ==="
+EV=$(last_eval runs/long_context_mid12_ring_n80/eval.jsonl)
+echo "=== MID12_RING_N80 EVAL: $EV ==="
+
+if python -c "import sys; sys.exit(0 if float('$EV') >= 0.9 else 1)"; then
+  # attribution arm: n-step 80 with the DEFAULT ring
+  run_with_retry python examples/long_context_demo.py --out runs/long_context_mid12_n80 \
+    --env memory_catch:10:12 --steps 36000 --eval-episodes 4 \
+    --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+    --set hidden_dim=128 --set max_episode_steps=288 \
+    --set learning_steps=128 --set block_length=512 \
+    --set buffer_capacity=102400 --set learning_starts=40000 \
+    --set recurrent_core=lru --set lr_schedule=cosine \
+    --set forward_steps=80
+  echo "=== MID12_N80 EXIT: $? ==="
+else
+  python runs/probe_state.py --run runs/long_context_mid12_ring_n80 --step 36000 \
+    --env memory_catch:10:12 --envs 384 \
+    --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+    --set hidden_dim=128 --set max_episode_steps=288 \
+    --set learning_steps=128 --set block_length=512 \
+    --set recurrent_core=lru --set lr_schedule=cosine \
+    --set lru_r_min=0.98 --set lru_r_max=0.9999 --set forward_steps=80 \
+    --out runs/long_context_mid12_ring_n80/probe.jsonl
+  echo "=== RING_N80_PROBE EXIT: $? ==="
+fi
+
+python runs/plot_temporal_frontier.py --out runs/temporal_frontier.jpg
+echo "=== FRONTIER_REPLOT EXIT: $? ==="
+
+echo R5G_CHAIN_ALL_DONE
