@@ -25,26 +25,38 @@ while ! grep -q R5E_CHAIN_ALL_DONE runs/r5e_chain.log 2>/dev/null; do sleep 60; 
 
 . runs/lib.sh
 
-# Sweep sizing note (second launch): the first attempt used
+# Sweep sizing note (THIRD launch): the first attempt used
 # learning_starts=20000 through the default 8-env host pool — ~35 min of
-# warmup PER GAME over the tunneled device (observed: 22k transitions in
-# 35 min), i.e. ~3.5 h for five games, which the round's wall-clock
-# cannot afford. The artifact's purpose is driving the sweep CLI for
+# warmup PER GAME over the tunneled device. The second attempt cut the
+# warmup to 4096 but kept the HOST replay plane, so every K-update
+# dispatch shipped ~40 MB/batch host->device through the tunnel: the
+# learner crawled at ~0.4 updates/s with the host pegged at 100% iowait
+# (observed mid-game-1, 2026-08-02), i.e. ~80 min/game — still
+# unaffordable. The artifact's purpose is driving the sweep CLI for
 # real (BASELINE config 3's driver), not a learning claim, so this
-# sizing collects with the 64-env vectorized pool, a 4096-transition
-# warmup, and unthrottled learner pacing — each game lands in minutes
-# and still exercises the full path (env factory -> threaded trainer ->
-# checkpoints -> summary.jsonl). The first attempt's partial game-1 dir
-# was removed.
+# launch puts each game on the framework's native data plane
+# (collector=device + replay_plane=device: collection, replay, and the
+# K-dispatch learner all stay in HBM; the tunnel carries scalars), with
+# the 4096-transition warmup, K=16 update dispatches (the threaded
+# trainer was dispatch-latency-bound at K=1 over the tunnel: ~3
+# updates/s observed), and unthrottled learner pacing — and still
+# exercises the full path (env factory -> threaded trainer ->
+# checkpoints -> summary.jsonl). Partial earlier dirs removed.
 rm -rf runs/sweep_r5
 python -m r2d2_tpu.sweep --games catch memory_catch memory_catch:60 \
   --allow-any-env --preset atari --root runs/sweep_r5/catch_family \
   --steps 2000 --set learning_starts=4096 --set num_actors=64 \
+  --set buffer_capacity=80000 \
+  --set collector=device --set replay_plane=device \
+  --set updates_per_dispatch=16 \
   --set samples_per_insert=100000 --set save_interval=1000
 echo "=== SWEEP_CATCH EXIT: $? ==="
 python -m r2d2_tpu.sweep --games procmaze_shaped procmaze_shaped:8 \
   --allow-any-env --preset procgen_impala --root runs/sweep_r5/procmaze \
   --steps 2000 --set learning_starts=4096 --set num_actors=64 \
+  --set buffer_capacity=80000 \
+  --set collector=device --set replay_plane=device \
+  --set updates_per_dispatch=16 \
   --set samples_per_insert=100000 --set save_interval=1000
 echo "=== SWEEP_PROCMAZE EXIT: $? ==="
 
