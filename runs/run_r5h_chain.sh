@@ -1,0 +1,78 @@
+#!/bin/bash
+# Round-5 chain H (queued behind chain G): make the 16x16 procmaze rung
+# decisive on the POSITIVE side (VERDICT r4 item 5's first arm).
+#
+# Where the evidence stands after chain D: from-scratch 16x16 is
+# decisively DEAD — 120k updates (4x the round-4 budget) with the
+# flattened exploration ladder (eps_alpha=3) land 3.2-6.8 sigma BELOW
+# the measured random-walk null at every one of 16 checkpoints
+# (runs/procmaze16_flat/eval_stats.jsonl: means 0.05-0.09 vs null
+# 0.1434 +/- 0.008 at n=2048) — the greedy policy learns a systematically
+# WORSE-than-random behavior at this scale. Round 4's warm-started
+# ladder (8x8 solved -> 12x12 +30k -> 16x16 +30k) was above its
+# baseline at every final checkpoint but under-powered: +0.02..+0.038
+# margins at n=256 are ~1-2 sigma each.
+#
+# This chain replicates the round-4 ladder EXACTLY (same recipe, same
+# budgets, fresh dirs — the r4 checkpoint dirs were cleaned at the
+# session boundary so no warm seed survives) and then measures the
+# 16x16 series with the round-5 z-instrument (runs/eval_stats.py) at
+# n=1024 episodes/checkpoint, which puts the per-checkpoint stderr at
+# ~0.009 and makes a +0.03 margin a ~3-sigma read. Verdict criteria
+# (pre-registered): final-three-checkpoint margins all positive with
+# pooled z >= 3 on their mean => the rung is decisively above-null via
+# transfer; positive but z < 3 => the honest "consistently above,
+# modest magnitude" read stands with real error bars; at/below null =>
+# the round-4 warm result does not replicate and the rung is recorded
+# as open.
+cd /root/repo
+while ! grep -q R5G_CHAIN_ALL_DONE runs/r5g_chain.log 2>/dev/null; do sleep 60; done
+
+. runs/lib.sh
+
+# rung 1: 8x8 from scratch (the round-3 recipe verbatim)
+run_with_retry python -m r2d2_tpu.train --preset procgen_impala --env procmaze_shaped:8 \
+  --mode fused --steps 30000 --updates-per-dispatch 16 \
+  --set checkpoint_dir=runs/procmaze8_r5/ckpt \
+  --set metrics_path=runs/procmaze8_r5/metrics.jsonl \
+  --set buffer_capacity=200000 --set learning_starts=30000 \
+  --set samples_per_insert=15.0 --set save_interval=3750 \
+  --set target_net_update_interval=500 --set forward_steps=20 --set num_actors=16
+echo "=== PROCMAZE8_R5 TRAIN EXIT: $? ==="
+
+# rung 2: 12x12 warm-started from the 8x8 policy (+30k)
+mkdir -p runs/procmaze12_warm2/ckpt
+if [ ! -d runs/procmaze12_warm2/ckpt/step_30000 ]; then
+  cp -r runs/procmaze8_r5/ckpt/step_30000 runs/procmaze12_warm2/ckpt/step_30000
+fi
+run_with_retry python -m r2d2_tpu.train --preset procgen_impala --env procmaze_shaped:12 \
+  --mode fused --steps 60000 --updates-per-dispatch 16 --resume \
+  --set checkpoint_dir=runs/procmaze12_warm2/ckpt \
+  --set metrics_path=runs/procmaze12_warm2/metrics.jsonl \
+  --set buffer_capacity=200000 --set learning_starts=30000 \
+  --set samples_per_insert=15.0 --set save_interval=3750 \
+  --set target_net_update_interval=500 --set forward_steps=20 --set num_actors=16
+echo "=== PROCMAZE12_WARM2 TRAIN EXIT: $? ==="
+
+# rung 3: 16x16 warm-started from the 12x12 policy (+30k)
+mkdir -p runs/procmaze16_warm2/ckpt
+if [ ! -d runs/procmaze16_warm2/ckpt/step_60000 ]; then
+  cp -r runs/procmaze12_warm2/ckpt/step_60000 runs/procmaze16_warm2/ckpt/step_60000
+fi
+run_with_retry python -m r2d2_tpu.train --preset procgen_impala --env procmaze_shaped:16 \
+  --mode fused --steps 90000 --updates-per-dispatch 16 --resume \
+  --set checkpoint_dir=runs/procmaze16_warm2/ckpt \
+  --set metrics_path=runs/procmaze16_warm2/metrics.jsonl \
+  --set buffer_capacity=200000 --set learning_starts=30000 \
+  --set samples_per_insert=15.0 --set save_interval=3750 \
+  --set target_net_update_interval=500 --set forward_steps=20 --set num_actors=16
+echo "=== PROCMAZE16_WARM2 TRAIN EXIT: $? ==="
+
+# the decisive measurement: n=1024/checkpoint, z vs the measured null
+python runs/eval_stats.py --preset procgen_impala --env procmaze_shaped:16 \
+  --ckpt runs/procmaze16_warm2/ckpt --episodes 1024 --null-episodes 2048 \
+  --set forward_steps=20 --set num_actors=16 \
+  --out runs/procmaze16_warm2/eval_stats.jsonl
+echo "=== PROCMAZE16_WARM2 STATS EXIT: $? ==="
+
+echo R5H_CHAIN_ALL_DONE
