@@ -14,7 +14,11 @@ hidden 128, LRU core, cosine lr, seq 212+, window-1-from-stored-state):
              requires the 72k series to reach its final checkpoint, so
              a crashed partial 72k run cannot displace the real point
   blind 270  long_context_mid12_L128  plateau at the null (round 4);
-             the ring-init arm (r 0.98/0.9999) also fails (round 5)
+             the ring-init arm (r 0.98/0.9999) also fails at the policy
+             level (round 5, retention repaired per the probe); the
+             chain-G compound arm ring x n-step-80
+             (long_context_mid12_ring_n80) SOLVES the rung — plotted as
+             a distinct diamond when its series exists
 
     python runs/plot_temporal_frontier.py --out runs/temporal_frontier.jpg
 """
@@ -102,11 +106,22 @@ def main():
         ax.annotate(f"{status(y, n)} ({y:.2f})", (x, y),
                     textcoords="offset points",
                     xytext=(0, 9), ha="center", fontsize=8, color=INK)
-    # the ring-init arm at 270: distinct marker, direct-labeled
+    # the 270-rung counter arms: distinct markers, direct-labeled.
+    # ring alone (retention repaired, credit not): fails at the policy
+    # level; ring x n-step 80 (chain G: retention AND credit attacked)
+    # solves the rung — plotted when its eval series exists.
     ring = final_mean("long_context_mid12_ring")
     ax.plot([270], [ring], color=BLUE, marker="x", ms=9, mew=2, ls="none")
     ax.annotate("ring-init arm r5", (270, ring), textcoords="offset points",
                 xytext=(4, -13), ha="right", fontsize=8, color=INK)
+    n80_path = os.path.join(HERE, "long_context_mid12_ring_n80", "eval.jsonl")
+    if os.path.exists(n80_path):
+        n80 = final_mean("long_context_mid12_ring_n80")
+        ax.plot([270], [n80], color=BLUE, marker="D", ms=8, ls="none",
+                mfc="none", mew=2)
+        ax.annotate(f"ring × n-step-80 arm r5 ({n80:.2f})", (270, n80),
+                    textcoords="offset points", xytext=(-4, 8), ha="right",
+                    fontsize=8, color=INK)
 
     ax.set_xlabel("blind span (steps the state must carry the cue)")
     ax.set_ylabel("eval mean reward")
