@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-5 chain I (queued behind chain H): the zero-state CONTROL at the
+# newly-solved blind-270 rung.
+#
+# Chain G solved memory_catch:10:12 (blind ~270) with ring x n-step 80
+# (runs/long_context_mid12_ring_n80: 1.0/0.97/0.97 sustained). The
+# strongest long-context ablation this repo can now run: the SAME
+# solving recipe with zero-state replay (true burn_in=0 after the
+# round-5 ordering fix). Geometry argument for why this is the clean
+# information-starvation test: learning windows are L=128 steps against
+# a ~270-step blind span, so NO window that starts at or after the cue's
+# end can see both the cue and the landing — the cue reaches the
+# learning window only through the stored recurrent carry. (Contrast
+# the mc84_full_lru_zerostate confound, where blind 22 < L=20+cue made
+# within-window carry possible, and the multi-ball control, where 3 of
+# 4 balls were within-window.)
+#
+# PRE-REGISTERED read: zero-state at/near the -0.504 null while the
+# stored arm holds 1.0 => stored-state replay is load-bearing at a
+# 270-step memory horizon, 2x the previous best controlled rung (126).
+# If the control LEARNS, that is an honest finding about what n-step-80
+# credit assignment can extract from within-episode state continuity at
+# eval time, and the row says so.
+cd /root/repo
+while ! grep -q R5H_CHAIN_ALL_DONE runs/r5h_chain.log 2>/dev/null; do sleep 60; done
+
+. runs/lib.sh
+
+run_with_retry python examples/long_context_demo.py --out runs/long_context_mid12_ring_n80_zs \
+  --env memory_catch:10:12 --steps 36000 --eval-episodes 4 \
+  --set obs_shape=26,26,1 --set encoder=impala --set impala_channels=8,16 \
+  --set hidden_dim=128 --set max_episode_steps=288 \
+  --set learning_steps=128 --set block_length=512 \
+  --set buffer_capacity=102400 --set learning_starts=40000 \
+  --set recurrent_core=lru --set lr_schedule=cosine \
+  --set lru_r_min=0.98 --set lru_r_max=0.9999 --set forward_steps=80 \
+  --ablate-zero-state
+echo "=== MID12_RING_N80_ZS EXIT: $? ==="
+EV=$(last_eval runs/long_context_mid12_ring_n80_zs/eval.jsonl)
+echo "=== MID12_RING_N80_ZS EVAL: $EV ==="
+
+echo R5I_CHAIN_ALL_DONE
