@@ -30,7 +30,15 @@ while ! grep -q R5G_CHAIN_ALL_DONE runs/r5g_chain.log 2>/dev/null; do sleep 60; 
 
 . runs/lib.sh
 
-# rung 1: 8x8 from scratch (the round-3 recipe verbatim)
+# rung 1: 8x8 from scratch (the round-3 recipe verbatim).
+# RELAUNCH NOTE: the first firing of this chain died at startup —
+# MetricsLogger open()s cfg.metrics_path without creating the parent
+# directory, and this script (unlike the r3/r4 chains) had no mkdir for
+# the rung-1 dir; worse, the failure CASCADED silently (rung 2's cp had
+# no source, rung 3's --resume on an empty ckpt dir started a useless
+# fresh 16x16 run). Fixed: mkdir -p per rung + a hard gate on the
+# previous rung's checkpoint existing before any warm rung may start.
+mkdir -p runs/procmaze8_r5
 run_with_retry python -m r2d2_tpu.train --preset procgen_impala --env procmaze_shaped:8 \
   --mode fused --steps 30000 --updates-per-dispatch 16 \
   --set checkpoint_dir=runs/procmaze8_r5/ckpt \
@@ -41,6 +49,11 @@ run_with_retry python -m r2d2_tpu.train --preset procgen_impala --env procmaze_s
 echo "=== PROCMAZE8_R5 TRAIN EXIT: $? ==="
 
 # rung 2: 12x12 warm-started from the 8x8 policy (+30k)
+if [ ! -d runs/procmaze8_r5/ckpt/step_30000 ]; then
+  echo "=== ABORT: rung-1 checkpoint missing; warm rungs would silently run fresh ==="
+  echo R5H_CHAIN_ALL_DONE
+  exit 1
+fi
 mkdir -p runs/procmaze12_warm2/ckpt
 if [ ! -d runs/procmaze12_warm2/ckpt/step_30000 ]; then
   cp -r runs/procmaze8_r5/ckpt/step_30000 runs/procmaze12_warm2/ckpt/step_30000
@@ -55,6 +68,11 @@ run_with_retry python -m r2d2_tpu.train --preset procgen_impala --env procmaze_s
 echo "=== PROCMAZE12_WARM2 TRAIN EXIT: $? ==="
 
 # rung 3: 16x16 warm-started from the 12x12 policy (+30k)
+if [ ! -d runs/procmaze12_warm2/ckpt/step_60000 ]; then
+  echo "=== ABORT: rung-2 checkpoint missing; warm rung would silently run fresh ==="
+  echo R5H_CHAIN_ALL_DONE
+  exit 1
+fi
 mkdir -p runs/procmaze16_warm2/ckpt
 if [ ! -d runs/procmaze16_warm2/ckpt/step_60000 ]; then
   cp -r runs/procmaze12_warm2/ckpt/step_60000 runs/procmaze16_warm2/ckpt/step_60000
