@@ -22,7 +22,9 @@
 # credit assignment can extract from within-episode state continuity at
 # eval time, and the row says so.
 cd /root/repo
-while ! grep -q R5H_CHAIN_ALL_DONE runs/r5h_chain.log 2>/dev/null; do sleep 60; done
+# Launched CONCURRENTLY with chain H rung 1 (which is samples_per_insert
+# throttle-bound at ~3 updates/s, ~3% chip duty cycle — measured before
+# co-scheduling; the serial gate was removed at relaunch).
 
 . runs/lib.sh
 
