@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-5 chain H2: the corrected warm rung (replaces chain H's rungs
+# 2-3). Chain H's rung 2 tried procmaze_shaped:12 — geometrically
+# invalid (obs 64 not divisible into a 12-cell grid), the SAME wall
+# round 4 hit before correcting its ladder to 8->16 directly
+# (runs/README.md procmaze16_warm row: "64 % 12 != 0, so 8->16 is the
+# real next rung"). This replicates the corrected round-4 protocol:
+# 16x16 warm-started from the solved 8x8 policy (step_30000 copied in,
+# --resume), 30k fresh updates, then the n=1024 z-instrument series.
+cd /root/repo
+. runs/lib.sh
+
+if [ ! -d runs/procmaze8_r5/ckpt/step_30000 ]; then
+  echo "=== ABORT: 8x8 seed checkpoint missing ==="
+  echo R5H2_CHAIN_ALL_DONE
+  exit 1
+fi
+mkdir -p runs/procmaze16_warm2/ckpt
+if [ ! -d runs/procmaze16_warm2/ckpt/step_30000 ]; then
+  cp -r runs/procmaze8_r5/ckpt/step_30000 runs/procmaze16_warm2/ckpt/step_30000
+fi
+run_with_retry python -m r2d2_tpu.train --preset procgen_impala --env procmaze_shaped:16 \
+  --mode fused --steps 60000 --updates-per-dispatch 16 --resume \
+  --set checkpoint_dir=runs/procmaze16_warm2/ckpt \
+  --set metrics_path=runs/procmaze16_warm2/metrics.jsonl \
+  --set buffer_capacity=200000 --set learning_starts=30000 \
+  --set samples_per_insert=15.0 --set save_interval=3750 \
+  --set target_net_update_interval=500 --set forward_steps=20 --set num_actors=16
+echo "=== PROCMAZE16_WARM2 TRAIN EXIT: $? ==="
+
+python runs/eval_stats.py --preset procgen_impala --env procmaze_shaped:16 \
+  --ckpt runs/procmaze16_warm2/ckpt --episodes 1024 --null-episodes 2048 \
+  --set forward_steps=20 --set num_actors=16 \
+  --out runs/procmaze16_warm2/eval_stats.jsonl
+echo "=== PROCMAZE16_WARM2 STATS EXIT: $? ==="
+
+echo R5H2_CHAIN_ALL_DONE
