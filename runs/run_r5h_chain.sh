@@ -1,4 +1,9 @@
 #!/bin/bash
+# SUPERSEDED after rung 1: the 12x12 rung below is geometrically invalid
+# (obs 64 not divisible into a 12-cell grid) — rungs 2-3 are replaced by
+# runs/run_r5h2_chain.sh (16x16 warm-started directly from the 8x8 seed,
+# the corrected round-4 protocol). Kept as provenance for the rung-1
+# (procmaze8_r5) invocation, which completed successfully.
 # Round-5 chain H (queued behind chain G): make the 16x16 procmaze rung
 # decisive on the POSITIVE side (VERDICT r4 item 5's first arm).
 #
