@@ -238,13 +238,16 @@ def main(
     core: str = "lstm",
     lru_chunk: int = 0,
     batch: int = 0,
+    emit: bool = True,
 ):
     """frame_multiplier: env frames per env step — 4 for Atari (frameskip,
     reference test.py:28,36), 1 for envs without frameskip. baseline: the
     denominator for vs_baseline. core/lru_chunk select the recurrent core
     (_core_overrides); batch > 0 overrides batch_size (the MFU
     shape-granularity probe — frames/s scales with batch by construction,
-    so cross-batch rows compare updates/s x batch, not the headline)."""
+    so cross-batch rows compare updates/s x batch, not the headline).
+    Returns the result row; emit=False suppresses the JSON print so matrix
+    drivers (learner_matrix_main) keep exactly one line on stdout."""
     cfg = cfg or default_atari().replace(
         compute_dtype="bfloat16",
         buffer_capacity=100_000,  # 250 block slots ~= 0.77 GB HBM obs store
@@ -377,16 +380,172 @@ def main(
     for t in threads:
         t.join(timeout=5.0)
 
+    row = {
+        "metric": metric,
+        "value": round(frames_per_sec, 1),
+        "unit": "env_frames/s",
+        "vs_baseline": round(frames_per_sec / baseline, 3),
+        "core": cfg.recurrent_core + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
+        "batch": cfg.batch_size,
+        "updates_per_sec": round(updates_per_sec, 2),
+    }
+    if emit:
+        print(json.dumps(row))
+    return row
+
+
+def learner_matrix_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0):
+    """Learner-mode driver: the headline is the BEST row of the batch
+    matrix, not a fixed batch size. Round 5 measured B=128 at 1.279M
+    env-frames/s — 27% above the B=64 row the headline used to report —
+    so pinning B=64 understated the chip. An explicit --batch still runs
+    exactly that one shape; batch=0 sweeps the matrix and emits one JSON
+    line carrying the winner (with its batch size) plus every row."""
+    if batch:
+        main(core=core, lru_chunk=lru_chunk, batch=batch)
+        return
+    rows = [
+        main(core=core, lru_chunk=lru_chunk, batch=bs, emit=False)
+        for bs in (64, 128)
+    ]
+    best = max(rows, key=lambda r: r["value"])
     print(
         json.dumps(
             {
-                "metric": metric,
+                **best,
+                "metric": "learner_env_frames_per_sec_per_chip",
+                "matrix": [
+                    {
+                        "batch": r["batch"],
+                        "value": r["value"],
+                        "updates_per_sec": r["updates_per_sec"],
+                    }
+                    for r in rows
+                ],
+            }
+        )
+    )
+
+
+def tiered_main(
+    core: str = "lstm",
+    lru_chunk: int = 0,
+    batch: int = 0,
+    capacity: int = 2_000_000,
+    K: int = 16,
+):
+    """Tiered-plane learner throughput AT FULL REPLAY CAPACITY: the store
+    holds `capacity` transitions in host RAM (2M default — the paper's
+    spec, 20x what the HBM plane's bench shape holds) while the staging
+    pipeline (replay/tiered_store.py) hides the host->HBM tunnel behind
+    the K-update scan. The JSON row reports updates/s AND the measured
+    H2D overlap fraction — the win condition is the tunnel disappearing
+    behind compute, not just the headline rate.
+
+    The store is filled to learning_starts only (np.zeros pages beyond the
+    filled prefix stay unmapped): sample/gather cost depends on the tree
+    and window shapes, not on how much of the 2M ring is resident."""
+    from r2d2_tpu.learner import make_stacked_batch_train_step
+    from r2d2_tpu.replay.tiered_store import TieredPrefetchPipeline, TieredReplayBuffer
+    from r2d2_tpu.utils.profiling import TransferTimer
+
+    cfg = default_atari().replace(
+        compute_dtype="bfloat16",
+        buffer_capacity=capacity,
+        replay_plane="tiered",
+        updates_per_dispatch=K,
+        **_core_overrides(core, lru_chunk),
+    )
+    if batch:
+        cfg = cfg.replace(batch_size=batch)
+    cfg.validate()
+    rng = np.random.default_rng(0)
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})", file=sys.stderr)
+
+    t0 = time.time()
+    replay = TieredReplayBuffer(cfg)
+    n_blocks = cfg.learning_starts // cfg.block_length + 5
+    for _ in range(n_blocks):
+        block = synth_block(cfg, rng)
+        prios = rng.uniform(0.5, 2.0, size=cfg.seqs_per_block).astype(np.float32)
+        replay.add_block(block, prios, None)
+    assert replay.can_sample()
+    print(
+        f"tiered replay: {len(replay)} transitions resident of "
+        f"{capacity} capacity ({n_blocks} blocks) in {time.time()-t0:.1f}s",
+        file=sys.stderr,
+    )
+
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    multi_step = make_stacked_batch_train_step(cfg, net, K)
+    timer = TransferTimer()
+    pipe = TieredPrefetchPipeline(
+        replay, np.random.default_rng(1), K, timer=timer
+    )
+    pending = [None]
+
+    def one_chunk():
+        nonlocal state
+        chunk = pipe.get()
+        state, metrics, priorities = multi_step(state, chunk.batch)
+        try:
+            priorities.copy_to_host_async()
+        except AttributeError:
+            pass
+        prev, pending[0] = pending[0], (priorities, chunk)
+        if prev is not None:
+            prios, c = prev
+            for row, idx in zip(np.asarray(prios), c.idxes):
+                replay.update_priorities(idx, row, c.old_ptr, c.old_advances)
+        return metrics
+
+    def sync() -> int:
+        return int(np.asarray(state.step))
+
+    t0 = time.time()
+    m = one_chunk()
+    sync()
+    print(f"compile+first chunk: {time.time()-t0:.1f}s loss={float(m['loss']):.4f}", file=sys.stderr)
+    for _ in range(4):
+        m = one_chunk()
+    sync()
+    timer.reset()  # overlap window excludes compile/warmup chunks
+
+    target_seconds = 20.0
+    n_updates = 0
+    t0 = time.time()
+    while time.time() - t0 < target_seconds:
+        m = one_chunk()
+        n_updates += K
+    sync()
+    elapsed = time.time() - t0
+    final_loss = float(m["loss"])
+    pipe.stop()
+    if pending[0] is not None:  # final in-flight priority chunk
+        prios, c = pending[0]
+        for row, idx in zip(np.asarray(prios), c.idxes):
+            replay.update_priorities(idx, row, c.old_ptr, c.old_advances)
+
+    updates_per_sec = n_updates / elapsed
+    frames_per_sec = updates_per_sec * cfg.batch_size * cfg.learning_steps * 4
+    print(
+        f"{n_updates} updates in {elapsed:.1f}s = {updates_per_sec:.2f} updates/s "
+        f"(final loss {final_loss:.4f})",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "tiered_learner_env_frames_per_sec_per_chip",
                 "value": round(frames_per_sec, 1),
                 "unit": "env_frames/s",
-                "vs_baseline": round(frames_per_sec / baseline, 3),
-                "core": cfg.recurrent_core + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
-                "batch": cfg.batch_size,
+                "vs_baseline": round(frames_per_sec / BASELINE_FRAMES_PER_SEC, 3),
                 "updates_per_sec": round(updates_per_sec, 2),
+                "replay_capacity_transitions": capacity,
+                "batch": cfg.batch_size,
+                "core": cfg.recurrent_core + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
+                **timer.stats(),
             }
         )
     )
@@ -477,7 +636,17 @@ if __name__ == "__main__":
     p.add_argument(
         "--batch", type=int, default=0,
         help="learner mode: override batch_size (shape-granularity probe; "
-             "0 = preset default 64)",
+             "0 = best-of-matrix sweep over {64, 128})",
+    )
+    p.add_argument(
+        "--plane", default="device", choices=["device", "tiered"],
+        help="learner mode: replay plane under the bench — device (HBM "
+             "store, fused in-jit gather) or tiered (full-capacity host "
+             "store + double-buffered HBM staging pipeline)",
+    )
+    p.add_argument(
+        "--capacity", type=int, default=2_000_000,
+        help="tiered plane: replay capacity in transitions (host RAM)",
     )
     args = p.parse_args()
     if args.mode == "system":
@@ -486,5 +655,7 @@ if __name__ == "__main__":
         fused_system_main(args.collect_every, args.core, args.lru_chunk)
     elif args.mode == "long_context":
         long_context_main(args.core, args.lru_chunk)
+    elif args.plane == "tiered":
+        tiered_main(args.core, args.lru_chunk, args.batch, args.capacity)
     else:
-        main(core=args.core, lru_chunk=args.lru_chunk, batch=args.batch)
+        learner_matrix_main(args.core, args.lru_chunk, args.batch)

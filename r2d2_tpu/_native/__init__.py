@@ -74,6 +74,12 @@ class NativeReplayCore:
                                        _i64p, _i64p, ctypes.c_int64,
                                        ctypes.c_int64, _u8p]
         lib.gather_windows.restype = None
+        lib.gather_windows_multi.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), _i64p, ctypes.c_int64,
+            ctypes.c_int64, _i64p, _i64p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p),
+        ]
+        lib.gather_windows_multi.restype = None
         lib.is_weights.argtypes = [_f64p, ctypes.c_int64, _i64p,
                                    ctypes.c_int64, ctypes.c_double, _f32p]
         lib.is_weights.restype = ctypes.c_int64
@@ -122,6 +128,34 @@ class NativeReplayCore:
         )
         return out
 
+    def gather_windows_multi(self, stores, b: np.ndarray,
+                             win_start: np.ndarray, T: int) -> list:
+        """Gather the SAME (b, win_start) windows from several stores that
+        share the slot axis, in ONE native call (one ctypes crossing + one
+        OMP region for the whole field group). Returns one (B, T,
+        *row_shape) array per store; clamp semantics identical to
+        gather_windows (bit-identical outputs, pinned by test)."""
+        b = np.ascontiguousarray(b, np.int64)
+        win_start = np.ascontiguousarray(win_start, np.int64)
+        B = len(b)
+        slot = stores[0].shape[1]
+        outs, row_bytes = [], np.empty(len(stores), np.int64)
+        store_ptrs = (ctypes.c_void_p * len(stores))()
+        out_ptrs = (ctypes.c_void_p * len(stores))()
+        for f, store in enumerate(stores):
+            assert store.flags["C_CONTIGUOUS"] and store.shape[1] == slot
+            row_shape = store.shape[2:]
+            row_bytes[f] = int(np.prod(row_shape, dtype=np.int64)) * store.itemsize
+            out = np.empty((B, T, *row_shape), store.dtype)
+            outs.append(out)
+            store_ptrs[f] = store.ctypes.data
+            out_ptrs[f] = out.ctypes.data
+        self._lib.gather_windows_multi(
+            store_ptrs, row_bytes, len(stores), slot, b, win_start, B, T,
+            out_ptrs,
+        )
+        return outs
+
 
 def load_native() -> Optional[NativeReplayCore]:
     """Build (if needed) and load the core; None if the toolchain or load
@@ -140,7 +174,11 @@ def load_native() -> Optional[NativeReplayCore]:
         try:
             lib = ctypes.CDLL(_LIB)
             _core = NativeReplayCore(lib)
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale .so missing a newer entry point (e.g.
+            # hand-copied into an image whose mtime defeats the rebuild
+            # check) — degrade to the numpy path instead of crashing every
+            # importer, including pytest collection of the -m native tests
             _load_failed = True
             return None
         return _core

@@ -96,6 +96,41 @@ void gather_windows(const uint8_t* store, int64_t slot, int64_t row_bytes,
   }
 }
 
+// Multi-field window gather: one call gathers the SAME (b, win_start)
+// windows from num_fields stores that share the slot axis (e.g. the
+// obs/last_action/last_reward group, or the action/reward/gamma learning
+// group). The tiered plane's K-batch staging path flattens its (K, B)
+// coordinates and crosses ctypes ONCE per field group instead of once per
+// (field, batch); the single OMP region load-balances the whole slab
+// (fields have wildly different row sizes — obs rows are ~7 KB, scalar
+// rows 1-4 bytes — so collapsing fields x windows into one schedule keeps
+// every thread busy). Field f is a (num_blocks, slot, ...) store of
+// row_bytes[f]-sized rows; clamp semantics identical to gather_windows.
+void gather_windows_multi(const uint8_t* const* stores,
+                          const int64_t* row_bytes, int64_t num_fields,
+                          int64_t slot, const int64_t* b,
+                          const int64_t* win_start, int64_t B, int64_t T,
+                          uint8_t* const* outs) {
+#pragma omp parallel for collapse(2) schedule(static)
+  for (int64_t f = 0; f < num_fields; ++f) {
+    for (int64_t i = 0; i < B; ++i) {
+      const int64_t rb = row_bytes[f];
+      const uint8_t* block = stores[f] + b[i] * slot * rb;
+      uint8_t* dst = outs[f] + i * T * rb;
+      const int64_t start = win_start[i];
+      if (start >= 0 && start + T <= slot) {
+        std::memcpy(dst, block + start * rb, T * rb);
+        continue;
+      }
+      for (int64_t t = 0; t < T; ++t) {
+        int64_t row = start + t;
+        row = row < 0 ? 0 : (row >= slot ? slot - 1 : row);
+        std::memcpy(dst + t * rb, block + row * rb, rb);
+      }
+    }
+  }
+}
+
 // Priority-of-leaves lookup plus IS-weight computation in one pass:
 // w_i = (max(p_i, min_positive_p) / min_positive_p)^-beta
 // (reference priority_tree.py:40-42 with the zero-leaf clamp of

@@ -166,10 +166,13 @@ class R2D2Config:
     metrics_path: Optional[str] = None  # jsonl metrics file
     use_native_replay: bool = True  # C++ replay core if built, else numpy
     # replay data plane: "host" (numpy store, batches shipped per update),
-    # "device" (HBM store + fused in-jit gather, single chip), "sharded"
-    # (HBM store sharded over the dp mesh axis + shard_map train step),
-    # "multihost" (per-process local shards over a GLOBAL mesh — the
-    # jax.distributed scale-out of "sharded"; replay/multihost_store.py)
+    # "tiered" (full-capacity host store + double-buffered HBM staging
+    # pipeline hiding the tunnel behind the K-update scan;
+    # replay/tiered_store.py), "device" (HBM store + fused in-jit gather,
+    # single chip), "sharded" (HBM store sharded over the dp mesh axis +
+    # shard_map train step), "multihost" (per-process local shards over a
+    # GLOBAL mesh — the jax.distributed scale-out of "sharded";
+    # replay/multihost_store.py)
     replay_plane: str = "host"
     # experience collection: "host" (VectorizedActor — batched jitted
     # policy, env stepped on host) or "device" (collect.DeviceCollector —
@@ -292,7 +295,9 @@ class R2D2Config:
                         f"last ball lands (needs >= {need}): every episode "
                         "would end reward-free"
                     )
-        if self.replay_plane not in ("host", "device", "sharded", "multihost"):
+        if self.replay_plane not in (
+            "host", "tiered", "device", "sharded", "multihost"
+        ):
             raise ValueError(f"unknown replay_plane {self.replay_plane!r}")
         if self.replay_plane == "multihost":
             if self.tp_size != 1:
@@ -302,18 +307,19 @@ class R2D2Config:
         if self.updates_per_dispatch < 1:
             raise ValueError("updates_per_dispatch must be >= 1")
         if self.updates_per_dispatch > 1 and self.replay_plane not in (
-            "device", "sharded", "multihost"
+            "tiered", "device", "sharded", "multihost"
         ):
             raise ValueError(
-                "updates_per_dispatch > 1 is implemented for the device, "
-                "sharded, and multihost replay planes (fused in-jit gathers)"
+                "updates_per_dispatch > 1 is implemented for the tiered, "
+                "device, sharded, and multihost replay planes (fused in-jit "
+                "gathers / staged K-batch chunks)"
             )
         if self.training_steps % self.updates_per_dispatch != 0:
             raise ValueError(
                 "training_steps must be a multiple of updates_per_dispatch "
                 "(each dispatch advances the step counter by that amount)"
             )
-        if self.collector == "device" and self.replay_plane == "host":
+        if self.collector == "device" and self.replay_plane in ("host", "tiered"):
             raise ValueError(
                 "collector='device' writes packed blocks straight into the "
                 "HBM store; it requires replay_plane='device', 'sharded', "

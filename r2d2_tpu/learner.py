@@ -400,6 +400,41 @@ def make_batch_train_step(cfg: R2D2Config, net: R2D2Network, donate: bool = True
     return jax.jit(raw, donate_argnums=(0, 1) if donate else ())
 
 
+def make_stacked_batch_train_step(
+    cfg: R2D2Config, net: R2D2Network, num_steps: int, donate: bool = True
+):
+    """K train steps in ONE dispatch over a PRE-GATHERED stacked batch: the
+    tiered plane's consumer. make_fused_multi_train_step's scan gathers each
+    iteration's batch from the HBM-resident store; here the gather already
+    happened on host at stage time (replay/tiered_store.py), so the scan is
+    re-pointed at the staging slab — a DeviceBatch whose leaves carry a
+    leading (K, ...) axis — and each iteration just slices its batch off.
+
+    Donating the batch (argnum 1) is what closes the staging ring: the
+    consumed slab's HBM is recycled into the next device_put instead of
+    accumulating a third live copy.
+
+    Signature: (state, stacked DeviceBatch with (K, B, ...) leaves) ->
+    (state, metrics-of-last-step, priorities (K, B))."""
+    raw = _raw_train_step(cfg, net)
+
+    def multi(state: TrainState, stacked: DeviceBatch):
+        if stacked.obs.shape[0] != num_steps:
+            raise ValueError(
+                f"staged batch has {stacked.obs.shape[0]} steps, "
+                f"expected {num_steps}"
+            )
+
+        def body(state, batch):
+            state, metrics, prios = raw(state, batch)
+            return state, (metrics, prios)
+
+        state, (metrics, prios) = jax.lax.scan(body, state, stacked)
+        return state, jax.tree.map(lambda x: x[-1], metrics), prios
+
+    return jax.jit(multi, donate_argnums=(0, 1) if donate else ())
+
+
 def make_sharded_gather_step(cfg: R2D2Config, mesh):
     """shard_map gather over the dp-sharded stores: each device gathers its
     (B/dp) sub-batch locally; the result is one global DeviceBatch with

@@ -1,0 +1,279 @@
+"""Tiered replay plane, L3 half: full-capacity host store + HBM staging.
+
+The capacity/throughput dilemma this closes (VERDICT round 5): the HBM
+plane (replay/device_store.py) serves 1M+ env-frames/s but only at
+capacities that fit on-chip (~100k transitions of 84x84 obs), while the
+host plane holds the paper's full 2x10^6 transitions but is tunnel-bound
+at 0.4-3 updates/s — every batch pays a blocking host->device copy plus
+per-field transfer latency, serialized ahead of its update.
+
+Tiering splits the difference:
+
+- The RESIDENT tier is the host-RAM slab store, unchanged from
+  ReplayBuffer (same preallocated per-field arrays, same add_block, same
+  shared control plane) — np.zeros allocation is lazy on Linux, so a 2M
+  config costs physical pages only for the filled prefix.
+- The STAGING tier is a pair of HBM slabs holding K sample-batches'
+  gathered windows each. `sample_window_stack` draws K batches under ONE
+  control-plane lock hold and gathers ALL their sequence windows in one
+  vectorized pass: the (K, B) coordinates are flattened and each field
+  GROUP crosses into the native core once (gather_windows_multi,
+  _native/replay_core.cpp) — host assembly is memcpy-bound, not
+  Python-loop-bound. `stage_chunk` then starts one async `device_put` of
+  the whole stacked pytree; TieredPrefetchPipeline runs that on a staging
+  thread so the transfer of chunk k+1 executes while the learner's fused
+  K-update scan (learner.make_stacked_batch_train_step) consumes chunk k.
+
+Staleness is applied AT STAGE TIME: the gather copies bytes out of the
+resident tier under the lock, so a staged chunk can never be invalidated
+by a concurrent block write — there is nothing pointer-like left in it.
+The old_ptr/old_advances stamps captured in the same lock hold ride along
+so the deferred priority write-back still passes through the standard
+pointer-window mask (control_plane.update_priorities): rows whose slots
+were overwritten between stage and write-back are dropped, never
+mis-applied.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import queue
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+
+
+@dataclasses.dataclass
+class StagedWindows:
+    """K sample-batches' windows, stacked (K, B, ...) on host — the field
+    set of SampledBatch with a leading K axis, plus the stage-time stamps
+    shared by the whole chunk (all K draws happen under one lock hold)."""
+
+    obs: np.ndarray            # (K, B, seq_len, *obs_shape) uint8
+    last_action: np.ndarray    # (K, B, seq_len) uint8
+    last_reward: np.ndarray    # (K, B, seq_len) float32
+    hidden: np.ndarray         # (K, B, 2, H) float32
+    action: np.ndarray         # (K, B, L) int32
+    n_step_reward: np.ndarray  # (K, B, L) float32
+    gamma: np.ndarray          # (K, B, L) float32
+    burn_in_steps: np.ndarray  # (K, B) int32
+    learning_steps: np.ndarray # (K, B) int32
+    forward_steps: np.ndarray  # (K, B) int32
+    is_weights: np.ndarray     # (K, B) float32
+    idxes: np.ndarray          # (K, B) int64 — for the priority write-back
+    old_ptr: int
+    env_steps: int
+    old_advances: int
+
+    def nbytes(self) -> int:
+        return sum(
+            getattr(self, f.name).nbytes
+            for f in dataclasses.fields(self)
+            if f.name not in ("old_ptr", "env_steps", "old_advances")
+        )
+
+
+@dataclasses.dataclass
+class StagedChunk:
+    """A StagedWindows after lift-off: `batch` is a stacked
+    learner.DeviceBatch (leaves (K, B, ...)) whose device_put has been
+    started; the stamps stay host-side for the priority write-back."""
+
+    batch: object
+    idxes: np.ndarray
+    old_ptr: int
+    old_advances: int
+    env_steps: int
+
+
+class TieredReplayBuffer(ReplayBuffer):
+    """ReplayBuffer (full-capacity host data plane, shared control plane)
+    plus the vectorized K-batch window gather the staging tier feeds on.
+
+    The single-batch `sample_batch` path is inherited untouched — it is the
+    executable spec `sample_window_stack` must match bit-for-bit (pinned by
+    tests/test_tiered_store.py): same RNG stream consumption (K stratified
+    tree draws in order), same clamp semantics, same dtypes, same stamps."""
+
+    def sample_window_stack(self, rng: np.random.Generator, k: int) -> StagedWindows:
+        cfg = self.cfg
+        L, T, B = cfg.learning_steps, cfg.seq_len, cfg.batch_size
+        with self.lock:
+            draws = [self._draw(rng) for _ in range(k)]
+            # flattened (K*B,) coordinates: one gather per field group
+            b = np.concatenate([d[0] for d in draws])
+            s = np.concatenate([d[1] for d in draws])
+            idxes = np.stack([d[2] for d in draws])
+            is_weights = np.stack([d[3] for d in draws])
+
+            burn = self.burn_in_store[b, s]
+            learn = self.learning_store[b, s]
+            fwd = self.forward_store[b, s]
+            first_burn = self.burn_in_store[b, 0]
+            win_start = first_burn + s * L - burn
+            lstart = s * L
+
+            if self.native is not None:
+                obs, last_action, last_reward = self.native.gather_windows_multi(
+                    [self.obs_store, self.last_action_store, self.last_reward_store],
+                    b, win_start, T,
+                )
+                action, n_step_reward, gamma = self.native.gather_windows_multi(
+                    [self.action_store, self.n_step_reward_store, self.gamma_store],
+                    b, lstart, L,
+                )
+                action = action.astype(np.int32)
+            else:
+                t = np.arange(T)
+                rows = win_start[:, None] + t[None, :]
+                np.clip(rows, 0, cfg.block_slot_len - 1, out=rows)
+                bcol = b[:, None]
+                obs = self.obs_store[bcol, rows]
+                last_action = self.last_action_store[bcol, rows]
+                last_reward = self.last_reward_store[bcol, rows]
+                tl = np.arange(L)
+                lrows = lstart[:, None] + tl[None, :]
+                np.clip(lrows, 0, cfg.block_length - 1, out=lrows)
+                action = self.action_store[bcol, lrows].astype(np.int32)
+                n_step_reward = self.n_step_reward_store[bcol, lrows]
+                gamma = self.gamma_store[bcol, lrows]
+
+            hidden = self.hidden_store[b, s]
+            old_ptr = self.block_ptr
+            env_steps = self.env_steps
+            old_advances = self.ptr_advances
+
+        def kb(x):
+            return x.reshape(k, B, *x.shape[1:])
+
+        return StagedWindows(
+            obs=kb(obs),
+            last_action=kb(last_action),
+            last_reward=kb(last_reward),
+            hidden=kb(hidden),
+            action=kb(action),
+            n_step_reward=kb(n_step_reward),
+            gamma=kb(gamma),
+            burn_in_steps=kb(burn.astype(np.int32)),
+            learning_steps=kb(learn.astype(np.int32)),
+            forward_steps=kb(fwd.astype(np.int32)),
+            is_weights=is_weights,
+            idxes=idxes,
+            old_ptr=old_ptr,
+            env_steps=env_steps,
+            old_advances=old_advances,
+        )
+
+
+def stage_chunk(replay: TieredReplayBuffer, rng: np.random.Generator, k: int,
+                timer=None) -> StagedChunk:
+    """Draw + host-gather + lift one K-batch chunk into HBM.
+
+    The device_put covers the whole stacked pytree in one call (one
+    transfer program, not 11 per update like the inline host plane), and
+    the trailing block_until_ready makes the h2d span measure true
+    transfer completion — callers run this off the critical path (staging
+    thread), so blocking here costs the consumer nothing. `timer` is a
+    utils.profiling.TransferTimer or None."""
+    import jax
+
+    from r2d2_tpu.learner import DeviceBatch
+
+    sw = replay.sample_window_stack(rng, k)
+    cm = timer.h2d(sw.nbytes()) if timer is not None else contextlib.nullcontext()
+    with cm:
+        batch = jax.device_put(DeviceBatch(
+            obs=sw.obs,
+            last_action=sw.last_action.astype(np.int32),
+            last_reward=sw.last_reward,
+            hidden=sw.hidden,
+            action=sw.action,
+            n_step_reward=sw.n_step_reward,
+            gamma=sw.gamma,
+            burn_in_steps=sw.burn_in_steps,
+            learning_steps=sw.learning_steps,
+            forward_steps=sw.forward_steps,
+            is_weights=sw.is_weights,
+        ))
+        jax.block_until_ready(batch)
+    return StagedChunk(
+        batch=batch,
+        idxes=sw.idxes,
+        old_ptr=sw.old_ptr,
+        old_advances=sw.old_advances,
+        env_steps=sw.env_steps,
+    )
+
+
+class TieredPrefetchPipeline:
+    """Double-buffered staging: a daemon thread stages chunk k+1 (host
+    gather + async device_put) while the consumer's fused K-update scan
+    executes chunk k.
+
+    depth=1 (the default) is the double buffer: one chunk ready in the
+    queue + one being consumed; the thread starts gathering the next only
+    after the queued one is taken, so steady-state HBM holds two staging
+    slabs — and the consumed slab's buffers are donated back by
+    make_stacked_batch_train_step, which is what makes the pair a ring
+    rather than a leak. The bounded queue IS the backpressure: a slow
+    consumer (compiling, checkpointing) simply stalls staging; a slow
+    stager surfaces as TransferTimer wait time (overlap fraction < 1).
+
+    A crash on the staging thread (malformed store, OOM) is re-raised from
+    get() instead of starving the consumer silently."""
+
+    def __init__(self, replay: TieredReplayBuffer, rng: np.random.Generator,
+                 k: int, timer=None, depth: int = 1):
+        self.replay = replay
+        self.rng = rng
+        self.k = k
+        self.timer = timer
+        self.q: "queue.Queue[StagedChunk]" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, name="tiered-stage", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                if not self.replay.can_sample():
+                    # constructed pre-warmup (bench convenience): idle until
+                    # the sampling gate opens instead of crashing on an
+                    # all-zero tree
+                    time.sleep(0.01)
+                    continue
+                chunk = stage_chunk(self.replay, self.rng, self.k, self.timer)
+                while not self._stop.is_set():
+                    try:
+                        self.q.put(chunk, timeout=0.1)
+                        break
+                    except queue.Full:
+                        pass
+        except BaseException as e:  # noqa: BLE001 — re-raised from get()
+            self._err = e
+
+    def get(self) -> StagedChunk:
+        """Next staged chunk; the block time (the un-hidden part of the
+        tunnel) is recorded as TransferTimer wait."""
+        cm = self.timer.wait() if self.timer is not None else contextlib.nullcontext()
+        with cm:
+            while True:
+                if self._err is not None:
+                    raise RuntimeError("tiered staging thread died") from self._err
+                try:
+                    return self.q.get(timeout=0.5)
+                except queue.Empty:
+                    if not self._thread.is_alive() and self._err is None:
+                        raise RuntimeError("tiered staging thread exited")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10.0)

@@ -47,6 +47,7 @@ from r2d2_tpu.learner import (
     make_gather_step,
     make_sharded_fused_train_step,
     make_sharded_gather_step,
+    make_stacked_batch_train_step,
     make_train_step,
 )
 from r2d2_tpu.ops.epsilon import epsilon_ladder
@@ -54,9 +55,10 @@ from r2d2_tpu.parallel.mesh import make_mesh, replicated_sharding, shard_batch
 from r2d2_tpu.replay.device_store import DeviceReplayBuffer
 from r2d2_tpu.replay.replay_buffer import ReplayBuffer
 from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
+from r2d2_tpu.replay.tiered_store import TieredPrefetchPipeline, TieredReplayBuffer
 from r2d2_tpu.utils.checkpoint import latest_checkpoint_step, restore_checkpoint, save_checkpoint
 from r2d2_tpu.utils.metrics import MetricsLogger
-from r2d2_tpu.utils.profiling import span, start_profiler_server, step_span
+from r2d2_tpu.utils.profiling import TransferTimer, span, start_profiler_server, step_span
 from r2d2_tpu.utils.supervision import Supervisor, WorkerStalledError
 
 
@@ -145,6 +147,82 @@ class _HostPlane:
         state, m, priorities = self.step_fn(state, dev)
         self.replay.update_priorities(idxes, np.asarray(priorities), old_ptr, old_adv)
         return state, m
+
+
+class _TieredPlane:
+    """Full-capacity host store + double-buffered HBM staging
+    (replay/tiered_store.py): the plane that serves the paper's 2M-
+    transition capacity at device-plane update throughput.
+
+    A staging thread draws K batches under one lock hold, host-gathers
+    their windows through the vectorized native multi-gather, and lifts
+    the stacked chunk into HBM while the learner's K-update scan
+    (make_stacked_batch_train_step) consumes the previous chunk — the
+    host->device tunnel runs behind compute instead of ahead of it. The
+    priority readback is deferred one dispatch exactly like _DevicePlane's;
+    staleness needs no extra machinery because chunks are BY-VALUE (bytes
+    copied out at stage time) and carry their stage-time window stamps.
+    The TransferTimer's overlap fraction lands in the metrics stream via
+    log_extras."""
+
+    def __init__(self, tr: "Trainer"):
+        self.tr = tr
+        self.replay = TieredReplayBuffer(tr.cfg)
+        self.K = self.steps_per_update = tr.cfg.updates_per_dispatch
+        self._pending = None  # deferred (priorities, chunk) readback
+        self.xfer = TransferTimer()
+        self.multi_fn = make_stacked_batch_train_step(tr.cfg, tr.net, self.K)
+        self._pipe: Optional[TieredPrefetchPipeline] = None
+
+    def _ensure_pipeline(self) -> TieredPrefetchPipeline:
+        # lazy: started on first sample, i.e. after warmup opened the
+        # sampling gate (and restartable after a finish_updates drain)
+        if self._pipe is None:
+            self._pipe = TieredPrefetchPipeline(
+                self.replay, self.tr.sample_rng, self.K, timer=self.xfer
+            )
+        return self._pipe
+
+    def sample(self, pipelined: bool = False):
+        # both modes consume the staging pipeline: it IS the prefetcher
+        # (threaded mode's sampler thread just forwards chunks into its
+        # queue, adding one more buffered chunk of depth)
+        with span("replay/staged_chunk"):
+            return "staged", self._ensure_pipeline().get(), None, None
+
+    def update(self, state, item):
+        _, chunk, _, _ = item
+        state, m, priorities = self.multi_fn(state, chunk.batch)
+        try:
+            priorities.copy_to_host_async()
+        except AttributeError:
+            pass
+        # deferred one dispatch (_DevicePlane._multi_update rationale): the
+        # readback lands while the NEXT chunk executes
+        prev, self._pending = self._pending, (priorities, chunk)
+        if prev is not None:
+            self.drain_pending(prev)
+        return state, m
+
+    def drain_pending(self, pending=None) -> None:
+        """Apply a deferred (priorities, chunk) pair. Called with the
+        previous pair each update; called with no argument on run-mode
+        exit, where it ALSO stops the staging thread — an undrained staged
+        chunk is simply dropped (by-value bytes, no tree writes pending),
+        leaving the sum tree consistent."""
+        if pending is None:
+            if self._pipe is not None:
+                self._pipe.stop()
+                self._pipe = None
+            pending, self._pending = self._pending, None
+        if pending is None:
+            return
+        prios, chunk = pending
+        for row, idx in zip(np.asarray(prios), chunk.idxes):
+            self.replay.update_priorities(idx, row, chunk.old_ptr, chunk.old_advances)
+
+    def log_extras(self) -> dict:
+        return self.xfer.stats()
 
 
 class _DevicePlane:
@@ -380,6 +458,7 @@ class _MultiHostPlane:
 
 _PLANES = {
     "host": _HostPlane,
+    "tiered": _TieredPlane,
     "device": _DevicePlane,
     "sharded": _ShardedPlane,
     "multihost": _MultiHostPlane,
@@ -656,6 +735,9 @@ class Trainer:
             self._profile_remaining = 0
 
     def _log(self, m, step, extra: Optional[dict] = None):
+        log_extras = getattr(self.plane, "log_extras", None)
+        if log_extras is not None:
+            extra = {**(extra or {}), **log_extras()}
         n_ep, r_sum = self.replay.pop_episode_stats()
         if self.cfg.replay_plane == "multihost" and jax.process_count() > 1:
             # env_steps_offset is a GLOBAL restored total (the snapshot
@@ -1002,7 +1084,7 @@ def main(argv=None):
                    help="fused: one dispatch = K updates + collection chunk "
                         "(collector='device' + replay 'device' only)")
     p.add_argument("--replay", default=None,
-                   choices=["host", "device", "sharded", "multihost"],
+                   choices=["host", "tiered", "device", "sharded", "multihost"],
                    help="replay data plane (default: preset's replay_plane)")
     p.add_argument("--distributed", action="store_true",
                    help="initialize jax.distributed from the standard env "

@@ -45,13 +45,29 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 # line) must not displace the finished 36k chain-B point.
 
 
-def _mid11_run():
-    path = os.path.join(HERE, "long_context_mid11_72k", "eval.jsonl")
+def _read_series(run):
+    """Parsed eval.jsonl rows for a run, or None with a log line when the
+    file is missing or torn (the _mid11_run guard, generalized: a crashed
+    or mid-write run must be SKIPPED, not crash the render or silently
+    plot a partial series — ADVICE.md round 5 lows)."""
+    path = os.path.join(HERE, run, "eval.jsonl")
     try:
         rows = [json.loads(l) for l in open(path) if l.strip()]
+    except (OSError, ValueError) as e:
+        print(f"skip {run}: unreadable eval series ({e})")
+        return None
+    if not rows:
+        print(f"skip {run}: empty eval series")
+        return None
+    return rows
+
+
+def _mid11_run():
+    rows = _read_series("long_context_mid11_72k")
+    try:
         if rows and rows[-1]["step"] >= 72000:
             return "long_context_mid11_72k"
-    except (OSError, ValueError, KeyError):
+    except (KeyError, TypeError):
         pass
     return "long_context_mid11"
 
@@ -76,16 +92,34 @@ def status(final, null):
 BLUE, GRAY, INK = "#1f77b4", "#7f7f7f", "#444444"
 
 
-def final_mean(run, k=3):
-    rows = [json.loads(l) for l in open(os.path.join(HERE, run, "eval.jsonl"))
-            if l.strip()]
-    vals = [r["mean_reward"] for r in rows[-k:]]
+def final_mean(run, k=3, require_step=None):
+    """Mean of the final k checkpoints' eval reward, or None (logged) when
+    the series is missing, torn, or — with require_step — hasn't reached
+    its final checkpoint (a partial run must not pose as a finished one)."""
+    rows = _read_series(run)
+    if rows is None:
+        return None
+    try:
+        if require_step is not None and rows[-1]["step"] < require_step:
+            print(
+                f"skip {run}: series ends at step {rows[-1]['step']} "
+                f"< required {require_step}"
+            )
+            return None
+        vals = [r["mean_reward"] for r in rows[-k:]]
+    except (KeyError, TypeError) as e:
+        print(f"skip {run}: malformed eval rows ({e!r})")
+        return None
     return sum(vals) / len(vals)
 
 
 def null_mean(run):
-    with open(os.path.join(HERE, run, "baseline.json")) as f:
-        return json.load(f)["random_mean_reward"]
+    try:
+        with open(os.path.join(HERE, run, "baseline.json")) as f:
+            return json.load(f)["random_mean_reward"]
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        print(f"skip {run}: unreadable baseline ({e!r})")
+        return None
 
 
 def main():
@@ -93,30 +127,43 @@ def main():
     p.add_argument("--out", default=os.path.join(HERE, "temporal_frontier.jpg"))
     args = p.parse_args()
 
-    xs = [r[0] for r in RUNGS]
-    evals = [final_mean(r[1]) for r in RUNGS]
-    nulls = [null_mean(r[2]) for r in RUNGS]
+    # only rungs whose eval series AND null both read cleanly are plotted;
+    # the rest are skipped with a log line (already printed by the readers)
+    points = []
+    for x, run, null_run in RUNGS:
+        y, n = final_mean(run), null_mean(null_run)
+        if y is None or n is None:
+            print(f"skip rung {x}: incomplete data")
+            continue
+        points.append((x, run, y, n))
+    if not points:
+        raise SystemExit("no rung has a complete eval + null series")
+    xs = [p[0] for p in points]
+    evals = [p[2] for p in points]
+    nulls = [p[3] for p in points]
 
     fig, ax = plt.subplots(figsize=(7.2, 4.2))
     ax.plot(xs, nulls, color=GRAY, ls=":", lw=2, marker="s", ms=6,
             label="measured random-walk null (n=2048)")
     ax.plot(xs, evals, color=BLUE, lw=2, marker="o", ms=8,
             label="trained, mean of final 3 checkpoints (n=64 each)")
-    for (x, run, _), y, n in zip(RUNGS, evals, nulls):
+    for x, run, y, n in points:
         ax.annotate(f"{status(y, n)} ({y:.2f})", (x, y),
                     textcoords="offset points",
                     xytext=(0, 9), ha="center", fontsize=8, color=INK)
     # the 270-rung counter arms: distinct markers, direct-labeled.
     # ring alone (retention repaired, credit not): fails at the policy
     # level; ring x n-step 80 (chain G: retention AND credit attacked)
-    # solves the rung — plotted when its eval series exists.
+    # solves the rung — each plotted only when its series reads cleanly
+    # (and, for the n80 diamond, reached its final 36000-step checkpoint).
     ring = final_mean("long_context_mid12_ring")
-    ax.plot([270], [ring], color=BLUE, marker="x", ms=9, mew=2, ls="none")
-    ax.annotate("ring-init arm r5", (270, ring), textcoords="offset points",
-                xytext=(4, -13), ha="right", fontsize=8, color=INK)
-    n80_path = os.path.join(HERE, "long_context_mid12_ring_n80", "eval.jsonl")
-    if os.path.exists(n80_path):
-        n80 = final_mean("long_context_mid12_ring_n80")
+    if ring is not None:
+        ax.plot([270], [ring], color=BLUE, marker="x", ms=9, mew=2, ls="none")
+        ax.annotate("ring-init arm r5", (270, ring),
+                    textcoords="offset points",
+                    xytext=(4, -13), ha="right", fontsize=8, color=INK)
+    n80 = final_mean("long_context_mid12_ring_n80", require_step=36000)
+    if n80 is not None:
         ax.plot([270], [n80], color=BLUE, marker="D", ms=8, ls="none",
                 mfc="none", mew=2)
         ax.annotate(f"ring × n-step-80 arm r5 ({n80:.2f})", (270, n80),

@@ -13,7 +13,13 @@ from r2d2_tpu._native import load_native
 from r2d2_tpu.replay.sum_tree import SumTree
 
 native = load_native()
-pytestmark = pytest.mark.skipif(native is None, reason="native core unavailable")
+# the `native` marker lets `pytest -m native` target exactly this layer;
+# load_native() returns None (never raises) on a missing toolchain or a
+# stale .so, so collection always succeeds and the module skips cleanly
+pytestmark = [
+    pytest.mark.native,
+    pytest.mark.skipif(native is None, reason="native core unavailable"),
+]
 
 
 def test_tree_update_matches_numpy():
@@ -64,6 +70,26 @@ def test_gather_windows_clamped_parity():
         rows = np.clip(win[:, None] + np.arange(T)[None, :], 0, slot - 1)
         expect = store[b[:, None], rows]
         np.testing.assert_array_equal(out, expect)
+
+
+def test_gather_windows_multi_matches_per_field():
+    """The grouped multi-field gather is bit-identical to per-field
+    gather_windows calls on the same coordinates — mixed dtypes and row
+    shapes in one group, negative and overrunning window starts."""
+    rng = np.random.default_rng(3)
+    nb, slot, T = 7, 21, 14
+    stores = [
+        rng.integers(0, 255, size=(nb, slot, 5, 3)).astype(np.uint8),
+        rng.integers(0, 255, size=(nb, slot)).astype(np.uint8),
+        rng.normal(size=(nb, slot)).astype(np.float32),
+    ]
+    b = rng.integers(0, nb, size=9).astype(np.int64)
+    win = rng.integers(-5, slot, size=9).astype(np.int64)
+    outs = native.gather_windows_multi(stores, b, win, T)
+    assert len(outs) == len(stores)
+    for store, out in zip(stores, outs):
+        assert out.dtype == store.dtype
+        np.testing.assert_array_equal(out, native.gather_windows(store, b, win, T))
 
 
 def test_replay_buffer_native_vs_numpy_batches():

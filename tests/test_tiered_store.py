@@ -1,0 +1,247 @@
+"""Tiered replay plane tests (replay/tiered_store.py).
+
+The inline host plane is the executable spec: for the same RNG stream and
+contents, the tiered K-batch stage must produce BIT-IDENTICAL sampled
+batches, stamps, and priority-write-back semantics (the CPU parity gate
+from the tiered-plane issue). Tier-1: everything here runs on CPU with no
+`slow` marker so the ROADMAP verify command exercises the staging path.
+"""
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import R2D2Config, tiny_test
+from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+from r2d2_tpu.replay.tiered_store import (
+    TieredPrefetchPipeline,
+    TieredReplayBuffer,
+    stage_chunk,
+)
+from r2d2_tpu.utils.profiling import TransferTimer
+from tests.test_replay_buffer import make_block, small_cfg
+
+
+def _fill(buf, cfg, n=6):
+    """Mixed full/short/terminal blocks: exercises every clamp path the
+    single-batch sampler has (same mix as the native parity test)."""
+    for i in range(n):
+        steps = [12, 12, 7, 12, 5, 12][i % 6]
+        block, prios, ep = make_block(
+            cfg, steps=steps, start_step=13 * i, terminal=(i % 3 == 2), seed=i
+        )
+        buf.add_block(block, prios, ep)
+
+
+def _pair(seed=0, **kw):
+    """(host spec buffer, tiered buffer) with identical contents."""
+    cfg = small_cfg(**kw)
+    host, tiered = ReplayBuffer(cfg), TieredReplayBuffer(cfg)
+    _fill(host, cfg)
+    _fill(tiered, cfg)
+    return cfg, host, tiered
+
+
+FIELDS = [
+    "obs", "last_action", "last_reward", "hidden", "action",
+    "n_step_reward", "gamma", "burn_in_steps", "learning_steps",
+    "forward_steps", "is_weights",
+]
+
+
+def test_window_stack_bit_identical_to_k_host_samples():
+    """K draws under one lock hold consume the identical RNG stream as K
+    sequential host sample_batch calls — every field, every stamp."""
+    K = 4
+    cfg, host, tiered = _pair()
+    for seed in range(3):
+        rng_h = np.random.default_rng(seed)
+        rng_t = np.random.default_rng(seed)
+        sw = tiered.sample_window_stack(rng_t, K)
+        for k in range(K):
+            b = host.sample_batch(rng_h)
+            for f in FIELDS:
+                np.testing.assert_array_equal(
+                    getattr(sw, f)[k], getattr(b, f), err_msg=f
+                )
+                assert getattr(sw, f).dtype == np.asarray(getattr(b, f)).dtype, f
+            np.testing.assert_array_equal(sw.idxes[k], b.idxes)
+            assert sw.old_ptr == b.old_ptr
+            assert sw.old_advances == b.old_advances
+            assert sw.env_steps == b.env_steps
+
+
+def test_window_stack_numpy_native_parity():
+    """The stacked gather's native and numpy paths agree bit-for-bit (the
+    numpy fallback is the spec; skipping when native is absent would leave
+    the native path untested, so this test self-gates per path)."""
+    cfg = small_cfg()
+    tiered_cc = TieredReplayBuffer(cfg)
+    tiered_np = TieredReplayBuffer(cfg.replace(use_native_replay=False))
+    assert tiered_np.native is None
+    _fill(tiered_cc, cfg)
+    _fill(tiered_np, cfg)
+    if tiered_cc.native is None:
+        pytest.skip("native core unavailable; numpy path is the only path")
+    a = tiered_cc.sample_window_stack(np.random.default_rng(7), 3)
+    b = tiered_np.sample_window_stack(np.random.default_rng(7), 3)
+    for f in FIELDS:
+        np.testing.assert_array_equal(getattr(a, f), getattr(b, f), err_msg=f)
+
+
+def test_priority_writeback_parity_with_host_plane():
+    """Applying the stacked chunk's priorities row-by-row under its
+    stage-time stamps leaves the tree IDENTICAL to the host plane applying
+    the same updates per batch — including rows invalidated by block
+    writes that land between stage and write-back."""
+    K = 3
+    cfg, host, tiered = _pair()
+    rng_h, rng_t = np.random.default_rng(1), np.random.default_rng(1)
+
+    sw = tiered.sample_window_stack(rng_t, K)
+    host_batches = [host.sample_batch(rng_h) for _ in range(K)]
+
+    # interleave a write: slots overwritten after the stage — the window
+    # mask must drop exactly the same rows on both planes
+    blk, prios, ep = make_block(cfg, steps=12, start_step=99, seed=42)
+    host.add_block(blk, prios, ep)
+    tiered.add_block(blk, prios, ep)
+
+    td = np.random.default_rng(2).uniform(0.1, 4.0, size=(K, cfg.batch_size))
+    for k in range(K):
+        hb = host_batches[k]
+        host.update_priorities(hb.idxes, td[k], hb.old_ptr, hb.old_advances)
+        tiered.update_priorities(
+            sw.idxes[k], td[k], sw.old_ptr, sw.old_advances
+        )
+    np.testing.assert_array_equal(host.tree.tree, tiered.tree.tree)
+
+
+def test_priority_writeback_full_lap_rejected():
+    """A write-back whose stamp is a full ring lap old leaves the tree
+    untouched (the old_advances guard — the torn/deferred-readback case)."""
+    cfg, _, tiered = _pair()
+    sw = tiered.sample_window_stack(np.random.default_rng(3), 2)
+    # advance the ring a full lap past the stamp
+    for i in range(cfg.num_blocks):
+        blk, prios, ep = make_block(cfg, steps=12, start_step=7 * i, seed=50 + i)
+        tiered.add_block(blk, prios, ep)
+    before = tiered.tree.tree.copy()
+    tiered.update_priorities(
+        sw.idxes[0],
+        np.full(cfg.batch_size, 9.9),
+        sw.old_ptr,
+        sw.old_advances,
+    )
+    np.testing.assert_array_equal(tiered.tree.tree, before)
+
+
+def test_stage_chunk_shapes_and_roundtrip():
+    """stage_chunk lifts the stacked windows to the device with the
+    learner's DeviceBatch field mapping (action/last_action as int32) and
+    no value drift through device_put."""
+    K = 2
+    cfg, _, tiered = _pair()
+    rng_t = np.random.default_rng(5)
+    sw = tiered.sample_window_stack(np.random.default_rng(5), K)
+    timer = TransferTimer()
+    chunk = stage_chunk(tiered, rng_t, K, timer=timer)
+    B, T, L = cfg.batch_size, cfg.seq_len, cfg.learning_steps
+
+    batch = chunk.batch
+    assert batch.obs.shape == (K, B, T, *cfg.obs_shape)
+    assert batch.last_action.shape == (K, B, T)
+    assert batch.action.shape == (K, B, L)
+    assert batch.hidden.shape == (K, B, 2, cfg.hidden_dim)
+    assert batch.is_weights.shape == (K, B)
+    assert str(batch.action.dtype) == "int32"
+    assert str(batch.last_action.dtype) == "int32"
+
+    np.testing.assert_array_equal(np.asarray(batch.obs), sw.obs)
+    np.testing.assert_array_equal(
+        np.asarray(batch.last_action), sw.last_action.astype(np.int32)
+    )
+    np.testing.assert_array_equal(np.asarray(batch.action), sw.action)
+    np.testing.assert_array_equal(np.asarray(batch.is_weights), sw.is_weights)
+    np.testing.assert_array_equal(chunk.idxes, sw.idxes)
+    assert chunk.old_ptr == sw.old_ptr
+    assert chunk.old_advances == sw.old_advances
+    assert timer.chunks == 1
+    assert timer.bytes_staged == sw.nbytes()
+
+
+def test_pipeline_chunks_bit_identical_and_clean_stop():
+    """The prefetch pipeline delivers the same chunk stream as direct
+    stage_chunk calls on the same RNG stream, and stop() joins the staging
+    thread."""
+    K = 2
+    cfg, _, tiered = _pair()
+    ref = TieredReplayBuffer(cfg)
+    _fill(ref, cfg)
+
+    timer = TransferTimer()
+    pipe = TieredPrefetchPipeline(
+        tiered, np.random.default_rng(11), K, timer=timer
+    )
+    rng_ref = np.random.default_rng(11)
+    try:
+        for _ in range(3):
+            got = pipe.get()
+            want = stage_chunk(ref, rng_ref, K)
+            np.testing.assert_array_equal(got.idxes, want.idxes)
+            np.testing.assert_array_equal(
+                np.asarray(got.batch.obs), np.asarray(want.batch.obs)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got.batch.is_weights),
+                np.asarray(want.batch.is_weights),
+            )
+    finally:
+        pipe.stop()
+    assert not pipe._thread.is_alive()
+    assert timer.wait_seconds >= 0.0
+
+
+def test_pipeline_error_surfaces_in_get():
+    """A staging-thread crash re-raises from get() instead of hanging the
+    consumer."""
+    cfg = small_cfg()
+    tiered = TieredReplayBuffer(cfg)
+    _fill(tiered, cfg)
+
+    def boom(*a, **kw):
+        raise RuntimeError("synthetic stage failure")
+
+    tiered.sample_window_stack = boom
+    pipe = TieredPrefetchPipeline(tiered, np.random.default_rng(0), 2)
+    try:
+        with pytest.raises(RuntimeError, match="staging thread died"):
+            pipe.get()
+    finally:
+        pipe.stop()
+
+
+def test_transfer_timer_overlap_math():
+    t = TransferTimer()
+    assert t.overlap_fraction() == 1.0  # nothing staged yet
+    t.h2d_seconds, t.wait_seconds = 2.0, 0.0
+    assert t.overlap_fraction() == 1.0  # consumer never waited
+    t.wait_seconds = 1.0
+    assert t.overlap_fraction() == pytest.approx(0.5)
+    t.wait_seconds = 5.0
+    assert t.overlap_fraction() == 0.0  # clamped: fully serialized
+    stats = t.stats()
+    for key in (
+        "h2d_overlap_fraction", "h2d_seconds", "h2d_wait_seconds",
+        "h2d_chunks", "h2d_gbytes_staged",
+    ):
+        assert key in stats
+    t.reset()
+    assert t.h2d_seconds == 0.0 and t.chunks == 0
+
+
+def test_config_accepts_tiered_plane():
+    small_cfg(replay_plane="tiered")
+    small_cfg(replay_plane="tiered", updates_per_dispatch=2)
+    tiny_test().replace(replay_plane="tiered", updates_per_dispatch=2).validate()
+    with pytest.raises(ValueError, match="collector='device'"):
+        small_cfg(replay_plane="tiered", collector="device", env_name="catch")
