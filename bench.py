@@ -551,6 +551,121 @@ def tiered_main(
     )
 
 
+def serve_main(
+    core: str = "lstm",
+    lru_chunk: int = 0,
+    sessions: int = 32,
+    seconds: float = 30.0,
+):
+    """Serving-plane load test: `sessions` concurrent CatchHostEnv session
+    threads drive the full-size network through r2d2_tpu.serve's
+    LocalClient for `seconds`, with a checkpoint hot-reload fired
+    mid-window to prove reloads don't dent the latency tail. Reports
+    sustained requests/s plus p50/p95/p99 request latency (submit ->
+    action), batch occupancy, and the reload count.
+
+    No baseline row exists yet for serving — vs_baseline is null until a
+    BENCH_*.json round records the first trajectory point."""
+    import os
+    import shutil
+    import tempfile
+
+    from r2d2_tpu.envs.catch import CatchHostEnv
+    from r2d2_tpu.serve import LocalClient, PolicyServer, ServeConfig
+    from r2d2_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = _system_cfg(core=core, lru_chunk=lru_chunk)
+    serve_cfg = ServeConfig(
+        buckets=(2, 4, 8, 16, 32),
+        max_wait_ms=2.0,
+        cache_capacity=max(2 * sessions, 64),
+        poll_interval_s=0.2,
+    )
+    tmp = tempfile.mkdtemp(prefix="serve_bench_")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    try:
+        server = PolicyServer(cfg, serve_cfg, checkpoint_dir=ckpt_dir)
+        save_checkpoint(ckpt_dir, server._template, 0, 0.0)  # step-0 series
+        t0 = time.time()
+        server.warmup()
+        print(f"[serve] warmup (all buckets) in {time.time() - t0:.1f}s", file=sys.stderr)
+        server.start()
+        client = LocalClient(server)
+        stop = threading.Event()
+        lats: list = [[] for _ in range(sessions)]
+
+        def session_loop(i: int) -> None:
+            env = CatchHostEnv(seed=i)
+            sid = f"bench-{i}"
+            obs, reward, reset = env.reset(), 0.0, True
+            while not stop.is_set():
+                t = time.perf_counter()
+                res = client.act(sid, obs, reward=reward, reset=reset)
+                lats[i].append(time.perf_counter() - t)
+                obs, reward, done, _ = env.step(res.action)
+                reset = done
+                if done:
+                    obs, reward = env.reset(), 0.0
+
+        threads = [
+            threading.Thread(target=session_loop, args=(i,), daemon=True)
+            for i in range(sessions)
+        ]
+        bench_t0 = time.time()
+        for t in threads:
+            t.start()
+        # mid-window: publish a new checkpoint so the watcher hot-reloads
+        # under live traffic
+        time.sleep(seconds / 2)
+        import jax.numpy as jnp
+
+        bumped = server._template.replace(step=jnp.asarray(100, jnp.int32))
+        save_checkpoint(ckpt_dir, bumped, 0, 0.0)
+        time.sleep(seconds / 2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        elapsed = time.time() - bench_t0
+        server.check()
+        stats = server.stats()
+        server.stop()
+
+        all_lat = np.sort(np.concatenate([np.asarray(l) for l in lats if l]))
+        n = all_lat.size
+        rps = n / elapsed
+        p50, p95, p99 = (
+            float(np.percentile(all_lat, p) * 1e3) for p in (50, 95, 99)
+        )
+        print(
+            f"{n} requests over {sessions} sessions in {elapsed:.1f}s "
+            f"(reloads={stats['reloads']}, occupancy="
+            f"{stats['mean_batch_occupancy']:.1f})",
+            file=sys.stderr,
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "serve_requests_per_sec",
+                    "value": round(rps, 1),
+                    "unit": "requests/s",
+                    "vs_baseline": None,
+                    "p50_latency_ms": round(p50, 2),
+                    "p95_latency_ms": round(p95, 2),
+                    "p99_latency_ms": round(p99, 2),
+                    "sessions": sessions,
+                    "mean_batch_occupancy": round(stats["mean_batch_occupancy"], 2),
+                    "bucket_fill": round(stats["bucket_fill"], 3),
+                    "reloads": stats["reloads"],
+                    "trace_count": stats["trace_count"],
+                    "core": cfg.recurrent_core
+                    + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def long_context_main(core: str = "lstm", lru_chunk: int = 0):
     """Stretch configuration (BASELINE.json config 5): seq_len = 64 burn-in
     + 512 learning + 5 forward = 581 per sequence — at batch 32, ~3.4x the
@@ -612,12 +727,15 @@ if __name__ == "__main__":
     p = argparse.ArgumentParser(description="r2d2_tpu benchmarks")
     p.add_argument(
         "--mode", default="learner",
-        choices=["learner", "system", "fused", "long_context"],
+        choices=["learner", "system", "fused", "long_context", "serve"],
         help="learner: fused-update throughput on synthetic replay (the "
              "driver's default metric). system: concurrent on-device "
              "collection + learning via threads. fused: the same full "
              "system as ONE megastep dispatch (megastep.py). long_context: "
-             "learner throughput on the seq-581 stretch preset.",
+             "learner throughput on the seq-581 stretch preset. serve: "
+             "serving-plane load test (r2d2_tpu/serve) — requests/s and "
+             "latency percentiles under concurrent stateful sessions with "
+             "a mid-window checkpoint hot-reload.",
     )
     p.add_argument(
         "--collect-every", type=int, default=6,
@@ -648,8 +766,18 @@ if __name__ == "__main__":
         "--capacity", type=int, default=2_000_000,
         help="tiered plane: replay capacity in transitions (host RAM)",
     )
+    p.add_argument(
+        "--sessions", type=int, default=32,
+        help="serve mode: concurrent stateful client sessions",
+    )
+    p.add_argument(
+        "--serve-seconds", type=float, default=30.0,
+        help="serve mode: measurement window (a hot reload fires halfway)",
+    )
     args = p.parse_args()
-    if args.mode == "system":
+    if args.mode == "serve":
+        serve_main(args.core, args.lru_chunk, args.sessions, args.serve_seconds)
+    elif args.mode == "system":
         system_main(args.core, args.lru_chunk)
     elif args.mode == "fused":
         fused_system_main(args.collect_every, args.core, args.lru_chunk)

@@ -1,0 +1,31 @@
+"""Policy serving plane (L4/L6): session-stateful batched online inference.
+
+The training stack's inference-side counterpart (SEED RL-style centralized
+batched acting over many user sessions): a device-resident recurrent-state
+cache keyed by session id, a deadline micro-batcher with bucketed batch
+shapes, and a threaded serve loop with atomic checkpoint hot-reload —
+turning a trained R2D2 checkpoint into a low-latency policy service.
+"""
+
+from r2d2_tpu.serve.batcher import MicroBatcher, QueueFullError, ServeRequest
+from r2d2_tpu.serve.client import LocalClient, PolicyClient
+from r2d2_tpu.serve.server import (
+    PolicyServer,
+    ServeConfig,
+    ServeResult,
+    reference_act,
+)
+from r2d2_tpu.serve.state_cache import RecurrentStateCache
+
+__all__ = [
+    "LocalClient",
+    "MicroBatcher",
+    "PolicyClient",
+    "PolicyServer",
+    "QueueFullError",
+    "RecurrentStateCache",
+    "ServeConfig",
+    "ServeRequest",
+    "ServeResult",
+    "reference_act",
+]

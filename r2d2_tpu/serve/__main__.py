@@ -1,0 +1,96 @@
+"""`python -m r2d2_tpu.serve` — run the policy service on a TCP port.
+
+Quickstart (after a training run wrote checkpoints):
+
+    python -m r2d2_tpu.serve --preset tiny_test --ckpt /tmp/run/ckpt \\
+        --port 9955 --metrics /tmp/serve_metrics.jsonl
+
+Then from any process:
+
+    from r2d2_tpu.serve import PolicyClient
+    c = PolicyClient(port=9955)
+    c.act("session-1", obs, reward=0.0, reset=True)["action"]
+
+The checkpoint watcher keeps polling `--ckpt`, so a concurrently training
+run's new saves go live without a restart.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from r2d2_tpu.config import PRESETS, parse_overrides
+from r2d2_tpu.serve.client import serve_tcp
+from r2d2_tpu.serve.server import PolicyServer, ServeConfig
+from r2d2_tpu.utils.metrics import MetricsLogger
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m r2d2_tpu.serve",
+        description="session-stateful batched policy serving",
+    )
+    p.add_argument("--preset", default="tiny_test", choices=sorted(PRESETS))
+    p.add_argument("--set", nargs="*", default=[], metavar="KEY=VALUE",
+                   help="R2D2Config overrides, e.g. --set hidden_dim=256")
+    p.add_argument("--ckpt", default=None,
+                   help="checkpoint series dir; latest step is served and "
+                        "new steps hot-reload. Omitted: fresh-init params "
+                        "(smoke serving)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9955)
+    p.add_argument("--buckets", type=int, nargs="+", default=[2, 4, 8, 16, 32],
+                   help="padded batch shapes (min 2: batch-1 breaks bitwise "
+                        "parity with batched acting)")
+    p.add_argument("--max-wait-ms", type=float, default=2.0)
+    p.add_argument("--queue-depth", type=int, default=1024)
+    p.add_argument("--cache-capacity", type=int, default=4096,
+                   help="resident sessions before LRU eviction")
+    p.add_argument("--poll-interval", type=float, default=0.5,
+                   help="checkpoint watcher poll cadence (seconds)")
+    p.add_argument("--epsilon", type=float, default=0.0)
+    p.add_argument("--metrics", default=None, help="jsonl metrics path")
+    args = p.parse_args(argv)
+
+    cfg = PRESETS[args.preset]()
+    if args.set:
+        cfg = cfg.replace(**parse_overrides(args.set)).validate()
+    serve_cfg = ServeConfig(
+        buckets=tuple(args.buckets),
+        max_wait_ms=args.max_wait_ms,
+        queue_depth=args.queue_depth,
+        cache_capacity=args.cache_capacity,
+        poll_interval_s=args.poll_interval,
+        epsilon=args.epsilon,
+    )
+    metrics = MetricsLogger(args.metrics) if args.metrics else None
+    server = PolicyServer(cfg, serve_cfg, checkpoint_dir=args.ckpt, metrics=metrics)
+    print(f"[serve] warming up {len(serve_cfg.buckets)} bucket shapes", file=sys.stderr)
+    server.warmup()
+    server.start()
+    tcp, _ = serve_tcp(server, host=args.host, port=args.port)
+    host, port = tcp.server_address[:2]
+    print(
+        f"[serve] listening on {host}:{port} "
+        f"(ckpt_step={server.stats()['ckpt_step']})",
+        file=sys.stderr,
+    )
+    try:
+        while True:
+            time.sleep(5.0)
+            server.check()  # raises WorkerFatalError when a worker dies
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        tcp.shutdown()
+        tcp.server_close()
+        server.stop()
+        if metrics is not None:
+            metrics.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

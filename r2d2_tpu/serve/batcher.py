@@ -1,0 +1,191 @@
+"""Dynamic micro-batching for the serving plane.
+
+Iteration-level batch formation in the Orca (Yu et al., OSDI 2022) /
+SEED RL style: requests from independent sessions accumulate in a bounded
+queue; the serve loop pulls a batch as soon as either `max_batch` requests
+are waiting or the oldest pulled request has waited `max_wait_s` — so an
+idle server answers a lone request at the deadline latency floor, and a
+loaded server forms full batches with no added wait.
+
+Batches are padded to a small fixed set of BUCKET sizes so the jitted act
+function compiles once per bucket, never per request count. The minimum
+bucket is 2 by construction: XLA lowers a batch-1 act through a
+matrix-vector path whose reduction order differs bitwise from the batched
+matmul path, while every shape >= 2 is row-stable — keeping all traffic on
+buckets >= 2 is what makes batched serving bit-identical to the direct
+per-session reference path (pinned by tests/test_serve.py).
+
+One session appears at most ONCE per batch: the recurrent state gathered
+at batch start is per-session, so a second in-flight request of the same
+session must observe the first one's updated carry — it is deferred to the
+next batch (FIFO within the session).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class QueueFullError(RuntimeError):
+    """The bounded request queue is full — the client should back off."""
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    session_id: str
+    obs: np.ndarray
+    reward: float
+    reset: bool
+    future: Future
+    t_enqueue: float
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        buckets: Sequence[int] = (2, 4, 8, 16, 32),
+        max_wait_s: float = 0.002,
+        queue_depth: int = 1024,
+    ):
+        self.buckets: Tuple[int, ...] = tuple(sorted(set(int(b) for b in buckets)))
+        if not self.buckets or self.buckets[0] < 2:
+            raise ValueError(
+                "buckets must be >= 2: batch-1 shapes take XLA's matvec "
+                "path and break bitwise parity with batched acting"
+            )
+        self.max_batch = self.buckets[-1]
+        self.max_wait_s = max_wait_s
+        self._q: "queue.Queue[ServeRequest]" = queue.Queue(maxsize=queue_depth)
+        # same-session requests deferred out of a batch, FIFO per session
+        self._deferred: "deque[ServeRequest]" = deque()
+        self._lock = threading.Lock()
+        self.batches = 0
+        self.requests = 0
+        self.rejected = 0
+        self.deferrals = 0
+        self.occupancy_sum = 0  # real rows summed over batches
+        self.padded_sum = 0  # bucket rows summed over batches
+
+    # ------------------------------------------------------------- enqueue
+
+    def submit(
+        self, session_id: str, obs: np.ndarray, reward: float = 0.0,
+        reset: bool = False,
+    ) -> Future:
+        """Enqueue one request; the returned Future resolves to the serve
+        loop's ServeResult. A full queue fails the future immediately with
+        QueueFullError instead of blocking the client thread."""
+        fut: Future = Future()
+        req = ServeRequest(
+            session_id=session_id,
+            obs=np.asarray(obs),
+            reward=float(reward),
+            reset=bool(reset),
+            future=fut,
+            t_enqueue=time.monotonic(),
+        )
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            with self._lock:
+                self.rejected += 1
+            fut.set_exception(
+                QueueFullError(
+                    f"serve queue full ({self._q.maxsize} requests pending)"
+                )
+            )
+        return fut
+
+    def qsize(self) -> int:
+        return self._q.qsize() + len(self._deferred)
+
+    # -------------------------------------------------------------- batching
+
+    def _take_deferred(self, batch: List[ServeRequest], seen: set) -> None:
+        keep: "deque[ServeRequest]" = deque()
+        while self._deferred and len(batch) < self.max_batch:
+            req = self._deferred.popleft()
+            if req.session_id in seen:
+                keep.append(req)
+            else:
+                seen.add(req.session_id)
+                batch.append(req)
+        keep.extend(self._deferred)
+        self._deferred = keep
+
+    def next_batch(self, timeout: float = 0.25) -> List[ServeRequest]:
+        """Form one batch: block up to `timeout` for the first request
+        (bounded, so a supervised serve loop heartbeats while idle), then
+        fill until max_batch or the max_wait deadline. Returns [] on an
+        idle interval."""
+        batch: List[ServeRequest] = []
+        seen: set = set()
+        self._take_deferred(batch, seen)
+        if not batch:
+            try:
+                first = self._q.get(timeout=timeout)
+            except queue.Empty:
+                return []
+            seen.add(first.session_id)
+            batch.append(first)
+        deadline = time.monotonic() + self.max_wait_s
+        while len(batch) < self.max_batch:
+            remaining = deadline - time.monotonic()
+            try:
+                req = self._q.get(timeout=max(remaining, 0.0)) if remaining > 0 \
+                    else self._q.get_nowait()
+            except queue.Empty:
+                break
+            if req.session_id in seen:
+                self._deferred.append(req)
+                self.deferrals += 1
+            else:
+                seen.add(req.session_id)
+                batch.append(req)
+        self.batches += 1
+        self.requests += len(batch)
+        self.occupancy_sum += len(batch)
+        self.padded_sum += self.bucket_for(len(batch))
+        return batch
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (n <= max_batch by construction)."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.max_batch
+
+    def drain(self) -> List[ServeRequest]:
+        """Remove and return everything still queued (server shutdown —
+        the caller fails the futures)."""
+        out: List[ServeRequest] = list(self._deferred)
+        self._deferred.clear()
+        while True:
+            try:
+                out.append(self._q.get_nowait())
+            except queue.Empty:
+                return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            rejected = self.rejected
+        batches = max(self.batches, 1)
+        return {
+            "queue_depth": self.qsize(),
+            "batches": self.batches,
+            "requests": self.requests,
+            "rejected": rejected,
+            "deferrals": self.deferrals,
+            "mean_batch_occupancy": self.occupancy_sum / batches,
+            # real rows / padded rows: how much of the compiled shapes the
+            # traffic actually fills
+            "bucket_fill": self.occupancy_sum / max(self.padded_sum, 1),
+        }

@@ -1,0 +1,150 @@
+"""Clients for the serving plane.
+
+Two transports over the same PolicyServer:
+
+- `LocalClient` — in-process blocking wrapper over `PolicyServer.submit`;
+  what tests, bench.py's load generator, and embedded callers use. One
+  client instance is safe to share across session threads (the batcher
+  queue is the synchronization point).
+- `serve_tcp` + `PolicyClient` — a stdlib JSON-lines TCP frontend for
+  out-of-process callers (`python -m r2d2_tpu.serve`). One request per
+  line: ``{"session": id, "obs": [...], "reward": r, "reset": bool}`` ->
+  ``{"action": a, "ckpt_step": s, "params_version": v}`` (add
+  ``"want_q": true`` for the full Q row; ``{"session": id, "cmd":
+  "evict"}`` frees the session's cache slot on disconnect).
+
+The wire format is deliberately boring — the serving plane's substance is
+the batcher/cache/hot-reload machinery behind it, and the bit-parity tests
+run through LocalClient where numbers survive untouched.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from r2d2_tpu.serve.server import PolicyServer, ServeResult
+
+
+class LocalClient:
+    def __init__(self, server: PolicyServer, timeout: float = 30.0):
+        self.server = server
+        self.timeout = timeout
+
+    def act(self, session_id: str, obs, reward: float = 0.0,
+            reset: bool = False) -> ServeResult:
+        """Submit one request and block for its result. Raises what the
+        server failed the future with (QueueFullError on overload,
+        RuntimeError on a crashed iteration)."""
+        fut = self.server.submit(session_id, obs, reward=reward, reset=reset)
+        return fut.result(timeout=self.timeout)
+
+    def reset(self, session_id: str) -> None:
+        self.server.reset_session(session_id)
+
+    def evict(self, session_id: str) -> None:
+        self.server.cache.evict(session_id)
+
+
+class _RequestHandler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        server: PolicyServer = self.server.policy_server  # type: ignore[attr-defined]
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+                if req.get("cmd") == "evict":
+                    server.cache.evict(str(req["session"]))
+                    resp = {"ok": True}
+                else:
+                    obs = np.asarray(req["obs"], np.uint8)
+                    fut = server.submit(
+                        str(req["session"]), obs,
+                        reward=float(req.get("reward", 0.0)),
+                        reset=bool(req.get("reset", False)),
+                    )
+                    result = fut.result(timeout=30.0)
+                    resp = {
+                        "action": result.action,
+                        "ckpt_step": result.ckpt_step,
+                        "params_version": result.params_version,
+                    }
+                    if req.get("want_q"):
+                        resp["q"] = np.asarray(result.q).tolist()
+            except Exception as e:  # answer in-band; keep the stream alive
+                resp = {"error": f"{type(e).__name__}: {e}"}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve_tcp(server: PolicyServer, host: str = "127.0.0.1",
+              port: int = 0) -> Tuple[_TCPServer, threading.Thread]:
+    """Start the JSON-lines frontend on (host, port); port 0 picks a free
+    one (read it back from ``tcp.server_address``). Returns the live
+    socketserver and its acceptor thread; call ``tcp.shutdown()`` then
+    ``tcp.server_close()`` to stop."""
+    tcp = _TCPServer((host, port), _RequestHandler)
+    tcp.policy_server = server  # type: ignore[attr-defined]
+    thread = threading.Thread(target=tcp.serve_forever, name="serve-tcp", daemon=True)
+    thread.start()
+    return tcp, thread
+
+
+class PolicyClient:
+    """Blocking JSON-lines TCP client; one socket, one session stream at a
+    time per instance (open one client per concurrent session)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._rfile = self._sock.makefile("rb")
+
+    def _round_trip(self, payload: dict) -> dict:
+        self._sock.sendall((json.dumps(payload) + "\n").encode())
+        line = self._rfile.readline()
+        if not line:
+            raise ConnectionError("server closed the connection")
+        resp = json.loads(line)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp
+
+    def act(self, session_id: str, obs, reward: float = 0.0,
+            reset: bool = False, want_q: bool = False) -> dict:
+        payload = {
+            "session": session_id,
+            "obs": np.asarray(obs).tolist(),
+            "reward": float(reward),
+            "reset": bool(reset),
+        }
+        if want_q:
+            payload["want_q"] = True
+        return self._round_trip(payload)
+
+    def evict(self, session_id: str) -> None:
+        self._round_trip({"session": session_id, "cmd": "evict"})
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+        finally:
+            self._sock.close()
+
+    def __enter__(self) -> "PolicyClient":
+        return self
+
+    def __exit__(self, *exc) -> Optional[bool]:
+        self.close()
+        return None

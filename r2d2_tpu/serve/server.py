@@ -1,0 +1,404 @@
+"""The serve loop: supervised batched acting with checkpoint hot-reload.
+
+The inference-side counterpart of the training workers (SEED RL's
+centralized inference, Espeholt et al. 2020): ONE thread owns the device
+and the session cache, pulling micro-batches from the batcher, advancing
+all sessions in a single jitted `net.act` step, and resolving each
+request's Future with the chosen action. Two supervised workers run under
+`utils/supervision.Supervisor` exactly like the training-side actor loops:
+
+- ``serve-loop``   — batch formation + the jitted step; a raising
+  iteration fails only the in-flight batch's futures (recovery hook) and
+  the loop restarts with the session cache intact;
+- ``ckpt-watcher`` — polls the orbax series (utils/checkpoint.py) and
+  atomically publishes new params.
+
+Hot reload is a single-attribute swap: params travel as one
+``(params, ckpt_step, version)`` tuple, read ONCE per batch, so every
+request in a batch is answered by exactly one checkpoint — a reload
+mid-traffic can never tear a batch across two param sets. In-flight
+requests complete under the params they were batched with.
+
+Bucketed shapes bound compilation: the jitted step retraces only when the
+(bucket,) batch shape is new, and `trace_count` counts the retraces so
+tests can pin traces <= len(buckets).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.learner import init_train_state
+from r2d2_tpu.models.r2d2 import R2D2Network
+from r2d2_tpu.serve.batcher import MicroBatcher, ServeRequest
+from r2d2_tpu.serve.state_cache import RecurrentStateCache
+from r2d2_tpu.utils.checkpoint import latest_checkpoint_step, restore_checkpoint
+from r2d2_tpu.utils.metrics import MetricsLogger
+from r2d2_tpu.utils.supervision import Supervisor
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving-plane knobs (the model/network config stays R2D2Config)."""
+
+    buckets: Tuple[int, ...] = (2, 4, 8, 16, 32)
+    max_wait_ms: float = 2.0
+    queue_depth: int = 1024
+    cache_capacity: int = 4096
+    poll_interval_s: float = 0.5  # checkpoint watcher cadence
+    epsilon: float = 0.0  # serving default: greedy
+    max_restarts: int = 3
+    seed: int = 0
+
+
+class ServeResult:
+    """One answered request: the action plus enough provenance (checkpoint
+    step, params version) to audit which params produced it."""
+
+    __slots__ = ("action", "q", "ckpt_step", "params_version")
+
+    def __init__(self, action: int, q: np.ndarray, ckpt_step: int, params_version: int):
+        self.action = action
+        self.q = q
+        self.ckpt_step = ckpt_step
+        self.params_version = params_version
+
+    def __repr__(self) -> str:
+        return (
+            f"ServeResult(action={self.action}, ckpt_step={self.ckpt_step}, "
+            f"params_version={self.params_version})"
+        )
+
+
+_REF_JITS: Dict[R2D2Network, object] = {}
+
+
+def reference_act(net: R2D2Network, params, obs, last_action, last_reward, carry,
+                  min_batch: int = 2):
+    """The direct (unbatched-service) acting path tests compare against:
+    one jitted `net.act` on exactly the given sessions, padded to
+    `min_batch` rows. The pad matters: XLA lowers batch-1 acting through a
+    matrix-vector path whose reduction order differs bitwise from the
+    batched matmul path, while every batch shape >= 2 is row-stable and
+    pad-content-independent — so a 2-row padded call IS the canonical
+    per-session reference, and the served path can match it bit-for-bit.
+
+    Returns (q (B, A), (h, c)) for the B real rows.
+    """
+    fn = _REF_JITS.get(net)
+    if fn is None:
+        fn = jax.jit(lambda p, o, la, lr, c: net.apply(p, o, la, lr, c, method=net.act))
+        _REF_JITS[net] = fn
+    obs = jnp.asarray(obs)
+    la = jnp.asarray(last_action, jnp.int32)
+    lr = jnp.asarray(last_reward, jnp.float32)
+    h, c = carry
+    B = obs.shape[0]
+    pad = max(min_batch - B, 0)
+    if pad:
+        obs = jnp.concatenate([obs, jnp.zeros((pad, *obs.shape[1:]), obs.dtype)])
+        la = jnp.concatenate([la, jnp.zeros((pad,), jnp.int32)])
+        lr = jnp.concatenate([lr, jnp.zeros((pad,), jnp.float32)])
+        h = jnp.concatenate([h, jnp.zeros((pad, h.shape[1]), h.dtype)])
+        c = jnp.concatenate([c, jnp.zeros((pad, c.shape[1]), c.dtype)])
+    q, (h_out, c_out) = fn(params, obs, la, lr, (h, c))
+    return q[:B], (h_out[:B], c_out[:B])
+
+
+class PolicyServer:
+    """Session-stateful batched policy service over a trained checkpoint.
+
+    Lifecycle: construct (params explicit, or restored from the latest
+    checkpoint under `checkpoint_dir`), `start()`, submit requests (or use
+    a serve.client wrapper), `stop()`. `check()` surfaces supervisor
+    restart/stall counters and raises if a worker died for good — call it
+    from the owning loop exactly like Trainer does.
+    """
+
+    def __init__(
+        self,
+        cfg: R2D2Config,
+        serve_cfg: ServeConfig = ServeConfig(),
+        params=None,
+        checkpoint_dir: Optional[str] = None,
+        metrics: Optional[MetricsLogger] = None,
+    ):
+        self.cfg = cfg
+        self.serve_cfg = serve_cfg
+        self.checkpoint_dir = checkpoint_dir
+        self.metrics = metrics
+
+        self.net, self._template = init_train_state(cfg, jax.random.PRNGKey(serve_cfg.seed))
+        ckpt_step = -1
+        if params is None:
+            if checkpoint_dir is not None and latest_checkpoint_step(checkpoint_dir) is not None:
+                state, _, _ = restore_checkpoint(checkpoint_dir, self._template)
+                params, ckpt_step = state.params, int(state.step)
+            else:
+                params = self._template.params  # fresh init (smoke serving)
+        # the atomic hot-reload cell: ONE attribute holding ONE tuple, read
+        # once per batch — Python attribute reads are atomic, so a batch
+        # sees exactly one (params, step, version) triple, never a mix
+        self._published: Tuple[object, int, int] = (params, ckpt_step, 0)
+
+        if serve_cfg.cache_capacity < max(serve_cfg.buckets):
+            # a batch's own admissions must never evict a co-batched
+            # session (two rows sharing a slot): with capacity >= max
+            # bucket, the LRU front is always a non-batch session
+            raise ValueError(
+                f"cache_capacity ({serve_cfg.cache_capacity}) must be >= the "
+                f"largest batch bucket ({max(serve_cfg.buckets)})"
+            )
+        self.cache = RecurrentStateCache(serve_cfg.cache_capacity, cfg.hidden_dim)
+        self.batcher = MicroBatcher(
+            buckets=serve_cfg.buckets,
+            max_wait_s=serve_cfg.max_wait_ms / 1000.0,
+            queue_depth=serve_cfg.queue_depth,
+        )
+        self._rng = np.random.default_rng(serve_cfg.seed)
+        self.trace_count = 0  # python-body counter: +1 per jit trace
+        self.reloads = 0
+        self.reload_errors = 0
+        self._inflight: List[ServeRequest] = []
+        self._step = self._build_step()
+
+        self.supervisor: Optional[Supervisor] = None
+        self._serve_worker = None
+        self._watch_worker = None
+
+    # ------------------------------------------------------------ jit step
+
+    def _build_step(self):
+        net = self.net
+
+        def step(params, h_store, c_store, la_store, lr_store,
+                 obs, rewards, slots, reset_mask, explore_mask, random_actions):
+            # runs once per TRACE (new bucket shape), not per call
+            self.trace_count += 1
+            h = h_store[slots]
+            c = c_store[slots]
+            la = la_store[slots]
+            zero = reset_mask[:, None]
+            h = jnp.where(zero, 0.0, h)
+            c = jnp.where(zero, 0.0, c)
+            la = jnp.where(reset_mask, 0, la)
+            lr = jnp.where(reset_mask, 0.0, rewards)
+            q, (h_new, c_new) = net.apply(params, obs, la, lr, (h, c), method=net.act)
+            action = jnp.where(explore_mask, random_actions, jnp.argmax(q, axis=1))
+            action = action.astype(jnp.int32)
+            # scatter back: pad rows all target the scratch slot (their
+            # writes collide there harmlessly; real slots are unique by the
+            # batcher's one-session-per-batch rule)
+            h_store = h_store.at[slots].set(h_new)
+            c_store = c_store.at[slots].set(c_new)
+            la_store = la_store.at[slots].set(action)
+            lr_store = lr_store.at[slots].set(lr)
+            return q, action, h_store, c_store, la_store, lr_store
+
+        # donating the session stores lets XLA update them in place; on CPU
+        # the donation is unsupported (warning noise) so it is gated off
+        donate = () if jax.default_backend() == "cpu" else (1, 2, 3, 4)
+        return jax.jit(step, donate_argnums=donate)
+
+    # ------------------------------------------------------------- serving
+
+    def submit(self, session_id: str, obs, reward: float = 0.0,
+               reset: bool = False) -> Future:
+        return self.batcher.submit(session_id, obs, reward=reward, reset=reset)
+
+    def reset_session(self, session_id: str) -> None:
+        self.cache.reset(session_id)
+
+    def _run_batch(self, batch: List[ServeRequest]) -> None:
+        self._inflight = batch
+        # single read of the publish cell: the whole batch — and the
+        # results' provenance — come from one params set
+        params, ckpt_step, version = self._published
+        n = len(batch)
+        bucket = self.batcher.bucket_for(n)
+        pad = bucket - n
+        slots, fresh = self.cache.assign([r.session_id for r in batch])
+
+        obs = np.stack(
+            [r.obs for r in batch] + [np.zeros_like(batch[0].obs)] * pad
+        )
+        rewards = np.fromiter(
+            (r.reward for r in batch), np.float32, count=n
+        )
+        rewards = np.concatenate([rewards, np.zeros(pad, np.float32)])
+        # a row starts from zero state when the client asked for a reset OR
+        # the cache admitted it fresh (new session, or evicted + returned);
+        # pad rows reset too so the scratch row's garbage never compounds
+        reset_mask = np.concatenate(
+            [np.array([r.reset for r in batch], bool) | fresh, np.ones(pad, bool)]
+        )
+        slots_full = np.concatenate(
+            [slots, np.full(pad, self.cache.pad_slot, np.int32)]
+        )
+        eps = self.serve_cfg.epsilon
+        if eps > 0.0:
+            explore = self._rng.random(bucket) < eps
+            randoms = self._rng.integers(0, self.cfg.action_dim, bucket)
+        else:
+            explore = np.zeros(bucket, bool)
+            randoms = np.zeros(bucket, np.int64)
+
+        h, c, la, lr = self.cache.arrays()
+        q, action, h, c, la, lr = self._step(
+            params, h, c, la, lr,
+            jnp.asarray(obs), jnp.asarray(rewards), jnp.asarray(slots_full),
+            jnp.asarray(reset_mask), jnp.asarray(explore),
+            jnp.asarray(randoms, jnp.int32),
+        )
+        q_np = np.asarray(q)
+        act_np = np.asarray(action)
+        # stores commit BEFORE futures resolve: a client's next request for
+        # the same session (only admissible in a later batch) always sees
+        # this batch's carry
+        self.cache.commit(h, c, la, lr)
+        t_done = time.monotonic()
+        for i, r in enumerate(batch):
+            r.future.set_result(
+                ServeResult(int(act_np[i]), q_np[i], ckpt_step, version)
+            )
+        self._inflight = []
+        if self.metrics is not None:
+            self.metrics.log(
+                {
+                    "plane": "serve",
+                    "batch_occupancy": n,
+                    "bucket": bucket,
+                    "queue_depth": self.batcher.qsize(),
+                    "latency_s_oldest": t_done - batch[0].t_enqueue,
+                    "ckpt_step": ckpt_step,
+                    "params_version": version,
+                    "reloads": self.reloads,
+                    "trace_count": self.trace_count,
+                    **self.cache.stats(),
+                }
+            )
+
+    def _serve_iteration(self) -> None:
+        batch = self.batcher.next_batch(timeout=0.25)
+        if batch:
+            self._run_batch(batch)
+
+    def _serve_recover(self) -> None:
+        """Restart hook: fail the in-flight batch's futures so no client
+        blocks forever on a crashed iteration. The session cache needs no
+        repair — stores only commit after a fully successful step, so a
+        crash leaves every session at its last committed state and a
+        client retry re-runs from exactly there."""
+        inflight, self._inflight = self._inflight, []
+        for r in inflight:
+            if not r.future.done():
+                r.future.set_exception(
+                    RuntimeError("serve iteration failed; retry the request")
+                )
+
+    # ----------------------------------------------------------- hot reload
+
+    def _watch_iteration(self) -> None:
+        # bounded work per call (supervision contract): one poll, then wait
+        try:
+            self.reload_now()
+        except FileNotFoundError:
+            # series advanced or a retention policy pruned the step between
+            # listing and restore; next poll re-resolves
+            self.reload_errors += 1
+        if self.supervisor is not None:
+            self.supervisor.stop.wait(self.serve_cfg.poll_interval_s)
+        else:
+            time.sleep(self.serve_cfg.poll_interval_s)
+
+    def reload_now(self) -> bool:
+        """One synchronous reload check (the watcher body; also usable
+        directly by tests and watcher-less servers). Returns True if new
+        params were published."""
+        step = latest_checkpoint_step(self.checkpoint_dir)
+        if step is None or step == self._published[1]:
+            return False
+        state, _, _ = restore_checkpoint(self.checkpoint_dir, self._template, step)
+        _, _, version = self._published
+        self._published = (state.params, int(state.step), version + 1)
+        self.reloads += 1
+        return True
+
+    # ------------------------------------------------------------ lifecycle
+
+    def warmup(self) -> None:
+        """Pre-trace every bucket shape with pad-only batches so live
+        traffic never waits on a compile. Writes touch only the scratch
+        row, so session state is untouched."""
+        for bucket in self.batcher.buckets:
+            obs = np.zeros((bucket, *self.cfg.obs_shape), np.uint8)
+            h, c, la, lr = self.cache.arrays()
+            out = self._step(
+                self._published[0], h, c, la, lr,
+                jnp.asarray(obs), jnp.zeros(bucket, jnp.float32),
+                jnp.full(bucket, self.cache.pad_slot, jnp.int32),
+                jnp.ones(bucket, bool), jnp.zeros(bucket, bool),
+                jnp.zeros(bucket, jnp.int32),
+            )
+            q, action, h, c, la, lr = out
+            jax.block_until_ready(q)
+            # commit: on donating backends the old stores were consumed
+            self.cache.commit(h, c, la, lr)
+
+    def start(self, watch_checkpoints: Optional[bool] = None) -> None:
+        if self.supervisor is not None:
+            raise RuntimeError("server already started")
+        if watch_checkpoints is None:
+            watch_checkpoints = self.checkpoint_dir is not None
+        self.supervisor = Supervisor()
+        # lambda indirection so tests can monkeypatch _serve_iteration and
+        # exercise the restart path on the live worker
+        self._serve_worker = self.supervisor.spawn(
+            "serve-loop",
+            lambda: self._serve_iteration(),
+            max_restarts=self.serve_cfg.max_restarts,
+            on_restart=self._serve_recover,
+        )
+        if watch_checkpoints:
+            self._watch_worker = self.supervisor.spawn(
+                "ckpt-watcher",
+                lambda: self._watch_iteration(),
+                max_restarts=self.serve_cfg.max_restarts,
+            )
+
+    def check(self) -> Dict[str, int]:
+        """Supervisor passthrough: restart/stall counters for the metrics
+        stream; raises WorkerFatalError when a worker is out of restarts."""
+        if self.supervisor is None:
+            return {"worker_restarts": 0, "worker_stalls": 0}
+        return self.supervisor.check()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        if self.supervisor is not None:
+            self.supervisor.shutdown(timeout)
+            self.supervisor = None
+        for r in self.batcher.drain():
+            if not r.future.done():
+                r.future.set_exception(RuntimeError("server stopped"))
+        self._serve_recover()  # anything mid-batch when the loop stopped
+
+    def stats(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "reloads": self.reloads,
+            "reload_errors": self.reload_errors,
+            "trace_count": self.trace_count,
+            "ckpt_step": self._published[1],
+            "params_version": self._published[2],
+        }
+        out.update(self.batcher.stats())
+        out.update(self.cache.stats())
+        return out

@@ -1,0 +1,138 @@
+"""Device-resident session-state cache for the serving plane.
+
+R2D2's policy is stateful: every user session carries an LSTM carry plus
+its last action and last reward across requests (models/r2d2.py `act`).
+Shipping that state to the client and back would add two host<->device
+round trips of 2*H floats per request; instead the state lives HERE, in
+fixed-capacity device arrays, and requests carry only a session id. Batch
+formation gathers the rows for the sessions in the batch, the jitted serve
+step advances them, and the updated rows scatter back — recurrent state
+never leaves the device between requests.
+
+Host side this is an LRU map session_id -> slot index (an OrderedDict —
+hits move to the back, evictions pop the front). A session that was
+evicted and returns is re-admitted FRESH (zero carry, NOOP last action,
+zero last reward — exactly the training episode-start state,
+models/r2d2.py `initial_carry`), which is also what per-session reset
+produces. The device arrays hold one extra scratch row at index
+`capacity`: padding rows of a bucketed batch gather from and scatter into
+it, so partially-full batches need no masking inside the jitted step.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class RecurrentStateCache:
+    """Fixed-capacity device store: session_id -> (carry, last_action,
+    last_reward) with LRU eviction.
+
+    Array mutation (`arrays` / `commit`) is single-writer by contract —
+    only the serve loop touches the device rows. The host-side map is
+    lock-protected so `reset` / `evict` / `stats` may be called from any
+    thread.
+    """
+
+    def __init__(self, capacity: int, hidden_dim: int):
+        if capacity < 1:
+            raise ValueError("cache capacity must be >= 1")
+        self.capacity = capacity
+        self.hidden_dim = hidden_dim
+        # +1 scratch row for bucket padding (gathered/scattered harmlessly)
+        self.h = jnp.zeros((capacity + 1, hidden_dim), jnp.float32)
+        self.c = jnp.zeros((capacity + 1, hidden_dim), jnp.float32)
+        self.last_action = jnp.zeros((capacity + 1,), jnp.int32)
+        self.last_reward = jnp.zeros((capacity + 1,), jnp.float32)
+        self._slots: "OrderedDict[str, int]" = OrderedDict()
+        self._free: List[int] = list(range(capacity))
+        self._lock = threading.Lock()
+        self.evictions = 0
+        self.admissions = 0
+
+    @property
+    def pad_slot(self) -> int:
+        """The scratch row index padding gathers/scatters target."""
+        return self.capacity
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    def __contains__(self, session_id: str) -> bool:
+        with self._lock:
+            return session_id in self._slots
+
+    # ------------------------------------------------------------ admission
+
+    def assign(self, session_ids: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+        """Map session ids to slot indices, admitting unknown sessions
+        (evicting the LRU session when full). Returns (slots, fresh) where
+        fresh[i] marks sessions that must start from zero state (new,
+        or evicted-and-readmitted). Ids must be unique within one call —
+        the batcher guarantees at most one request per session per batch.
+        """
+        if len(set(session_ids)) != len(session_ids):
+            raise ValueError("duplicate session ids in one batch")
+        slots = np.empty(len(session_ids), np.int32)
+        fresh = np.zeros(len(session_ids), bool)
+        with self._lock:
+            for i, sid in enumerate(session_ids):
+                slot = self._slots.get(sid)
+                if slot is None:
+                    fresh[i] = True
+                    self.admissions += 1
+                    if self._free:
+                        slot = self._free.pop()
+                    else:
+                        # evict the least-recently-used session NOT part of
+                        # this batch (batch members were just admitted to
+                        # the back of the order, so the front is safe)
+                        _, slot = self._slots.popitem(last=False)
+                        self.evictions += 1
+                self._slots[sid] = slot
+                self._slots.move_to_end(sid)
+                slots[i] = slot
+        return slots, fresh
+
+    def reset(self, session_id: str) -> None:
+        """Forget a session's state without freeing its slot: the next
+        request re-runs admission-fresh semantics via the reset flag, so
+        dropping the mapping is enough (and cheaper than touching device
+        rows from a foreign thread)."""
+        self.evict(session_id)
+
+    def evict(self, session_id: str) -> bool:
+        """Explicitly free a session's slot (client disconnect)."""
+        with self._lock:
+            slot = self._slots.pop(session_id, None)
+            if slot is None:
+                return False
+            self._free.append(slot)
+            return True
+
+    # ------------------------------------------------------------ device IO
+
+    def arrays(self):
+        """The device arrays the jitted serve step reads and rewrites."""
+        return self.h, self.c, self.last_action, self.last_reward
+
+    def commit(self, h, c, last_action, last_reward) -> None:
+        """Install the serve step's updated arrays (serve-loop thread
+        only). The old arrays may have been donated into the step."""
+        self.h, self.c = h, c
+        self.last_action, self.last_reward = last_action, last_reward
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "cache_sessions": len(self._slots),
+                "cache_capacity": self.capacity,
+                "cache_evictions": self.evictions,
+                "cache_admissions": self.admissions,
+            }

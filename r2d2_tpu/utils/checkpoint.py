@@ -17,6 +17,7 @@ the reference's test.py walks (test.py:26-30).
 from __future__ import annotations
 
 import os
+import shutil
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -38,27 +39,70 @@ def _payload(state: TrainState, env_steps: int, wall_minutes: float) -> Dict[str
     }
 
 
+# The orbax finalize marker, written last inside a completed save. A
+# step dir without it is partially written (crashed save, or a save still
+# in flight on a fs without atomic rename) and must be invisible to
+# readers: `ocp.utils.is_checkpoint_finalized` only inspects the directory
+# NAME on a local fs, so a torn `step_N` would pass it.
+_FINALIZED_MARKER = "_CHECKPOINT_METADATA"
+
+
+def _barrier(name: str) -> None:
+    """Multihost sync point: orbax saves distributed arrays collectively,
+    so every process writes into the same (shared-fs) step dir and the
+    rename must happen exactly once, after ALL hosts finished writing."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 def save_checkpoint(
     ckpt_dir: str, state: TrainState, env_steps: int, wall_minutes: float
 ) -> str:
+    """Atomic for concurrent readers (the serve-plane hot-reload watcher
+    polls this series live): the tree is written to a deterministic temp
+    dir, then renamed into `step_{N}` in one fs operation — a reader lists
+    either the complete checkpoint or nothing, never a torn one."""
     step = int(state.step)
-    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+    base = os.path.abspath(ckpt_dir)
+    final = os.path.join(base, f"step_{step}")
+    # deterministic (not randomized) temp name: all hosts of a multihost
+    # save must target the SAME directory on the shared fs
+    tmp = os.path.join(base, f".tmp_step_{step}")
+    if jax.process_index() == 0:
+        os.makedirs(base, exist_ok=True)
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)  # leftover from a crashed save
+    _barrier(f"ckpt_clean_{step}")
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, _payload(state, env_steps, wall_minutes), force=True)
+    ckptr.save(tmp, _payload(state, env_steps, wall_minutes), force=True)
     ckptr.wait_until_finished()
-    return path
+    _barrier(f"ckpt_written_{step}")
+    if jax.process_index() == 0:
+        if os.path.isdir(final):
+            shutil.rmtree(final)  # force=True semantics, atomically
+        os.rename(tmp, final)
+    _barrier(f"ckpt_renamed_{step}")
+    return final
 
 
 def list_checkpoint_steps(ckpt_dir: str) -> List[int]:
+    """Completed checkpoints only: in-flight temp dirs (`.tmp_step_*`) and
+    partially-written `step_*` dirs missing the orbax finalize marker are
+    skipped, so a concurrent reader can never pick up a torn step."""
     if not os.path.isdir(ckpt_dir):
         return []
     steps = []
     for name in os.listdir(ckpt_dir):
-        if name.startswith("step_"):
-            try:
-                steps.append(int(name[5:]))
-            except ValueError:
-                pass
+        if not name.startswith("step_"):
+            continue
+        try:
+            step = int(name[5:])
+        except ValueError:
+            continue
+        if os.path.exists(os.path.join(ckpt_dir, name, _FINALIZED_MARKER)):
+            steps.append(step)
     return sorted(steps)
 
 

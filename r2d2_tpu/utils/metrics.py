@@ -13,6 +13,33 @@ import sys
 import time
 from typing import Any, Dict, Optional
 
+import numpy as np
+
+
+def _json_default(v: Any) -> Any:
+    """Serializer fallback for non-JSON values in metric records:
+    numpy/jax scalars -> Python numbers, small arrays -> lists, big arrays
+    -> a shape/dtype summary (a learning-curve line must never carry a
+    multi-megabyte tensor), anything else -> str. `default=float` used to
+    sit here and raised TypeError on all of these."""
+    if isinstance(v, np.ndarray):
+        if v.ndim == 0:
+            return v.item()
+        if v.size <= 32:
+            return v.tolist()
+        return f"<array shape={v.shape} dtype={v.dtype}>"
+    if isinstance(v, (np.generic,)):
+        return v.item()
+    if hasattr(v, "item") and getattr(v, "ndim", None) == 0:
+        return v.item()  # jax scalar arrays
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        arr = np.asarray(v)
+        return _json_default(arr)
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return str(v)
+
 
 class MetricsLogger:
     def __init__(self, path: Optional[str] = None, stdout_interval: float = 10.0):
@@ -24,7 +51,7 @@ class MetricsLogger:
     def log(self, record: Dict[str, Any], force_print: bool = False) -> None:
         record = {"ts": time.time(), **record}
         if self._fh:
-            self._fh.write(json.dumps(record, default=float) + "\n")
+            self._fh.write(json.dumps(record, default=_json_default) + "\n")
         now = time.time()
         if force_print or now - self._last_print >= self.stdout_interval:
             parts = " ".join(
@@ -36,5 +63,8 @@ class MetricsLogger:
             self._last_print = now
 
     def close(self) -> None:
-        if self._fh:
-            self._fh.close()
+        """Idempotent: serve/train teardown paths may both close the same
+        logger (supervised shutdown + atexit)."""
+        fh, self._fh = self._fh, None
+        if fh is not None and not fh.closed:
+            fh.close()

@@ -1,0 +1,56 @@
+"""MetricsLogger serialization: numpy/jax values must land as valid jsonl
+(the old `default=float` raised TypeError on arrays), and close() must be
+idempotent (train and serve teardown paths can both reach it)."""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.utils.metrics import MetricsLogger, _json_default
+
+
+def test_log_numpy_and_jax_values(tmp_path):
+    path = tmp_path / "m.jsonl"
+    m = MetricsLogger(str(path), stdout_interval=1e9)
+    m.log(
+        {
+            "np_scalar": np.float32(1.5),
+            "np_int": np.int64(7),
+            "np_arr": np.arange(3),
+            "np_big": np.zeros((64, 64)),
+            "jax_scalar": jnp.asarray(2.5),
+            "jax_arr": jnp.arange(4),
+            "weird": object(),
+            "plain": 3,
+        }
+    )
+    m.close()
+    rec = json.loads(path.read_text().strip())
+    assert rec["np_scalar"] == 1.5
+    assert rec["np_int"] == 7
+    assert rec["np_arr"] == [0, 1, 2]
+    # big arrays are summarized, never serialized element-wise
+    assert "shape=(64, 64)" in rec["np_big"]
+    assert rec["jax_scalar"] == 2.5
+    assert rec["jax_arr"] == [0, 1, 2, 3]
+    assert isinstance(rec["weird"], str)
+    assert rec["plain"] == 3
+
+
+def test_close_idempotent(tmp_path):
+    m = MetricsLogger(str(tmp_path / "m.jsonl"))
+    m.log({"a": 1})
+    m.close()
+    m.close()  # second close must be a no-op, not ValueError
+    m2 = MetricsLogger(None)
+    m2.log({"a": 1})  # no file -> stdout only, still fine
+    m2.close()
+    m2.close()
+
+
+def test_json_default_zero_dim_array():
+    assert _json_default(np.asarray(3.0)) == 3.0
+    assert _json_default(jnp.asarray(3)) == 3

@@ -1,0 +1,388 @@
+"""Serving-plane tests (r2d2_tpu/serve): bit-parity with the direct acting
+path under interleaved multi-session traffic, LRU eviction/re-admission,
+bounded jit traces, checkpoint hot-reload under live traffic, and
+supervised crash recovery. All CPU tier-1 — tiny_test shapes."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import tiny_test
+from r2d2_tpu.serve import (
+    LocalClient,
+    MicroBatcher,
+    PolicyClient,
+    PolicyServer,
+    QueueFullError,
+    ServeConfig,
+    reference_act,
+)
+from r2d2_tpu.serve.client import serve_tcp
+from r2d2_tpu.serve.state_cache import RecurrentStateCache
+from r2d2_tpu.utils.checkpoint import save_checkpoint
+
+
+CFG = tiny_test()
+
+
+@pytest.fixture(scope="module")
+def base_server():
+    """One warm server shared by the pure-traffic tests (module scope:
+    network init + bucket compiles are the slow part)."""
+    srv = PolicyServer(
+        CFG,
+        ServeConfig(buckets=(2, 4, 8), max_wait_ms=3.0, cache_capacity=64),
+    )
+    srv.warmup()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+class SessionReference:
+    """The direct per-session acting path: replays a recorded request
+    stream through `reference_act`, carrying (h, c, last_action) exactly
+    as the training/eval episode-start rules do."""
+
+    def __init__(self, net, hidden_dim: int):
+        self.net = net
+        self.h = jnp.zeros((1, hidden_dim), jnp.float32)
+        self.c = jnp.zeros((1, hidden_dim), jnp.float32)
+        self.last_action = np.zeros(1, np.int32)
+        self.started = False
+
+    def step(self, params, obs, reward: float, reset: bool):
+        if reset or not self.started:
+            self.h = jnp.zeros_like(self.h)
+            self.c = jnp.zeros_like(self.c)
+            self.last_action = np.zeros(1, np.int32)
+            reward = 0.0
+            self.started = True
+        q, (self.h, self.c) = reference_act(
+            self.net, params, obs[None],
+            self.last_action, np.array([reward], np.float32),
+            (self.h, self.c),
+        )
+        q = np.asarray(q)[0]
+        action = int(np.argmax(q))
+        self.last_action = np.array([action], np.int32)
+        return q, action
+
+
+# --------------------------------------------------------------- bit parity
+
+
+def test_batched_parity_interleaved_sessions(base_server):
+    """Concurrent session threads produce batches of mixed composition;
+    every response must still be bit-identical to the direct per-session
+    reference path."""
+    srv = base_server
+    client = LocalClient(srv)
+    params = srv._published[0]
+    rng = np.random.default_rng(1)
+    n_sessions, n_steps = 5, 12
+    streams = [
+        [
+            (
+                rng.integers(0, 255, CFG.obs_shape, dtype=np.uint8),
+                float(rng.normal()),
+                bool(t == 6 and s == 2),  # one mid-stream client reset
+            )
+            for t in range(n_steps)
+        ]
+        for s in range(n_sessions)
+    ]
+    responses = [[] for _ in range(n_sessions)]
+    barrier = threading.Barrier(n_sessions)
+
+    def run_session(s: int) -> None:
+        barrier.wait()  # overlap the streams so real batching happens
+        for obs, reward, reset in streams[s]:
+            responses[s].append(
+                client.act(f"parity-{s}", obs, reward=reward, reset=reset)
+            )
+
+    threads = [
+        threading.Thread(target=run_session, args=(s,)) for s in range(n_sessions)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+
+    for s in range(n_sessions):
+        ref = SessionReference(srv.net, CFG.hidden_dim)
+        for (obs, reward, reset), res in zip(streams[s], responses[s]):
+            q_ref, a_ref = ref.step(params, obs, reward, reset)
+            np.testing.assert_array_equal(q_ref, np.asarray(res.q))
+            assert a_ref == res.action
+
+
+def test_eviction_and_readmission(base_server):
+    """A session evicted under cache pressure is re-admitted FRESH: its
+    next response matches the reference path restarted from zero state."""
+    srv = base_server
+    client = LocalClient(srv)
+    params = srv._published[0]
+    rng = np.random.default_rng(2)
+
+    obs0 = rng.integers(0, 255, CFG.obs_shape, dtype=np.uint8)
+    obs1 = rng.integers(0, 255, CFG.obs_shape, dtype=np.uint8)
+    client.act("evict-me", obs0, reset=True)
+    # force the eviction directly (the LRU-pressure path is exercised in
+    # test_state_cache_lru below; here we pin the serving semantics)
+    assert srv.cache.evict("evict-me")
+    res = client.act("evict-me", obs1, reward=1.5)
+
+    ref = SessionReference(srv.net, CFG.hidden_dim)
+    # the reference restarts from zero: the carried reward/action are gone
+    q_ref, a_ref = ref.step(params, obs1, 1.5, reset=True)
+    np.testing.assert_array_equal(q_ref, np.asarray(res.q))
+    assert a_ref == res.action
+    # contrast: a session that KEPT its slot must NOT equal the fresh path
+    client.act("keeper", obs0, reset=True)
+    res_kept = client.act("keeper", obs1, reward=1.5)
+    assert not np.array_equal(q_ref, np.asarray(res_kept.q))
+
+
+def test_state_cache_lru():
+    cache = RecurrentStateCache(capacity=2, hidden_dim=4)
+    s_a, _ = cache.assign(["a"])
+    s_b, _ = cache.assign(["b"])
+    cache.assign(["a"])  # touch a -> b becomes LRU
+    _, fresh_c = cache.assign(["c"])  # evicts b
+    assert fresh_c[0]
+    assert "b" not in cache and "a" in cache
+    _, fresh_b = cache.assign(["b"])  # re-admission is fresh
+    assert fresh_b[0]
+    assert cache.evictions == 2
+    with pytest.raises(ValueError):
+        cache.assign(["x", "x"])
+    assert cache.pad_slot == 2
+
+
+def test_compile_count_bounded_by_buckets(base_server):
+    """The whole module's traffic — warmup, parity threads, evictions —
+    may trace the serve step at most once per bucket shape."""
+    assert base_server.trace_count <= len(base_server.batcher.buckets)
+
+
+# ------------------------------------------------------------ micro-batcher
+
+
+def test_batcher_same_session_deferred():
+    b = MicroBatcher(buckets=(2, 4), max_wait_s=0.01, queue_depth=16)
+    b.submit("s", np.zeros(1), reset=True)
+    b.submit("s", np.zeros(1))
+    b.submit("t", np.zeros(1))
+    first = b.next_batch(timeout=0.1)
+    # one session at most once per batch; its second request waits
+    assert sorted(r.session_id for r in first) == ["s", "t"]
+    second = b.next_batch(timeout=0.1)
+    assert [r.session_id for r in second] == ["s"]
+    assert b.deferrals == 1
+    assert b.bucket_for(1) == 2 and b.bucket_for(3) == 4
+
+
+def test_batcher_rejects_min_bucket_one():
+    with pytest.raises(ValueError):
+        MicroBatcher(buckets=(1, 4))
+
+
+def test_server_rejects_cache_smaller_than_bucket():
+    # a batch's own admissions must never evict a co-batched session
+    with pytest.raises(ValueError, match="cache_capacity"):
+        PolicyServer(CFG, ServeConfig(buckets=(2, 8), cache_capacity=4))
+
+
+def test_queue_overload_fails_fast():
+    b = MicroBatcher(buckets=(2,), queue_depth=2)
+    b.submit("a", np.zeros(1))
+    b.submit("b", np.zeros(1))
+    fut = b.submit("c", np.zeros(1))
+    with pytest.raises(QueueFullError):
+        fut.result(timeout=1.0)
+    assert b.stats()["rejected"] == 1
+
+
+# ------------------------------------------------- hot reload + supervision
+
+
+def _bump_params(state, scale: float):
+    return state.replace(
+        params=jax.tree.map(lambda x: (x * scale).astype(x.dtype), state.params)
+    )
+
+
+def test_hot_reload_e2e(tmp_path):
+    """The acceptance e2e: >= 3 concurrent CatchHostEnv sessions driven to
+    episode completion through the client while a new checkpoint lands
+    mid-traffic. Every response must be bit-identical to the direct-act
+    reference under the params version that answered it — no dropped and
+    no torn requests."""
+    from r2d2_tpu.envs.catch import CatchHostEnv
+
+    cfg = CFG.replace(action_dim=3)  # catch's action space
+    ckpt_dir = str(tmp_path / "ckpt")
+    srv = PolicyServer(
+        cfg,
+        ServeConfig(buckets=(2, 4, 8), max_wait_ms=3.0, cache_capacity=64,
+                    poll_interval_s=0.05),
+        checkpoint_dir=ckpt_dir,
+    )
+    state1 = _bump_params(srv._template, 1.0).replace(step=jnp.asarray(1, jnp.int32))
+    state2 = _bump_params(srv._template, 1.05).replace(step=jnp.asarray(2, jnp.int32))
+    save_checkpoint(ckpt_dir, state1, 0, 0.0)
+    assert srv.reload_now()  # serve the step-1 series before traffic
+    params_by_step = {1: srv._published[0]}
+    srv.warmup()
+    srv.start()  # spawns serve-loop + ckpt-watcher
+    client = LocalClient(srv)
+
+    n_sessions = 4
+    stop = threading.Event()
+    records = [[] for _ in range(n_sessions)]  # (obs, reward, reset, result)
+    episodes = [0] * n_sessions
+    errors: list = []
+
+    def run_session(i: int) -> None:
+        env = CatchHostEnv(height=CFG.obs_shape[0], width=CFG.obs_shape[1], seed=i)
+        sid = f"sess-{i}"
+        obs, reward, reset = env.reset(), 0.0, True
+        try:
+            while not stop.is_set() or episodes[i] == 0:
+                res = client.act(sid, obs, reward=reward, reset=reset)
+                records[i].append((obs, reward, reset, res))
+                obs, reward, done, _ = env.step(res.action)
+                reset = done
+                if done:
+                    episodes[i] += 1
+                    obs, reward = env.reset(), 0.0
+        except Exception as e:  # pragma: no cover - failure detail for CI
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=run_session, args=(i,)) for i in range(n_sessions)
+    ]
+    for t in threads:
+        t.start()
+
+    # land a new checkpoint mid-traffic; the watcher must pick it up
+    time.sleep(0.3)
+    save_checkpoint(ckpt_dir, state2, 0, 0.0)
+    deadline = time.monotonic() + 20.0
+    while srv._published[1] != 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert srv._published[1] == 2, "watcher never picked up the new checkpoint"
+    params_by_step[2] = srv._published[0]
+    # keep traffic flowing until every session has answered under the NEW
+    # params and finished at least one episode
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        if all(
+            any(r.ckpt_step == 2 for (_, _, _, r) in rec) for rec in records
+        ) and all(e >= 1 for e in episodes):
+            break
+        time.sleep(0.02)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    srv.check()  # no worker death
+    srv.stop()
+
+    assert not errors, errors
+    assert all(e >= 1 for e in episodes)
+    for i in range(n_sessions):
+        assert any(r.ckpt_step == 2 for (_, _, _, r) in records[i]), (
+            f"session {i} never served by the reloaded checkpoint"
+        )
+        ref = SessionReference(srv.net, CFG.hidden_dim)
+        for obs, reward, reset, res in records[i]:
+            assert res.ckpt_step in params_by_step  # never torn/unknown
+            q_ref, a_ref = ref.step(params_by_step[res.ckpt_step], obs, reward, reset)
+            np.testing.assert_array_equal(q_ref, np.asarray(res.q))
+            assert a_ref == res.action
+
+
+def test_crash_recovery_preserves_sessions():
+    """A raising serve iteration fails only the in-flight futures; the
+    supervisor restarts the loop and the session cache still carries the
+    pre-crash recurrent state (parity with an uninterrupted reference)."""
+    srv = PolicyServer(
+        CFG, ServeConfig(buckets=(2,), max_wait_ms=1.0, cache_capacity=8)
+    )
+    srv.warmup()
+    srv.start()
+    client = LocalClient(srv)
+    params = srv._published[0]
+    rng = np.random.default_rng(3)
+    obs = [rng.integers(0, 255, CFG.obs_shape, dtype=np.uint8) for _ in range(3)]
+
+    ref = SessionReference(srv.net, CFG.hidden_dim)
+    res0 = client.act("s", obs[0], reset=True)
+    ref.step(params, obs[0], 0.0, True)
+
+    real_iteration = srv._serve_iteration
+    bomb_active = threading.Event()
+
+    def bomb():
+        bomb_active.set()
+        batch = srv.batcher.next_batch(timeout=0.25)
+        if batch:
+            # one-shot: un-patch BEFORE raising, so the restarted loop (and
+            # any already-blocked bomb call) serves the retry normally
+            srv._serve_iteration = real_iteration
+            srv._inflight = batch
+            raise RuntimeError("injected serve fault")
+
+    srv._serve_iteration = bomb
+    # wait until the loop is actually INSIDE the patched body: a submit
+    # racing the previous (healthy) iteration's next_batch would be served
+    # normally and never crash
+    assert bomb_active.wait(timeout=10.0)
+    fut = srv.submit("s", obs[1], reward=0.5)
+    with pytest.raises(RuntimeError, match="retry"):
+        fut.result(timeout=10.0)
+
+    deadline = time.monotonic() + 10.0
+    while srv._serve_worker.restarts < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    counters = srv.check()  # restart budget not exhausted -> no raise
+    assert counters["worker_restarts"] >= 1
+
+    # the retried request continues from the LAST COMMITTED carry
+    res1 = client.act("s", obs[1], reward=0.5)
+    q_ref, a_ref = ref.step(params, obs[1], 0.5, False)
+    np.testing.assert_array_equal(q_ref, np.asarray(res1.q))
+    assert a_ref == res1.action
+    assert res0.params_version == res1.params_version
+    srv.stop()
+
+
+# ----------------------------------------------------------------- frontend
+
+
+def test_tcp_roundtrip(base_server):
+    srv = base_server
+    tcp, _ = serve_tcp(srv, port=0)
+    try:
+        port = tcp.server_address[1]
+        rng = np.random.default_rng(4)
+        obs = rng.integers(0, 255, CFG.obs_shape, dtype=np.uint8)
+        with PolicyClient(port=port) as remote:
+            resp = remote.act("tcp-1", obs, reset=True, want_q=True)
+            ref = SessionReference(srv.net, CFG.hidden_dim)
+            q_ref, a_ref = ref.step(srv._published[0], obs, 0.0, True)
+            assert resp["action"] == a_ref
+            np.testing.assert_allclose(np.asarray(resp["q"], np.float32), q_ref)
+            remote.evict("tcp-1")
+            assert "tcp-1" not in srv.cache
+    finally:
+        tcp.shutdown()
+        tcp.server_close()
