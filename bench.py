@@ -98,6 +98,65 @@ def _system_cfg(E: int = 256, core: str = "lstm", lru_chunk: int = 0):
     )
 
 
+def recovery_main():
+    """Preemption-recovery benchmark: kill a small training run mid-stream
+    with an injected SIGTERM (utils/faults.py — the deterministic stand-in
+    for a real grace-window delivery), then measure the wall time from
+    starting the resumed Trainer's construction to its first COMPLETED
+    update. That interval is the full operational cost of a preemption:
+    checkpoint restore + replay-snapshot restore + mid-run carry rehydrate
+    + recompile + first sample/update. Reported as the standard BENCH row
+    `recovery_to_first_update_s`."""
+    import os
+    import tempfile
+
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.train import Trainer
+    from r2d2_tpu.utils import faults
+
+    workdir = tempfile.mkdtemp(prefix="bench_recovery_")
+    cfg = tiny_test().replace(
+        env_name="catch",
+        snapshot_replay=True,
+        checkpoint_dir=os.path.join(workdir, "ckpt"),
+        metrics_path=os.path.join(workdir, "metrics.jsonl"),
+        training_steps=40,
+        save_interval=10_000,  # only the preemption checkpoint exists
+        learning_starts=48,
+    )
+    # phase 1: train until the injected SIGTERM cuts the run (update #6)
+    faults.install(faults.FaultPlane(schedule={"trainer.update": {6: "sigterm"}}))
+    try:
+        trainer = Trainer(cfg)
+        trainer.run_inline(env_steps_per_update=4)
+        assert trainer.preempted, "injected SIGTERM did not preempt the run"
+        cut_step = trainer._step
+    finally:
+        faults.uninstall()
+    print(f"preempted at step {cut_step}; resuming...", file=sys.stderr)
+
+    # phase 2: the measured recovery — construction-to-first-update
+    t0 = time.time()
+    resumed = Trainer(cfg, resume=True)
+    m, step = resumed._one_update(resumed.plane.sample())
+    jax.block_until_ready(resumed.state.params)
+    recovery_s = time.time() - t0
+    resumed.finish_updates()
+    assert step == cut_step + 1
+    print(
+        json.dumps(
+            {
+                "metric": "recovery_to_first_update_s",
+                "value": round(recovery_s, 3),
+                "unit": "s",
+                "cut_step": cut_step,
+                "resumed_step": step,
+                "loss": round(float(m["loss"]), 4),
+            }
+        )
+    )
+
+
 def fused_system_main(collect_every: int = 6, core: str = "lstm", lru_chunk: int = 0):
     """Full-system throughput via the fused megastep (megastep.py): ONE
     dispatch = K updates + a collection chunk every collect_every'th
@@ -727,7 +786,7 @@ if __name__ == "__main__":
     p = argparse.ArgumentParser(description="r2d2_tpu benchmarks")
     p.add_argument(
         "--mode", default="learner",
-        choices=["learner", "system", "fused", "long_context", "serve"],
+        choices=["learner", "system", "fused", "long_context", "serve", "recovery"],
         help="learner: fused-update throughput on synthetic replay (the "
              "driver's default metric). system: concurrent on-device "
              "collection + learning via threads. fused: the same full "
@@ -735,7 +794,9 @@ if __name__ == "__main__":
              "learner throughput on the seq-581 stretch preset. serve: "
              "serving-plane load test (r2d2_tpu/serve) — requests/s and "
              "latency percentiles under concurrent stateful sessions with "
-             "a mid-window checkpoint hot-reload.",
+             "a mid-window checkpoint hot-reload. recovery: preempt a run "
+             "with an injected SIGTERM and measure resume-to-first-update "
+             "wall time (utils/faults.py).",
     )
     p.add_argument(
         "--collect-every", type=int, default=6,
@@ -775,7 +836,9 @@ if __name__ == "__main__":
         help="serve mode: measurement window (a hot reload fires halfway)",
     )
     args = p.parse_args()
-    if args.mode == "serve":
+    if args.mode == "recovery":
+        recovery_main()
+    elif args.mode == "serve":
         serve_main(args.core, args.lru_chunk, args.sessions, args.serve_seconds)
     elif args.mode == "system":
         system_main(args.core, args.lru_chunk)

@@ -26,6 +26,7 @@ Semantics preserved per env (reference worker.py:685-747):
 
 from __future__ import annotations
 
+import json
 import threading
 from typing import Callable, List, Optional, Sequence
 
@@ -36,6 +37,7 @@ import numpy as np
 from r2d2_tpu.config import R2D2Config
 from r2d2_tpu.models.r2d2 import R2D2Network
 from r2d2_tpu.replay.accumulator import SequenceAccumulator
+from r2d2_tpu.utils.faults import fault_point
 
 
 class ParamStore:
@@ -202,6 +204,7 @@ class VectorizedActor:
             self.step()
 
     def step(self) -> None:
+        fault_point("actor.step")
         cfg = self.cfg
         E = self.env.num_envs
 
@@ -298,6 +301,57 @@ class VectorizedActor:
         hidden) sequences into replay. Instead: discard every in-flight
         accumulator window and start fresh episodes in all slots."""
         self._reset_state(np.array(self.env.reset_all()))
+
+    def carry_state(self) -> dict:
+        """Every mutable field step() reads, as flat npz-safe numpy arrays
+        (the preemption carry). Restoring this on a fresh actor of the same
+        config makes the next step() bit-identical to the one an
+        uninterrupted run would have taken — unlike resync(), which
+        discards in-flight windows and restarts the episode streams."""
+        h, c = self.carry
+        d = {
+            "rng": np.asarray(json.dumps(self.rng.bit_generator.state)),
+            "obs": np.asarray(self.obs),
+            "last_action": self.last_action.copy(),
+            "last_reward": self.last_reward.copy(),
+            "carry_h": np.asarray(h),
+            "carry_c": np.asarray(c),
+            "episode_steps": self.episode_steps.copy(),
+            "pending_cut": self._pending_cut.copy(),
+            "pending_truncate": self._pending_truncate.copy(),
+            "counters": np.asarray(
+                [self.total_steps, self._steps_since_refresh, self.param_version],
+                np.int64,
+            ),
+        }
+        for j, leaf in enumerate(jax.tree.leaves(self.params)):
+            d[f"params_{j}"] = np.asarray(leaf)
+        for i, acc in enumerate(self.accs):
+            for k, v in acc.carry_state().items():
+                d[f"acc{i}_{k}"] = v
+        return d
+
+    def restore_carry(self, d: dict) -> None:
+        self.rng.bit_generator.state = json.loads(str(np.asarray(d["rng"])[()]))
+        self.obs = np.array(d["obs"])
+        self.last_action = np.asarray(d["last_action"], np.int32)
+        self.last_reward = np.asarray(d["last_reward"], np.float32)
+        self.carry = (jnp.asarray(d["carry_h"]), jnp.asarray(d["carry_c"]))
+        self.episode_steps = np.asarray(d["episode_steps"], np.int64)
+        self._pending_cut = np.asarray(d["pending_cut"], bool)
+        self._pending_truncate = np.asarray(d["pending_truncate"], bool)
+        counters = np.asarray(d["counters"])
+        self.total_steps = int(counters[0])
+        self._steps_since_refresh = int(counters[1])
+        self.param_version = int(counters[2])
+        treedef = jax.tree.structure(self.params)
+        leaves = [jnp.asarray(d[f"params_{j}"]) for j in range(treedef.num_leaves)]
+        self.params = jax.tree.unflatten(treedef, leaves)
+        for i, acc in enumerate(self.accs):
+            prefix = f"acc{i}_"
+            acc.restore_carry({
+                k[len(prefix):]: v for k, v in d.items() if k.startswith(prefix)
+            })
 
     # ---------------------------------------------------------------- utils
 

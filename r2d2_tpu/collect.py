@@ -446,3 +446,23 @@ class DeviceCollector:
             self.env_state = initial_carry(self.cfg, self._fn_env, self.E, kr)
         else:
             self.env_state = jax.vmap(self._fn_env.reset)(jax.random.split(kr, self.E))
+
+    def carry_state(self) -> dict:
+        """Preemption carry (npz-safe): the PRNG key, step counter, and the
+        full env/episode carry as indexed pytree leaves. step() is a pure
+        function of (params, env_state, key), so restoring these resumes
+        the collection stream exactly."""
+        d = {
+            "key": np.asarray(self.key),
+            "total_steps": np.asarray(self.total_steps, np.int64),
+        }
+        for j, leaf in enumerate(jax.tree.leaves(self.env_state)):
+            d[f"env_{j}"] = np.asarray(leaf)
+        return d
+
+    def restore_carry(self, d: dict) -> None:
+        self.key = jnp.asarray(d["key"])
+        self.total_steps = int(np.asarray(d["total_steps"])[()])
+        treedef = jax.tree.structure(self.env_state)
+        leaves = [jnp.asarray(d[f"env_{j}"]) for j in range(treedef.num_leaves)]
+        self.env_state = jax.tree.unflatten(treedef, leaves)

@@ -163,6 +163,18 @@ class R2D2Config:
     # replay distribution instead of refilling from scratch. Costs one
     # obs-store-sized .npz write (~7 KB/transition at 84x84).
     snapshot_replay: bool = False
+    # > 0: also write the replay snapshot every N learner updates, off the
+    # hot path (background thread; the previous snapshot is kept until the
+    # new one lands via atomic rename). Requires snapshot_replay=True. A
+    # crash between checkpoints then restarts from a recent replay
+    # distribution instead of the run's start.
+    snapshot_every: int = 0
+    # tiered plane only: stage chunks synchronously on the consumer thread
+    # instead of the prefetch pipeline. Removes the staging-thread RNG race
+    # with priority write-backs, making the tiered sampling stream
+    # bit-reproducible (the chaos suite's resume-exactness contract);
+    # costs the pipeline's overlap, so keep False for throughput runs.
+    deterministic_staging: bool = False
     metrics_path: Optional[str] = None  # jsonl metrics file
     use_native_replay: bool = True  # C++ replay core if built, else numpy
     # replay data plane: "host" (numpy store, batches shipped per update),
@@ -299,6 +311,18 @@ class R2D2Config:
             "host", "tiered", "device", "sharded", "multihost"
         ):
             raise ValueError(f"unknown replay_plane {self.replay_plane!r}")
+        if self.snapshot_every < 0:
+            raise ValueError("snapshot_every must be >= 0")
+        if self.snapshot_every > 0 and not self.snapshot_replay:
+            raise ValueError(
+                "snapshot_every > 0 schedules periodic replay snapshots; "
+                "it requires snapshot_replay=True"
+            )
+        if self.deterministic_staging and self.replay_plane != "tiered":
+            raise ValueError(
+                "deterministic_staging is the tiered plane's synchronous "
+                "staging mode; set replay_plane='tiered' (or leave it False)"
+            )
         if self.replay_plane == "multihost":
             if self.tp_size != 1:
                 raise ValueError("replay_plane='multihost' supports tp_size=1")

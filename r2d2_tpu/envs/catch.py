@@ -332,3 +332,22 @@ class CatchVecEnv:
             np.asarray(done),
             np.asarray(next_obs),
         )
+
+    def get_state(self) -> dict:
+        """Full env state as host arrays (npz-safe), for the preemption
+        carry: a set_state on a fresh instance of the same geometry resumes
+        the exact episodes, including each env's PRNG key stream."""
+        d = {"s_" + name: np.asarray(v)
+             for name, v in zip(CatchState._fields, self._state)}
+        d["seed"] = np.asarray(self._seed, np.int64)
+        d["reset_count"] = np.asarray(self._reset_count, np.int64)
+        return d
+
+    def set_state(self, d: dict) -> None:
+        self._state = CatchState(*(
+            jnp.asarray(d["s_" + name]) for name in CatchState._fields
+        ))
+        self._seed = int(np.asarray(d["seed"])[()])
+        # overrides the constructor's implicit reset: the next reset_all
+        # continues the saved key schedule, not a replay of it
+        self._reset_count = int(np.asarray(d["reset_count"])[()])

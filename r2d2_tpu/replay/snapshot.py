@@ -32,7 +32,7 @@ restores it on --resume.
 from __future__ import annotations
 
 import os
-from typing import Dict
+from typing import Dict, Optional
 
 import jax
 import numpy as np
@@ -40,16 +40,25 @@ import numpy as np
 from r2d2_tpu.replay.control_plane import ReplayControlPlane
 from r2d2_tpu.replay.device_store import DeviceReplayBuffer
 from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+from r2d2_tpu.utils.faults import fault_point
 
 STORE_FIELDS = (
     "obs", "last_action", "last_reward", "action", "n_step_reward",
     "gamma", "hidden", "burn_in", "learning", "forward",
 )
 
+# ptr_advances is the lap-detection stamp deferred write-backs compare
+# against; dropping it across a resume would let a stale write-back land
+# after a full buffer lap. Old snapshots (pre ptr_advances) restore with 0.
 _COUNTERS = (
     "block_ptr", "size", "env_steps", "num_episodes", "episode_reward_sum",
-    "total_episodes", "total_reward_sum",
+    "total_episodes", "total_reward_sum", "ptr_advances",
 )
+
+# extras ride in the same npz under this prefix (mid-run carry: trainer
+# RNG / actor / env / pending write-back state), so snapshot + carry land
+# or are lost atomically — one os.replace
+_EXTRA_PREFIX = "x_"
 
 
 def _plane_state(plane: ReplayControlPlane, prefix: str = "") -> Dict[str, np.ndarray]:
@@ -64,7 +73,11 @@ def _plane_state(plane: ReplayControlPlane, prefix: str = "") -> Dict[str, np.nd
 
 def _restore_plane(plane: ReplayControlPlane, d, prefix: str = "") -> None:
     plane.tree.load_leaves(d[prefix + "tree_leaves"])
+    names = getattr(d, "files", None) or list(d)
     for k in _COUNTERS:
+        if prefix + k not in names:  # pre-ptr_advances snapshot
+            setattr(plane, k, 0)
+            continue
         v = d[prefix + k][()]
         setattr(plane, k, float(v) if "reward" in k else int(v))
     plane.learning_sum[:] = d[prefix + "learning_sum"]
@@ -100,16 +113,19 @@ def _validated_stores(
 def _atomic_savez(path: str, payload: Dict[str, np.ndarray]) -> None:
     # keep the .npz suffix on the temp name: np.savez APPENDS .npz to
     # filenames without it, which would break the rename
+    fault_point("snapshot.write")
     tmp = path + ".tmp.npz"
     np.savez(tmp, **payload)
     os.replace(tmp, path)
 
 
-def save_replay(replay, path: str) -> None:
+def save_replay(replay, path: str, extra: Optional[Dict[str, np.ndarray]] = None) -> None:
     """Snapshot any replay plane (host / device / sharded) to `path`.
 
     The payload (control state + a copy of every store) is captured under
-    the buffer lock; the npz write happens after release."""
+    the buffer lock; the npz write happens after release. `extra` carries
+    caller state (trainer RNG / actor / env / pending write-backs) in the
+    same file under a reserved prefix — restore_replay hands it back."""
     from r2d2_tpu.replay.multihost_store import MultiHostShardedReplay
     from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
 
@@ -152,20 +168,29 @@ def save_replay(replay, path: str) -> None:
                 payload["store_" + k] = getattr(replay, k + "_store").copy()
     else:
         raise TypeError(f"unknown replay type {type(replay).__name__}")
+    for k, v in (extra or {}).items():
+        payload[_EXTRA_PREFIX + k] = np.asarray(v)
     _atomic_savez(path, payload)
 
 
-def restore_replay(replay, path: str) -> None:
+def restore_replay(replay, path: str) -> Dict[str, np.ndarray]:
     """Restore a snapshot into a freshly built replay of the SAME config.
 
     Mismatches (different plane kind, capacity, obs shape, hidden dim, dp)
     raise BEFORE any state is touched — a failed restore leaves the buffer
-    exactly as constructed."""
+    exactly as constructed. Returns the `extra` dict the snapshot was
+    saved with (empty for plain snapshots), fully materialized."""
     from r2d2_tpu.replay.multihost_store import MultiHostShardedReplay
     from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
 
     with np.load(path, allow_pickle=False) as d:
         kind = str(d["kind"])
+        # materialize extras before the NpzFile closes
+        extras = {
+            k[len(_EXTRA_PREFIX):]: np.asarray(d[k])
+            for k in d.files
+            if k.startswith(_EXTRA_PREFIX)
+        }
         if isinstance(replay, MultiHostShardedReplay):
             _check_kind(kind, "multihost")
             with replay.lock:
@@ -229,3 +254,4 @@ def restore_replay(replay, path: str) -> None:
                     current[k][:] = vals[k]
         else:
             raise TypeError(f"unknown replay type {type(replay).__name__}")
+    return extras

@@ -46,6 +46,7 @@ from typing import Optional
 import numpy as np
 
 from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+from r2d2_tpu.utils.faults import fault_point, with_retries
 
 
 @dataclasses.dataclass
@@ -89,6 +90,10 @@ class StagedChunk:
     old_ptr: int
     old_advances: int
     env_steps: int
+    # the sampling RNG's bit-generator state captured BEFORE this chunk's
+    # draws — the rewind point if the chunk is discarded at preemption
+    # (TieredPrefetchPipeline.stop(rewind=True))
+    rng_state: Optional[dict] = None
 
 
 class TieredReplayBuffer(ReplayBuffer):
@@ -184,9 +189,11 @@ def stage_chunk(replay: TieredReplayBuffer, rng: np.random.Generator, k: int,
 
     from r2d2_tpu.learner import DeviceBatch
 
+    pre_state = rng.bit_generator.state
     sw = replay.sample_window_stack(rng, k)
-    cm = timer.h2d(sw.nbytes()) if timer is not None else contextlib.nullcontext()
-    with cm:
+
+    def lift():
+        fault_point("tiered.stage_h2d")
         batch = jax.device_put(DeviceBatch(
             obs=sw.obs,
             last_action=sw.last_action.astype(np.int32),
@@ -201,12 +208,21 @@ def stage_chunk(replay: TieredReplayBuffer, rng: np.random.Generator, k: int,
             is_weights=sw.is_weights,
         ))
         jax.block_until_ready(batch)
+        return batch
+
+    cm = timer.h2d(sw.nbytes()) if timer is not None else contextlib.nullcontext()
+    with cm:
+        # a torn/failed transfer re-lifts from the already-gathered host
+        # windows: the retry never re-draws, so the sampling stream is
+        # unaffected by transfer flakes
+        batch = with_retries(lift, "tiered.stage_h2d")
     return StagedChunk(
         batch=batch,
         idxes=sw.idxes,
         old_ptr=sw.old_ptr,
         old_advances=sw.old_advances,
         env_steps=sw.env_steps,
+        rng_state=pre_state,
     )
 
 
@@ -236,6 +252,9 @@ class TieredPrefetchPipeline:
         self.q: "queue.Queue[StagedChunk]" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._err: Optional[BaseException] = None
+        # RNG state before the draw of a chunk staged but NOT yet queued —
+        # the rewind point when stop(rewind=True) catches a stage in flight
+        self._inflight_state: Optional[dict] = None
         self._thread = threading.Thread(
             target=self._run, name="tiered-stage", daemon=True
         )
@@ -250,10 +269,12 @@ class TieredPrefetchPipeline:
                     # all-zero tree
                     time.sleep(0.01)
                     continue
+                self._inflight_state = self.rng.bit_generator.state
                 chunk = stage_chunk(self.replay, self.rng, self.k, self.timer)
                 while not self._stop.is_set():
                     try:
                         self.q.put(chunk, timeout=0.1)
+                        self._inflight_state = None
                         break
                     except queue.Full:
                         pass
@@ -274,6 +295,24 @@ class TieredPrefetchPipeline:
                     if not self._thread.is_alive() and self._err is None:
                         raise RuntimeError("tiered staging thread exited")
 
-    def stop(self) -> None:
+    def stop(self, rewind: bool = False) -> None:
+        """Stop the staging thread. With rewind=True (the preemption path),
+        also rewind the sampling RNG to the state before the EARLIEST
+        unconsumed draw — queued chunks are discarded, and a resumed run
+        re-draws them identically, keeping the sampling stream bit-exact
+        across the preempt instead of skipping the prefetched batches."""
         self._stop.set()
         self._thread.join(timeout=10.0)
+        if not rewind:
+            return
+        states = []
+        while True:  # drain in FIFO (= draw) order
+            try:
+                states.append(self.q.get_nowait().rng_state)
+            except queue.Empty:
+                break
+        states.append(self._inflight_state)
+        for st in states:
+            if st is not None:
+                self.rng.bit_generator.state = st
+                break

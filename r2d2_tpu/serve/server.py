@@ -42,6 +42,7 @@ from r2d2_tpu.models.r2d2 import R2D2Network
 from r2d2_tpu.serve.batcher import MicroBatcher, ServeRequest
 from r2d2_tpu.serve.state_cache import RecurrentStateCache
 from r2d2_tpu.utils.checkpoint import latest_checkpoint_step, restore_checkpoint
+from r2d2_tpu.utils.faults import Backoff, InjectedFault, fault_point, total_retries
 from r2d2_tpu.utils.metrics import MetricsLogger
 from r2d2_tpu.utils.supervision import Supervisor
 
@@ -168,6 +169,13 @@ class PolicyServer:
         self.trace_count = 0  # python-body counter: +1 per jit trace
         self.reloads = 0
         self.reload_errors = 0
+        # watcher poll escalation on transient reload failures (checkpoint
+        # dir not mounted yet, step pruned between list and restore): back
+        # off instead of hammering the fs at poll_interval_s
+        self._watch_backoff = Backoff(
+            base=serve_cfg.poll_interval_s, factor=2.0,
+            max_delay=max(30.0, serve_cfg.poll_interval_s),
+        )
         self._inflight: List[ServeRequest] = []
         self._step = self._build_step()
 
@@ -311,19 +319,28 @@ class PolicyServer:
         # bounded work per call (supervision contract): one poll, then wait
         try:
             self.reload_now()
-        except FileNotFoundError:
-            # series advanced or a retention policy pruned the step between
-            # listing and restore; next poll re-resolves
+        except (OSError, InjectedFault):
+            # transient fs trouble: the step vanished between listing and
+            # restore (series advanced, retention pruned it —
+            # FileNotFoundError), or the checkpoint dir itself is briefly
+            # unreachable (remount, NFS hiccup). Count it and re-poll with
+            # exponential backoff; the next successful reload resets the
+            # cadence.
             self.reload_errors += 1
-        if self.supervisor is not None:
-            self.supervisor.stop.wait(self.serve_cfg.poll_interval_s)
+            wait = self._watch_backoff.fail()
         else:
-            time.sleep(self.serve_cfg.poll_interval_s)
+            self._watch_backoff.reset()
+            wait = self.serve_cfg.poll_interval_s
+        if self.supervisor is not None:
+            self.supervisor.stop.wait(wait)
+        else:
+            time.sleep(wait)
 
     def reload_now(self) -> bool:
         """One synchronous reload check (the watcher body; also usable
         directly by tests and watcher-less servers). Returns True if new
         params were published."""
+        fault_point("serve.reload")
         step = latest_checkpoint_step(self.checkpoint_dir)
         if step is None or step == self._published[1]:
             return False
@@ -395,6 +412,7 @@ class PolicyServer:
         out: Dict[str, object] = {
             "reloads": self.reloads,
             "reload_errors": self.reload_errors,
+            "io_retries": total_retries(),
             "trace_count": self.trace_count,
             "ckpt_step": self._published[1],
             "params_version": self._published[2],

@@ -24,9 +24,13 @@ on learning_starts.
 from __future__ import annotations
 
 import argparse
+import contextlib
+import json
 import os
 import queue
+import signal
 import sys
+import threading
 import time
 from typing import Callable, Optional
 
@@ -55,11 +59,17 @@ from r2d2_tpu.parallel.mesh import make_mesh, replicated_sharding, shard_batch
 from r2d2_tpu.replay.device_store import DeviceReplayBuffer
 from r2d2_tpu.replay.replay_buffer import ReplayBuffer
 from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
-from r2d2_tpu.replay.tiered_store import TieredPrefetchPipeline, TieredReplayBuffer
+from r2d2_tpu.replay.tiered_store import (
+    StagedChunk,
+    TieredPrefetchPipeline,
+    TieredReplayBuffer,
+    stage_chunk,
+)
 from r2d2_tpu.utils.checkpoint import latest_checkpoint_step, restore_checkpoint, save_checkpoint
+from r2d2_tpu.utils.faults import fault_point, install_from_env, total_retries, with_retries
 from r2d2_tpu.utils.metrics import MetricsLogger
 from r2d2_tpu.utils.profiling import TransferTimer, span, start_profiler_server, step_span
-from r2d2_tpu.utils.supervision import Supervisor, WorkerStalledError
+from r2d2_tpu.utils.supervision import PREEMPT_EXIT_CODE, Supervisor, WorkerStalledError
 
 
 def _is_procmaze(name: str) -> bool:
@@ -137,9 +147,17 @@ class _HostPlane:
     def sample(self, pipelined: bool = False):
         with span("replay/sample"):
             b = self.replay.sample_batch(self.tr.sample_rng)
-            dev = DeviceBatch.from_sampled(b)
-            if self.tr.mesh is not None:
-                dev = DeviceBatch(*shard_batch(self.tr.mesh, tuple(dev)))
+
+            def lift():
+                fault_point("host_plane.h2d")
+                dev = DeviceBatch.from_sampled(b)
+                if self.tr.mesh is not None:
+                    dev = DeviceBatch(*shard_batch(self.tr.mesh, tuple(dev)))
+                return dev
+
+            # a flaky h2d re-lifts the already-drawn host batch: retries
+            # never touch the sampling RNG, so the draw stream is stable
+            dev = with_retries(lift, "host_plane.h2d")
             return "batch", dev, b.idxes, (b.old_ptr, b.old_advances)
 
     def update(self, state, item):
@@ -184,6 +202,16 @@ class _TieredPlane:
         return self._pipe
 
     def sample(self, pipelined: bool = False):
+        if self.tr.cfg.deterministic_staging:
+            # synchronous stage on the consumer thread: no staging-thread
+            # RNG race with write-backs, so the sampling stream is
+            # bit-reproducible (the chaos suite's resume contract); trades
+            # away the pipeline's transfer/compute overlap
+            with span("replay/staged_chunk"):
+                chunk = stage_chunk(
+                    self.replay, self.tr.sample_rng, self.K, self.xfer
+                )
+                return "staged", chunk, None, None
         # both modes consume the staging pipeline: it IS the prefetcher
         # (threaded mode's sampler thread just forwards chunks into its
         # queue, adding one more buffered chunk of depth)
@@ -220,6 +248,39 @@ class _TieredPlane:
         prios, chunk = pending
         for row, idx in zip(np.asarray(prios), chunk.idxes):
             self.replay.update_priorities(idx, row, chunk.old_ptr, chunk.old_advances)
+
+    def capture_pending(self) -> Optional[dict]:
+        """Preemption capture: serialize the deferred write-back INSTEAD of
+        applying it. In an uninterrupted run the next draw happens before
+        this write-back lands (update() applies it one dispatch later), so
+        draining it at preemption would make the resumed draw see a tree
+        the uninterrupted run never had — restore_pending re-queues it so
+        the resumed iteration replays the exact apply order. Also stops the
+        staging pipeline with an RNG rewind: queued/in-flight chunks are
+        discarded and their draws re-happen identically after resume."""
+        if self._pipe is not None:
+            self._pipe.stop(rewind=True)
+            self._pipe = None
+        pending, self._pending = self._pending, None
+        if pending is None:
+            return None
+        prios, chunk = pending
+        return {
+            "prios": np.asarray(prios),
+            "idxes": np.asarray(chunk.idxes),
+            "old_ptr": np.asarray(chunk.old_ptr, np.int64),
+            "old_advances": np.asarray(chunk.old_advances, np.int64),
+        }
+
+    def restore_pending(self, d: dict) -> None:
+        chunk = StagedChunk(
+            batch=None,  # already consumed pre-preempt; only stamps remain
+            idxes=np.asarray(d["idxes"]),
+            old_ptr=int(np.asarray(d["old_ptr"])[()]),
+            old_advances=int(np.asarray(d["old_advances"])[()]),
+            env_steps=0,
+        )
+        self._pending = (np.asarray(d["prios"]), chunk)
 
     def log_extras(self) -> dict:
         return self.xfer.stats()
@@ -307,6 +368,31 @@ class _DevicePlane:
             # ring while this chunk's readback was deferred — the stamp
             # drops the batch instead of mis-applying it (control_plane)
             self.replay.update_priorities(d.idxes, row, d.old_ptr, d.old_advances)
+
+    def capture_pending(self) -> Optional[dict]:
+        """Preemption capture of the K>1 deferred readback — same apply-
+        order-preservation rationale as _TieredPlane.capture_pending."""
+        pending, self._pending = self._pending, None
+        if pending is None:
+            return None
+        prios, draws = pending
+        return {
+            "prios": np.asarray(prios),
+            "idxes": np.stack([np.asarray(d.idxes) for d in draws]),
+            "old_ptr": np.asarray([d.old_ptr for d in draws], np.int64),
+            "old_advances": np.asarray([d.old_advances for d in draws], np.int64),
+        }
+
+    def restore_pending(self, d: dict) -> None:
+        import types
+
+        draws = [
+            types.SimpleNamespace(
+                idxes=np.asarray(idx), old_ptr=int(p), old_advances=int(a)
+            )
+            for idx, p, a in zip(d["idxes"], d["old_ptr"], d["old_advances"])
+        ]
+        self._pending = (np.asarray(d["prios"]), draws)
 
     def update(self, state, item):
         kind, payload, idxes, stamp = item
@@ -563,6 +649,15 @@ class Trainer:
                 "checkpoint was trained with (or K=1)"
             )
         self.sample_rng = np.random.default_rng(cfg.seed + 2)
+        # preemption protocol: request_preempt (usually via SIGTERM inside
+        # a run mode's _sigterm_to_preempt window) sets the event; the run
+        # loop honors it at the next iteration boundary, snapshots replay +
+        # mid-run carry, writes a finalized checkpoint, and the CLI exits
+        # with PREEMPT_EXIT_CODE
+        self.preempted = False
+        self._preempt = threading.Event()
+        self._snap_thread: Optional[threading.Thread] = None
+        self._resume_carry: dict = {}
         self.plane = _PLANES[cfg.replay_plane](self)
         self.replay = self.plane.replay
         if self._resumed and cfg.snapshot_replay:
@@ -581,7 +676,7 @@ class Trainer:
             restored, failed = 0, 0
             if os.path.exists(snap):
                 try:
-                    restore_replay(self.replay, snap)
+                    self._resume_carry = restore_replay(self.replay, snap)
                     restored = self.replay.env_steps
                 except Exception as e:  # noqa: BLE001 — agreed below
                     failed = 1
@@ -621,6 +716,175 @@ class Trainer:
                 seed=cfg.seed + 1,
             )
         self.metrics = metrics or MetricsLogger(cfg.metrics_path, cfg.log_interval)
+        if self._resumed:
+            self._maybe_restore_carry()
+
+    # ---------------------------------------------------- preemption / carry
+
+    def request_preempt(self, signum=None, frame=None) -> None:
+        """Ask the run loop to cut at its next iteration boundary.
+        Signal-handler-safe: sets a flag and returns — a SIGTERM landing
+        mid-update lets the update finish, so the cut is always at a clean
+        step boundary."""
+        self._preempt.set()
+
+    def _preempt_now(self) -> bool:
+        """Checked once per run-loop iteration. Multi-process runs agree
+        via an UNCONDITIONAL allgather — the loop is in lockstep through
+        the collective update dispatches, so every process reaches this
+        the same number of times, and any host's SIGTERM cuts ALL hosts at
+        the same step (a guarded collective would deadlock the others)."""
+        local = 1 if self._preempt.is_set() else 0
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            local = int(multihost_utils.process_allgather(np.int32(local)).sum())
+        if local:
+            self.preempted = True
+        return bool(local)
+
+    @contextlib.contextmanager
+    def _sigterm_to_preempt(self):
+        """Route SIGTERM into the preemption protocol for the enclosed run.
+        Installed only on the main thread (signal.signal raises ValueError
+        elsewhere — library callers driving a Trainer from a worker thread
+        keep their process-level handler and can call request_preempt
+        themselves); the previous handler is restored on exit."""
+        try:
+            prev = signal.signal(
+                signal.SIGTERM, lambda s, f: self.request_preempt(s, f)
+            )
+        except ValueError:
+            prev = None
+        try:
+            yield
+        finally:
+            if prev is not None:
+                signal.signal(signal.SIGTERM, prev)
+
+    def _carry_payload(self) -> dict:
+        """Everything OUTSIDE the replay tree and learner state that the
+        next iteration reads: the cut step, the sampling RNG, the published
+        params, any captured deferred priority write-back, and the actor /
+        env episode streams. Together with the replay snapshot it rides in
+        and the finalized checkpoint, a --resume restores the exact
+        mid-run program point (bit-identical next update AND next draw,
+        pinned by tests/test_chaos.py)."""
+        carry = {
+            "carry_step": np.asarray(self._step, np.int64),
+            "sample_rng": np.asarray(
+                json.dumps(self.sample_rng.bit_generator.state)
+            ),
+        }
+        params, version = self.param_store.latest()
+        carry["pub_version"] = np.asarray(version, np.int64)
+        for j, leaf in enumerate(jax.tree.leaves(params)):
+            carry[f"pub_{j}"] = np.asarray(leaf)
+        capture = getattr(self.plane, "capture_pending", None)
+        if capture is not None:
+            pend = capture()
+            if pend:
+                for k, v in pend.items():
+                    carry[f"pend_{k}"] = v
+        env_state = None
+        if self.vec_env is not None and hasattr(self.vec_env, "get_state"):
+            env_state = self.vec_env.get_state()
+        if self.cfg.collector == "device":
+            for k, v in self.actor.carry_state().items():
+                carry[f"actor_{k}"] = v
+        elif env_state is not None:
+            # host actor carry is only useful if the ENV also resumes
+            # exactly; emulator pools without get_state fall back to fresh
+            # episodes on resume (the actor's resync-style cold start)
+            for k, v in self.actor.carry_state().items():
+                carry[f"actor_{k}"] = v
+            for k, v in env_state.items():
+                carry[f"env_{k}"] = v
+        return carry
+
+    def _capture_carry_safe(self) -> Optional[dict]:
+        """Preempt-path carry capture for the run modes' finally blocks: a
+        capture failure must degrade to a carry-less snapshot (still a
+        valid end-of-run-style resume), never mask the original unwind.
+        Must run BEFORE finish_updates — capture_pending serializes the
+        deferred write-back that finish_updates would otherwise apply."""
+        if not (self.preempted and self.cfg.snapshot_replay):
+            return None
+        try:
+            return self._carry_payload()
+        except Exception:  # noqa: BLE001 — degrade, don't mask
+            import traceback
+
+            traceback.print_exc()
+            return None
+
+    def _maybe_restore_carry(self) -> None:
+        """Rehydrate the mid-run carry a preemption snapshot stored. The
+        carry is only valid at the exact step it was cut at: a snapshot
+        lagging the checkpoint (e.g. a periodic snapshot plus a later
+        crash) is still restored as DATA by the replay restore above, but
+        its carry is discarded and the run falls back to fresh episode
+        streams — data-safe either way."""
+        carry = self._resume_carry
+        if "carry_step" not in carry:
+            return
+        carry_step = int(np.asarray(carry["carry_step"])[()])
+        if carry_step != self._initial_step:
+            print(
+                f"[resume] discarding mid-run carry cut at step {carry_step} "
+                f"(checkpoint is at step {self._initial_step}); resuming "
+                "with fresh episode streams",
+                file=sys.stderr,
+            )
+            return
+        self.sample_rng.bit_generator.state = json.loads(
+            str(np.asarray(carry["sample_rng"])[()])
+        )
+        if "pub_version" in carry:
+            treedef = jax.tree.structure(self.param_store._params)
+            leaves = [
+                jnp.asarray(carry[f"pub_{j}"])
+                for j in range(treedef.num_leaves)
+            ]
+            with self.param_store._lock:
+                self.param_store._params = jax.tree.unflatten(treedef, leaves)
+                self.param_store.version = int(
+                    np.asarray(carry["pub_version"])[()]
+                )
+        pend = {
+            k[len("pend_"):]: v for k, v in carry.items()
+            if k.startswith("pend_")
+        }
+        restore_pending = getattr(self.plane, "restore_pending", None)
+        if pend and restore_pending is not None:
+            restore_pending(pend)
+        act = {
+            k[len("actor_"):]: v for k, v in carry.items()
+            if k.startswith("actor_")
+        }
+        if act and hasattr(self.actor, "restore_carry"):
+            self.actor.restore_carry(act)
+        envd = {
+            k[len("env_"):]: v for k, v in carry.items()
+            if k.startswith("env_")
+        }
+        if envd and self.vec_env is not None and hasattr(self.vec_env, "set_state"):
+            self.vec_env.set_state(envd)
+
+    def _finalize_preempt(self) -> None:
+        """The preemption COMMIT: a finalized checkpoint at the cut step,
+        written strictly AFTER the replay snapshot + carry landed. Resume
+        keys off the latest finalized checkpoint, so a crash between the
+        two leaves the previous checkpoint/snapshot pair in force — at no
+        point does a checkpoint reference a snapshot that isn't on disk."""
+        if latest_checkpoint_step(self.cfg.checkpoint_dir) == self._step:
+            return  # the cadence crossing already checkpointed this step
+        save_checkpoint(
+            self.cfg.checkpoint_dir,
+            self.state,
+            self._global_env_steps(),
+            self.wall_minutes_offset + (time.time() - self._start_time) / 60.0,
+        )
 
     # ------------------------------------------------------------- plumbing
 
@@ -643,6 +907,7 @@ class Trainer:
                 self._stop_profile()
 
     def _one_update(self, item):
+        fault_point("trainer.update")
         self._profile_gate()
         prev = self._step
         with step_span("learner_update", prev):
@@ -668,6 +933,11 @@ class Trainer:
                 self._global_env_steps(),
                 self.wall_minutes_offset + (time.time() - self._start_time) / 60.0,
             )
+        if (
+            self.cfg.snapshot_every > 0
+            and step // self.cfg.snapshot_every > prev // self.cfg.snapshot_every
+        ):
+            self._snapshot_async()
 
     def _global_env_steps(self) -> int:
         """Run-total env steps. replay.env_steps is host-local on the
@@ -702,22 +972,43 @@ class Trainer:
             )
         return os.path.join(self.cfg.checkpoint_dir, "replay_snapshot.npz")
 
-    def save_replay_snapshot(self) -> str:
+    def save_replay_snapshot(self, extra: Optional[dict] = None) -> str:
         """Persist full replay contents (replay/snapshot.py); returns the
-        path. Run modes call this on exit when cfg.snapshot_replay is set."""
+        path. Run modes call this on exit when cfg.snapshot_replay is set.
+        `extra` rides in the same atomic write (preemption carry: RNG,
+        published params, deferred write-backs, actor/env streams)."""
         from r2d2_tpu.replay.snapshot import save_replay
 
         os.makedirs(self.cfg.checkpoint_dir, exist_ok=True)
         path = self._replay_snapshot_path()
-        save_replay(self.replay, path)
+        save_replay(self.replay, path, extra=extra)
         return path
 
-    def _snapshot_on_exit(self) -> None:
+    def _snapshot_async(self) -> None:
+        """Periodic (snapshot_every) snapshot off the hot path: the write
+        runs on a background thread; if the previous one is still going it
+        is simply skipped (next crossing tries again). The write itself is
+        atomic (tmp+rename), so the previous snapshot stays valid until
+        the new one fully lands."""
+        if self._snap_thread is not None and self._snap_thread.is_alive():
+            return
+        t = threading.Thread(
+            target=self._snapshot_on_exit, name="replay-snapshot", daemon=True
+        )
+        self._snap_thread = t
+        t.start()
+
+    def _snapshot_on_exit(self, extra: Optional[dict] = None) -> None:
         """finally-block wrapper: the snapshot is the largest write of the
         run (obs-store-sized), so a failure here (ENOSPC) must not replace
         the in-flight training exception with its own."""
+        t = self._snap_thread
+        if t is not None and t is not threading.current_thread() and t.is_alive():
+            # a periodic snapshot is mid-write: let it land (its rename and
+            # ours would race on the same final path otherwise)
+            t.join(timeout=60.0)
         try:
-            self.save_replay_snapshot()
+            self.save_replay_snapshot(extra=extra)
         except Exception as e:  # noqa: BLE001 — log-and-continue on exit
             import traceback
 
@@ -738,6 +1029,9 @@ class Trainer:
         log_extras = getattr(self.plane, "log_extras", None)
         if log_extras is not None:
             extra = {**(extra or {}), **log_extras()}
+        retries = total_retries()
+        if retries:
+            extra = {**(extra or {}), "io_retries": retries}
         n_ep, r_sum = self.replay.pop_episode_stats()
         if self.cfg.replay_plane == "multihost" and jax.process_count() > 1:
             # env_steps_offset is a GLOBAL restored total (the snapshot
@@ -791,6 +1085,14 @@ class Trainer:
         progress_mark = 0  # attempted steps at the last recorded insertion
         saturation = 2 * self.cfg.buffer_capacity + self.cfg.learning_starts
         while not self.replay.can_sample():
+            # single-process only: warmup iterations are NOT in lockstep
+            # across hosts (each fills at its own rate), so the allgather
+            # handshake _preempt_now uses would deadlock here. Multi-host
+            # preemption during warmup falls through to the run loop's
+            # first iteration check instead.
+            if jax.process_count() == 1 and self._preempt.is_set():
+                self.preempted = True
+                return
             self.actor.step()
             if beat is not None:
                 beat()
@@ -832,11 +1134,13 @@ class Trainer:
         # single-threaded loop: the main-thread watchdog is the only stall
         # protection (utils/supervision.py — hard-exits a wedged process)
         sup = self._sup = self._make_supervisor()
-        with sup.armed_watchdog():
+        with self._sigterm_to_preempt(), sup.armed_watchdog():
             self.warmup(beat=sup.main_beat)
             try:
                 while self._step < cfg.training_steps:
                     sup.main_beat()
+                    if self._preempt_now():
+                        break
                     for _ in range(max(k // self.actor.steps_per_call, 1)):
                         self.actor.step()
                     m, step = self._one_update(self.plane.sample())
@@ -846,9 +1150,14 @@ class Trainer:
                 # a stall
                 sup.stop.set()
                 self._stop_profile()
+                # carry BEFORE finish_updates: capture_pending serializes
+                # the deferred write-back that the drain would apply
+                carry = self._capture_carry_safe()
                 self.finish_updates()
                 if cfg.snapshot_replay:
-                    self._snapshot_on_exit()
+                    self._snapshot_on_exit(extra=carry)
+        if self.preempted:
+            self._finalize_preempt()
 
     def run_threaded(self) -> None:
         """Actor thread + prefetch thread + learner loop (reference
@@ -860,8 +1169,10 @@ class Trainer:
         self._start_time = time.time()
         batch_q: "queue.Queue" = queue.Queue(maxsize=8)
         sup = self._sup = self._make_supervisor()
-        with sup.armed_watchdog():
+        with self._sigterm_to_preempt(), sup.armed_watchdog():
             self._run_threaded_body(sup, batch_q)
+        if self.preempted:
+            self._finalize_preempt()
 
     def _make_supervisor(self) -> Supervisor:
         return Supervisor(
@@ -932,16 +1243,21 @@ class Trainer:
         def cleanup():
             # shutdown FIRST: it stops the main-thread watchdog, whose
             # timeout must not count the (possibly minutes-long) priority
-            # drain and replay snapshot below as a "stall"
+            # drain and replay snapshot below as a "stall"; it also joins
+            # the actor/sampler threads, so the carry below sees quiescent
+            # accumulators and a frozen replay
             sup.shutdown()
             self._stop_profile()
+            carry = self._capture_carry_safe()
             self.finish_updates()
             if cfg.snapshot_replay:
-                self._snapshot_on_exit()
+                self._snapshot_on_exit(extra=carry)
 
         try:
             while self._step < cfg.training_steps:
                 sup.main_beat()
+                if self._preempt_now():
+                    break
                 try:
                     item = batch_q.get(timeout=2.0)
                 except queue.Empty:
@@ -998,8 +1314,10 @@ class Trainer:
         # watchdog hard-exits (utils/supervision.STALL_EXIT_CODE) instead.
         # Armed before warmup so the warmup collection is covered too.
         sup = self._sup = self._make_supervisor()
-        with sup.armed_watchdog():
+        with self._sigterm_to_preempt(), sup.armed_watchdog():
             self._run_fused_body(sup, collect_every)
+        if self.preempted:
+            self._finalize_preempt()
 
     def _run_fused_body(self, sup: Supervisor, collect_every: Optional[int]) -> None:
         cfg = self.cfg
@@ -1044,6 +1362,8 @@ class Trainer:
             pending_log = None
             while self._step < cfg.training_steps:
                 sup.main_beat()
+                if self._preempt_now():
+                    break
                 self._profile_gate()
                 prev = self._step
                 with step_span("fused_megastep", prev):
@@ -1072,7 +1392,9 @@ class Trainer:
             self.actor.key = runner.key if hasattr(runner, "key") else runner.keys[0]
             self.actor.total_steps += runner.total_env_steps
             if cfg.snapshot_replay:
-                self._snapshot_on_exit()
+                # carry AFTER the actor handback so the DeviceCollector
+                # carry captures the runner's final env/PRNG state
+                self._snapshot_on_exit(extra=self._capture_carry_safe())
 
 
 def main(argv=None):
@@ -1164,6 +1486,9 @@ def main(argv=None):
 
     if args.profile_port:
         start_profiler_server(args.profile_port)
+    # deterministic fault injection for chaos drills (R2D2_FAULTS env var;
+    # utils/faults.py) — a no-op when unset
+    install_from_env()
     trainer = Trainer(
         cfg,
         resume=args.resume,
@@ -1186,6 +1511,13 @@ def main(argv=None):
         from r2d2_tpu.utils.supervision import exit_for_stall
 
         exit_for_stall(e)
+    if trainer.preempted:
+        # CLI contract: SIGTERM was absorbed into a clean cut — replay
+        # snapshot + mid-run carry + finalized checkpoint are on disk.
+        # PREEMPT_EXIT_CODE tells the external supervisor "restart with --resume
+        # and training continues bit-exactly", vs STALL_EXIT_CODE's
+        # "state may be stale".
+        sys.exit(PREEMPT_EXIT_CODE)
 
 
 if __name__ == "__main__":

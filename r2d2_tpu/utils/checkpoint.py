@@ -26,6 +26,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from r2d2_tpu.learner import TrainState
+from r2d2_tpu.utils.faults import fault_point, with_retries
 
 
 def _payload(state: TrainState, env_steps: int, wall_minutes: float) -> Dict[str, Any]:
@@ -75,9 +76,20 @@ def save_checkpoint(
         if os.path.isdir(tmp):
             shutil.rmtree(tmp)  # leftover from a crashed save
     _barrier(f"ckpt_clean_{step}")
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(tmp, _payload(state, env_steps, wall_minutes), force=True)
-    ckptr.wait_until_finished()
+
+    def write():
+        # the flaky window is the orbax write itself (transient fs errors,
+        # injected "checkpoint.save" faults); retried attempts rewrite the
+        # SAME temp dir (force=True), so a half-written first attempt is
+        # simply overwritten. Barriers stay OUTSIDE the retry: every host
+        # retries locally the same bounded number of times at most, and
+        # only the final outcome crosses the sync points.
+        fault_point("checkpoint.save")
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(tmp, _payload(state, env_steps, wall_minutes), force=True)
+        ckptr.wait_until_finished()
+
+    with_retries(write, "checkpoint.save")
     _barrier(f"ckpt_written_{step}")
     if jax.process_index() == 0:
         if os.path.isdir(final):
@@ -122,8 +134,13 @@ def restore_checkpoint(ckpt_dir: str, template_state: TrainState, step: Optional
     abstract = jax.tree.map(
         ocp.utils.to_shape_dtype_struct, _payload(template_state, 0, 0.0)
     )
-    ckptr = ocp.StandardCheckpointer()
-    restored = ckptr.restore(path, abstract)
+
+    def read():
+        fault_point("checkpoint.restore")
+        ckptr = ocp.StandardCheckpointer()
+        return ckptr.restore(path, abstract)
+
+    restored = with_retries(read, "checkpoint.restore")
     state = TrainState(
         params=restored["params"],
         target_params=restored["target_params"],

@@ -1,0 +1,291 @@
+"""Deterministic fault-injection plane + shared retry/backoff helpers.
+
+Long preemptible-TPU runs fail by interruption, not by bug: SIGTERM with a
+grace window, a wedged host->device transfer, a checkpoint write into a
+flaky shared fs. The framework's failure handling (supervision restarts,
+replay snapshots, atomic checkpoints, preemption-safe resume) is only
+trustworthy if those paths are EXERCISED — so every host-side subsystem
+registers named fault sites via `fault_point("site")`, a hook that costs
+one global read + one `is None` branch when no plane is installed, and a
+test (or an operator, via the R2D2_FAULTS env var) installs a seeded
+schedule that fires crashes, stalls, torn transfers, or a real delivered
+SIGTERM at exact call counts.
+
+Determinism contract: a FaultPlane fires as a pure function of
+(seed, site, per-site call number) — never of wall clock or thread
+interleaving on the SAME call sequence — so a chaos test that kills the
+trainer at site X call N reproduces bit-for-bit, and a failure seen in CI
+replays locally from the spec string alone.
+
+The second half is the shared transient-I/O policy: `with_retries` wraps
+the flaky boundaries (host<->device transfers, checkpoint I/O, the serve
+checkpoint watcher) in bounded exponential backoff, and every retry is
+counted per-site in `retry_stats()` so the Trainer/serve metrics streams
+carry the flake rate instead of silently absorbing it.
+
+Registered sites (KNOWN_SITES below):
+- trainer.update      — top of every learner update (SIGTERM injection)
+- actor.step          — top of every host collection step
+- host_plane.h2d      — host replay batch lift to device (train.py)
+- tiered.stage_h2d    — staged-chunk device_put (replay/tiered_store.py)
+- checkpoint.save     — orbax write (utils/checkpoint.py)
+- checkpoint.restore  — orbax read (utils/checkpoint.py)
+- snapshot.write      — replay snapshot npz write (replay/snapshot.py)
+- serve.reload        — serve-plane checkpoint hot-reload (serve/server.py)
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple, Type
+
+# Every site wired into the codebase, for chaos suites that want to sweep
+# "kill at every registered site". Adding a fault_point at a new boundary
+# should add its name here (tests cross-check the wiring).
+KNOWN_SITES = (
+    "trainer.update",
+    "actor.step",
+    "host_plane.h2d",
+    "tiered.stage_h2d",
+    "checkpoint.save",
+    "checkpoint.restore",
+    "snapshot.write",
+    "serve.reload",
+)
+
+
+class InjectedFault(RuntimeError):
+    """A fault_point fired an 'error' action. Classified as TRANSIENT by
+    with_retries — the injected stand-in for a flaky transfer or fs — so
+    retry-wrapped boundaries absorb it up to their attempt budget."""
+
+
+class FaultPlane:
+    """A seeded schedule of named fault sites.
+
+    Two trigger forms, combinable:
+    - `schedule={site: {n: action}}` — fire `action` on the site's n-th
+      call (1-based, counted per site since install);
+    - `rates={site: (p, action)}` — fire on calls where a crc32 hash of
+      (seed, site, n) maps below p. Same seed => same firing calls, on
+      any host, in any thread interleaving.
+
+    Actions:
+    - "error"       raise InjectedFault (transient-classified)
+    - "sigterm"     os.kill(self, SIGTERM) — the preemption drill; the
+                    call itself returns normally, exactly like a real
+                    grace-window delivery mid-step
+    - "stall:S"     sleep S seconds (heartbeat/watchdog drill)
+    - "exit:C"      os._exit(C) — hard crash, no unwind
+
+    `max_fires` bounds total firings (a rate-based plane in a long run
+    should degrade to a no-op once it has made its point). Thread-safe;
+    counters are per-site."""
+
+    def __init__(
+        self,
+        schedule: Optional[Dict[str, Dict[int, str]]] = None,
+        rates: Optional[Dict[str, Tuple[float, str]]] = None,
+        seed: int = 0,
+        max_fires: Optional[int] = None,
+    ):
+        self.schedule = {s: dict(m) for s, m in (schedule or {}).items()}
+        self.rates = dict(rates or {})
+        self.seed = seed
+        self.max_fires = max_fires
+        self.calls: Dict[str, int] = {}
+        self.fired: List[Tuple[str, int, str]] = []  # (site, call_n, action)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_spec(cls, spec: str, seed: int = 0) -> "FaultPlane":
+        """Parse the R2D2_FAULTS wire format: comma/semicolon-separated
+        clauses of `site@N=action` (exact call) or `site%P=action` (seeded
+        rate P in [0,1]), plus `seed=K` / `max_fires=K` settings. Example:
+
+            R2D2_FAULTS="trainer.update@5=sigterm,tiered.stage_h2d%0.05=error,seed=7"
+        """
+        schedule: Dict[str, Dict[int, str]] = {}
+        rates: Dict[str, Tuple[float, str]] = {}
+        max_fires = None
+        for clause in spec.replace(";", ",").split(","):
+            clause = clause.strip()
+            if not clause:
+                continue
+            key, _, action = clause.partition("=")
+            if not action:
+                raise ValueError(f"fault spec clause {clause!r} needs '=action'")
+            key = key.strip()
+            action = action.strip()
+            if key == "seed":
+                seed = int(action)
+            elif key == "max_fires":
+                max_fires = int(action)
+            elif "@" in key:
+                site, _, n = key.partition("@")
+                schedule.setdefault(site, {})[int(n)] = action
+            elif "%" in key:
+                site, _, p = key.partition("%")
+                rates[site] = (float(p), action)
+            else:
+                raise ValueError(
+                    f"fault spec clause {clause!r}: expected site@N=action, "
+                    "site%P=action, seed=K, or max_fires=K"
+                )
+        return cls(schedule=schedule, rates=rates, seed=seed, max_fires=max_fires)
+
+    def _decide(self, site: str) -> Optional[Tuple[int, str]]:
+        with self._lock:
+            n = self.calls.get(site, 0) + 1
+            self.calls[site] = n
+            if self.max_fires is not None and len(self.fired) >= self.max_fires:
+                return None
+            action = self.schedule.get(site, {}).get(n)
+            if action is None and site in self.rates:
+                p, rate_action = self.rates[site]
+                h = zlib.crc32(f"{self.seed}:{site}:{n}".encode())
+                if h / 2**32 < p:
+                    action = rate_action
+            if action is None:
+                return None
+            self.fired.append((site, n, action))
+            return n, action
+
+    def hit(self, site: str) -> None:
+        decided = self._decide(site)
+        if decided is None:
+            return
+        n, action = decided
+        if action == "error":
+            raise InjectedFault(f"injected fault at {site!r} (call {n})")
+        if action == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        if action.startswith("stall:"):
+            time.sleep(float(action[6:]))
+            return
+        if action.startswith("exit:"):
+            os._exit(int(action[5:]))
+        raise ValueError(f"unknown fault action {action!r} at {site!r}")
+
+
+# the installed plane; None (the default) keeps fault_point at one global
+# read + one branch — zero-cost in production hot loops
+_PLANE: Optional[FaultPlane] = None
+
+
+def fault_point(site: str) -> None:
+    """Named fault site. No-op unless a FaultPlane is installed."""
+    plane = _PLANE
+    if plane is not None:
+        plane.hit(site)
+
+
+def install(plane: FaultPlane) -> FaultPlane:
+    global _PLANE
+    _PLANE = plane
+    return plane
+
+
+def uninstall() -> None:
+    global _PLANE
+    _PLANE = None
+
+
+def active() -> Optional[FaultPlane]:
+    return _PLANE
+
+
+def install_from_env(var: str = "R2D2_FAULTS") -> Optional[FaultPlane]:
+    """Entry-point hook (train.main and chaos subprocesses): install a
+    plane from the env var's spec string, if set."""
+    spec = os.environ.get(var)
+    if not spec:
+        return None
+    return install(FaultPlane.from_spec(spec))
+
+
+# ------------------------------------------------------------------ retries
+
+# The transient class: injected faults plus the OS-level errors a flaky
+# shared fs or interconnect surfaces. Deliberately NOT a bare Exception —
+# a logic bug must never be silently retried into "success".
+TRANSIENT_ERRORS: Tuple[Type[BaseException], ...] = (
+    InjectedFault,
+    OSError,
+    ConnectionError,
+)
+
+_retry_lock = threading.Lock()
+_retry_counts: Dict[str, int] = {}
+
+
+def with_retries(
+    fn: Callable,
+    site: str,
+    attempts: int = 3,
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    retry_on: Tuple[Type[BaseException], ...] = TRANSIENT_ERRORS,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Run `fn` with bounded exponential backoff on transient errors.
+
+    Every retry increments the site's counter in retry_stats() — the
+    Trainer and serve metrics merge these, so a flaky boundary shows up
+    as a rate in the metrics stream instead of vanishing into latency.
+    The final attempt's error propagates: retries bound tail latency,
+    they do not convert persistent failures into hangs."""
+    delay = base_delay
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on:
+            if attempt == attempts - 1:
+                raise
+            with _retry_lock:
+                _retry_counts[site] = _retry_counts.get(site, 0) + 1
+            sleep(min(delay, max_delay))
+            delay *= 2.0
+
+
+def retry_stats() -> Dict[str, int]:
+    """Per-site retry counts since process start (or the last reset)."""
+    with _retry_lock:
+        return dict(_retry_counts)
+
+
+def total_retries() -> int:
+    with _retry_lock:
+        return sum(_retry_counts.values())
+
+
+def reset_retry_stats() -> None:
+    with _retry_lock:
+        _retry_counts.clear()
+
+
+class Backoff:
+    """Tiny backoff state machine for poll loops (the serve checkpoint
+    watcher): fail() escalates and returns the next delay, reset() on
+    success. Keeps the loop's one-bounded-unit-of-work-per-call contract —
+    the DELAY is returned, not slept, so callers wait on their own stop
+    event and stay responsive to shutdown."""
+
+    def __init__(self, base: float = 0.1, factor: float = 2.0, max_delay: float = 30.0):
+        self.base = base
+        self.factor = factor
+        self.max_delay = max_delay
+        self.failures = 0
+
+    def fail(self) -> float:
+        delay = min(self.base * (self.factor ** self.failures), self.max_delay)
+        self.failures += 1
+        return delay
+
+    def reset(self) -> None:
+        self.failures = 0

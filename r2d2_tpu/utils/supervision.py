@@ -42,6 +42,13 @@ from typing import Callable, Dict, List, Optional
 # "wedged runtime — restart with --resume"
 STALL_EXIT_CODE = 86
 
+# process exit code for a clean preemption exit (SIGTERM caught, replay
+# snapshot + finalized checkpoint written): external supervisors map it to
+# "reschedule with --resume, state is complete". Distinct from
+# STALL_EXIT_CODE because a stall means state may be STALE (last periodic
+# checkpoint), while a preempt exit guarantees state is CURRENT.
+PREEMPT_EXIT_CODE = 85
+
 
 class SupervisedWorker:
     """One host worker loop: `body()` is called repeatedly until stop."""

@@ -1,0 +1,209 @@
+"""The fault-injection plane itself (utils/faults.py): deterministic
+schedules and rates, the spec-string wire format, the shared retry policy,
+and the watcher Backoff — the primitives the chaos suite (test_chaos.py)
+builds its kill-and-resume drills on."""
+
+import pytest
+
+from r2d2_tpu.utils import faults
+from r2d2_tpu.utils.faults import Backoff, FaultPlane, InjectedFault, with_retries
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test starts and ends with no plane installed and fresh retry
+    counters — the module globals are process-wide."""
+    faults.uninstall()
+    faults.reset_retry_stats()
+    yield
+    faults.uninstall()
+    faults.reset_retry_stats()
+
+
+def test_fault_point_noop_without_plane():
+    for site in faults.KNOWN_SITES:
+        faults.fault_point(site)  # must not raise, must not need a plane
+
+
+def test_schedule_fires_on_exact_call():
+    plane = faults.install(FaultPlane(schedule={"trainer.update": {3: "error"}}))
+    faults.fault_point("trainer.update")
+    faults.fault_point("trainer.update")
+    with pytest.raises(InjectedFault, match="call 3"):
+        faults.fault_point("trainer.update")
+    faults.fault_point("trainer.update")  # only the scheduled call fires
+    assert plane.fired == [("trainer.update", 3, "error")]
+    assert plane.calls["trainer.update"] == 4
+
+
+def test_schedule_counts_per_site():
+    faults.install(FaultPlane(schedule={"a": {2: "error"}}))
+    faults.fault_point("b")
+    faults.fault_point("a")
+    faults.fault_point("b")  # site b's calls must not advance site a
+    with pytest.raises(InjectedFault):
+        faults.fault_point("a")
+
+
+def test_rate_is_deterministic_in_seed():
+    def firing_calls(seed):
+        plane = FaultPlane(rates={"s": (0.3, "error")}, seed=seed)
+        fired = []
+        for n in range(1, 101):
+            if plane._decide("s") is not None:
+                fired.append(n)
+        return fired
+
+    a, b = firing_calls(7), firing_calls(7)
+    assert a == b and a  # same seed: identical firing calls, and some fire
+    assert firing_calls(8) != a  # different seed: different schedule
+
+
+def test_max_fires_bounds_total():
+    plane = FaultPlane(rates={"s": (1.0, "error")}, max_fires=2)
+    faults.install(plane)
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            faults.fault_point("s")
+    faults.fault_point("s")  # budget spent: degraded to a no-op
+    assert len(plane.fired) == 2
+
+
+def test_stall_action_sleeps(monkeypatch):
+    import time as _time
+
+    slept = []
+    monkeypatch.setattr(_time, "sleep", slept.append)
+    faults.install(FaultPlane(schedule={"s": {1: "stall:2.5"}}))
+    faults.fault_point("s")
+    assert slept == [2.5]
+
+
+def test_from_spec_round_trip():
+    plane = FaultPlane.from_spec(
+        "trainer.update@5=sigterm, tiered.stage_h2d%0.05=error; seed=7, max_fires=3"
+    )
+    assert plane.schedule == {"trainer.update": {5: "sigterm"}}
+    assert plane.rates == {"tiered.stage_h2d": (0.05, "error")}
+    assert plane.seed == 7
+    assert plane.max_fires == 3
+
+
+def test_from_spec_rejects_malformed():
+    with pytest.raises(ValueError):
+        FaultPlane.from_spec("trainer.update=error")  # no @N or %P
+    with pytest.raises(ValueError):
+        FaultPlane.from_spec("trainer.update@5")  # no action
+
+
+def test_install_from_env(monkeypatch):
+    monkeypatch.setenv("R2D2_FAULTS", "a@1=error")
+    plane = faults.install_from_env()
+    assert faults.active() is plane
+    with pytest.raises(InjectedFault):
+        faults.fault_point("a")
+    faults.uninstall()
+    monkeypatch.delenv("R2D2_FAULTS")
+    assert faults.install_from_env() is None
+    assert faults.active() is None
+
+
+def test_unknown_action_raises():
+    faults.install(FaultPlane(schedule={"s": {1: "melt"}}))
+    with pytest.raises(ValueError, match="melt"):
+        faults.fault_point("s")
+
+
+# ------------------------------------------------------------------ retries
+
+
+def test_with_retries_absorbs_transients_and_counts():
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert with_retries(flaky, "test.site", sleep=lambda _: None) == "ok"
+    assert len(attempts) == 3
+    assert faults.retry_stats() == {"test.site": 2}
+    assert faults.total_retries() == 2
+
+
+def test_with_retries_final_attempt_propagates():
+    def always():
+        raise ConnectionError("down for good")
+
+    with pytest.raises(ConnectionError):
+        with_retries(always, "test.site", attempts=3, sleep=lambda _: None)
+    # only the non-final attempts count as retries
+    assert faults.retry_stats() == {"test.site": 2}
+
+
+def test_with_retries_does_not_retry_logic_errors():
+    calls = []
+
+    def buggy():
+        calls.append(1)
+        raise ValueError("a bug, not a flake")
+
+    with pytest.raises(ValueError):
+        with_retries(buggy, "test.site", sleep=lambda _: None)
+    assert len(calls) == 1
+    assert faults.total_retries() == 0
+
+
+def test_with_retries_backoff_schedule():
+    delays = []
+
+    def always():
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        with_retries(
+            always, "s", attempts=4, base_delay=0.05, max_delay=0.15,
+            sleep=delays.append,
+        )
+    assert delays == [0.05, 0.1, 0.15]  # doubled, then clamped
+
+
+def test_with_retries_absorbs_injected_fault():
+    faults.install(FaultPlane(schedule={"s": {1: "error"}}))
+
+    def body():
+        faults.fault_point("s")
+        return 42
+
+    assert with_retries(body, "s", sleep=lambda _: None) == 42
+    assert faults.retry_stats() == {"s": 1}
+
+
+def test_backoff_escalates_and_resets():
+    b = Backoff(base=0.1, factor=2.0, max_delay=0.5)
+    assert [b.fail() for _ in range(4)] == [0.1, 0.2, 0.4, 0.5]
+    b.reset()
+    assert b.fail() == 0.1
+
+
+# ------------------------------------------------------------------- wiring
+
+
+def test_known_sites_are_wired():
+    """Every registered site name appears as a fault_point call somewhere
+    in the package — the chaos sweep relies on KNOWN_SITES being live."""
+    import os
+
+    import r2d2_tpu
+
+    root = os.path.dirname(r2d2_tpu.__file__)
+    sources = []
+    for dirpath, _, names in os.walk(root):
+        for name in names:
+            if name.endswith(".py"):
+                with open(os.path.join(dirpath, name)) as f:
+                    sources.append(f.read())
+    blob = "\n".join(sources)
+    for site in faults.KNOWN_SITES:
+        assert f'fault_point("{site}")' in blob, site

@@ -54,6 +54,46 @@ def test_supervisor_fatal_after_restart_budget():
     sup.shutdown()
 
 
+def test_on_restart_hook_failure_goes_fatal():
+    """A failing recovery hook means the worker cannot be restored to a
+    known-good state: the supervisor must go fatal immediately instead of
+    restarting into corruption — and check() must surface BOTH tracebacks
+    (the crash and the failed hook)."""
+    sup = Supervisor()
+    bodies = []
+
+    def body():
+        bodies.append(1)
+        raise RuntimeError("worker crashed")
+
+    def bad_hook():
+        raise RuntimeError("hook is broken too")
+
+    w = sup.spawn("w", body, max_restarts=5, on_restart=bad_hook)
+    deadline = time.monotonic() + 10
+    with pytest.raises(WorkerFatalError):
+        while time.monotonic() < deadline:
+            sup.check()
+            time.sleep(0.02)
+    assert w.fatal
+    assert len(bodies) == 1  # never restarted after the hook failed
+    assert any("hook is broken too" in e for e in w.errors)
+    assert any("worker crashed" in e for e in w.errors)
+    sup.shutdown()
+
+
+def test_exit_codes_are_distinct():
+    """The CLI contract's three-way exit distinction: clean (0), preempted
+    (state CURRENT, restart with --resume), stalled (state possibly STALE,
+    backend suspect). Supervisors key recovery policy off these."""
+    from r2d2_tpu.utils.supervision import PREEMPT_EXIT_CODE, STALL_EXIT_CODE
+
+    assert len({0, PREEMPT_EXIT_CODE, STALL_EXIT_CODE}) == 3
+    # both fit in a POSIX exit byte and stay clear of shell/signal codes
+    assert 1 <= PREEMPT_EXIT_CODE <= 125
+    assert 1 <= STALL_EXIT_CODE <= 125
+
+
 def test_supervisor_reports_stall():
     sup = Supervisor(heartbeat_timeout=0.05)
     release = threading.Event()
