@@ -63,6 +63,20 @@ def synth_block(cfg, rng: np.random.Generator) -> Block:
     )
 
 
+def _precision_overrides(precision: str) -> dict:
+    """--precision -> config fields. 'bf16' is the full mixed-precision
+    plane (config.precision: bf16 matmuls + bf16 carry storage in replay /
+    serve). 'fp32' is FULL float32 including compute — the vs_fp32 speedup
+    denominator. Note the pre-policy bench rows ran a middle point (bf16
+    matmuls, f32 state), so the fp32 arm here is slower than old rows."""
+    if precision not in ("fp32", "bf16"):
+        raise SystemExit(f"unknown precision {precision!r}")
+    return {
+        "precision": precision,
+        "compute_dtype": "float32" if precision == "fp32" else "bfloat16",
+    }
+
+
 def _core_overrides(core: str, lru_chunk: int) -> dict:
     """--core/--lru-chunk -> config fields. 'lstm' is the headline default;
     'lru' selects the time-parallel core (models/lru.py), with lru_chunk>0
@@ -74,15 +88,16 @@ def _core_overrides(core: str, lru_chunk: int) -> dict:
     return {"recurrent_core": core, "lru_chunk": lru_chunk if core == "lru" else 0}
 
 
-def _system_cfg(E: int = 256, core: str = "lstm", lru_chunk: int = 0):
+def _system_cfg(E: int = 256, core: str = "lstm", lru_chunk: int = 0,
+                precision: str = "bf16"):
     """Shared full-system benchmark config: catch at Atari resolution
     (84x84, device-rendered; this image has no ALE and one host core —
     SURVEY.md section 2.4), full-size network."""
     return default_atari().replace(
         env_name="catch",
         action_dim=3,
-        compute_dtype="bfloat16",
         num_actors=E,
+        **_precision_overrides(precision),
         **_core_overrides(core, lru_chunk),
         max_episode_steps=82,  # catch: ball lands after height-2 steps
         collector="device",
@@ -98,7 +113,7 @@ def _system_cfg(E: int = 256, core: str = "lstm", lru_chunk: int = 0):
     )
 
 
-def recovery_main():
+def recovery_main(precision: str = "fp32"):
     """Preemption-recovery benchmark: kill a small training run mid-stream
     with an injected SIGTERM (utils/faults.py — the deterministic stand-in
     for a real grace-window delivery), then measure the wall time from
@@ -115,8 +130,11 @@ def recovery_main():
     from r2d2_tpu.utils import faults
 
     workdir = tempfile.mkdtemp(prefix="bench_recovery_")
+    # fp32 default: the recovery row's historical config. --precision bf16
+    # additionally drills the bf16 snapshot round trip under preemption.
     cfg = tiny_test().replace(
         env_name="catch",
+        **_precision_overrides(precision if precision != "both" else "bf16"),
         snapshot_replay=True,
         checkpoint_dir=os.path.join(workdir, "ckpt"),
         metrics_path=os.path.join(workdir, "metrics.jsonl"),
@@ -152,12 +170,15 @@ def recovery_main():
                 "cut_step": cut_step,
                 "resumed_step": step,
                 "loss": round(float(m["loss"]), 4),
+                "core": cfg.recurrent_core,
+                "precision": cfg.precision,
             }
         )
     )
 
 
-def fused_system_main(collect_every: int = 6, core: str = "lstm", lru_chunk: int = 0):
+def fused_system_main(collect_every: int = 6, core: str = "lstm",
+                      lru_chunk: int = 0, precision: str = "bf16"):
     """Full-system throughput via the fused megastep (megastep.py): ONE
     dispatch = K updates + a collection chunk every collect_every'th
     dispatch. No worker threads — the host only runs sum-tree bookkeeping
@@ -167,7 +188,8 @@ def fused_system_main(collect_every: int = 6, core: str = "lstm", lru_chunk: int
     from r2d2_tpu.megastep import FusedSystemRunner
     from r2d2_tpu.train import Trainer
 
-    cfg = _system_cfg(core=core, lru_chunk=lru_chunk)
+    cfg = _system_cfg(core=core, lru_chunk=lru_chunk,
+                      precision="bf16" if precision == "both" else precision)
     trainer = Trainer(cfg)
     print(f"warmup: filling {cfg.learning_starts} transitions...", file=sys.stderr)
     t0 = time.time()
@@ -216,12 +238,13 @@ def fused_system_main(collect_every: int = 6, core: str = "lstm", lru_chunk: int
                 "vs_baseline": round(learner_fps / BASELINE_FRAMES_PER_SEC, 3),
                 "concurrent_collection_env_frames_per_sec": round(collect_fps, 1),
                 "core": cfg.recurrent_core + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
+                "precision": cfg.precision,
             }
         )
     )
 
 
-def system_main(core: str = "lstm", lru_chunk: int = 0):
+def system_main(core: str = "lstm", lru_chunk: int = 0, precision: str = "bf16"):
     """Full-system throughput: on-device collection (collect.py) and the
     K-update learner dispatch sharing ONE chip concurrently — the complete
     TPU-native R2D2 (actor + replay + learner) with no synthetic data.
@@ -232,7 +255,8 @@ def system_main(core: str = "lstm", lru_chunk: int = 0):
     measured WHILE collection sustains its own rate on the same chip."""
     from r2d2_tpu.train import Trainer
 
-    cfg = _system_cfg(core=core, lru_chunk=lru_chunk)
+    cfg = _system_cfg(core=core, lru_chunk=lru_chunk,
+                      precision="bf16" if precision == "both" else precision)
     trainer = Trainer(cfg)
     print(f"warmup: filling {cfg.learning_starts} transitions...", file=sys.stderr)
     t0 = time.time()
@@ -283,6 +307,7 @@ def system_main(core: str = "lstm", lru_chunk: int = 0):
                 "vs_baseline": round(learner_fps / BASELINE_FRAMES_PER_SEC, 3),
                 "concurrent_collection_env_frames_per_sec": round(collect_fps, 1),
                 "core": cfg.recurrent_core + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
+                "precision": cfg.precision,
             }
         )
     )
@@ -298,6 +323,7 @@ def main(
     lru_chunk: int = 0,
     batch: int = 0,
     emit: bool = True,
+    precision: str = "bf16",
 ):
     """frame_multiplier: env frames per env step — 4 for Atari (frameskip,
     reference test.py:28,36), 1 for envs without frameskip. baseline: the
@@ -305,11 +331,14 @@ def main(
     (_core_overrides); batch > 0 overrides batch_size (the MFU
     shape-granularity probe — frames/s scales with batch by construction,
     so cross-batch rows compare updates/s x batch, not the headline).
-    Returns the result row; emit=False suppresses the JSON print so matrix
-    drivers (learner_matrix_main) keep exactly one line on stdout."""
+    precision selects the mixed-precision arm (_precision_overrides;
+    ignored when an explicit cfg is passed — the row reports
+    cfg.precision either way). Returns the result row; emit=False
+    suppresses the JSON print so matrix drivers (learner_matrix_main)
+    keep exactly one line on stdout."""
     cfg = cfg or default_atari().replace(
-        compute_dtype="bfloat16",
         buffer_capacity=100_000,  # 250 block slots ~= 0.77 GB HBM obs store
+        **_precision_overrides(precision),
         **_core_overrides(core, lru_chunk),
     )
     if batch:
@@ -445,6 +474,7 @@ def main(
         "unit": "env_frames/s",
         "vs_baseline": round(frames_per_sec / baseline, 3),
         "core": cfg.recurrent_core + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
+        "precision": cfg.precision,
         "batch": cfg.batch_size,
         "updates_per_sec": round(updates_per_sec, 2),
     }
@@ -453,37 +483,60 @@ def main(
     return row
 
 
-def learner_matrix_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0):
+def learner_matrix_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0,
+                        precision: str = "bf16"):
     """Learner-mode driver: the headline is the BEST row of the batch
     matrix, not a fixed batch size. Round 5 measured B=128 at 1.279M
     env-frames/s — 27% above the B=64 row the headline used to report —
     so pinning B=64 understated the chip. An explicit --batch still runs
     exactly that one shape; batch=0 sweeps the matrix and emits one JSON
-    line carrying the winner (with its batch size) plus every row."""
-    if batch:
-        main(core=core, lru_chunk=lru_chunk, batch=batch)
-        return
+    line carrying the winner (with its batch size) plus every row.
+
+    The headline always carries `vs_fp32`: under bf16 a silent fp32
+    reference runs at the winning batch so the speedup is measured at the
+    same shape; --precision both additionally attaches the fp32 row."""
+    arm = "bf16" if precision == "both" else precision
+    batches = (batch,) if batch else (64, 128)
     rows = [
-        main(core=core, lru_chunk=lru_chunk, batch=bs, emit=False)
-        for bs in (64, 128)
+        main(core=core, lru_chunk=lru_chunk, batch=bs, emit=False, precision=arm)
+        for bs in batches
     ]
     best = max(rows, key=lambda r: r["value"])
-    print(
-        json.dumps(
-            {
-                **best,
-                "metric": "learner_env_frames_per_sec_per_chip",
-                "matrix": [
-                    {
-                        "batch": r["batch"],
-                        "value": r["value"],
-                        "updates_per_sec": r["updates_per_sec"],
-                    }
-                    for r in rows
-                ],
-            }
+    if arm == "fp32":
+        fp32_row, vs_fp32 = None, 1.0
+    else:
+        fp32_row = main(
+            core=core, lru_chunk=lru_chunk, batch=best["batch"],
+            emit=False, precision="fp32",
         )
-    )
+        vs_fp32 = best["value"] / fp32_row["value"]
+        print(
+            f"[precision] bf16 {best['value']:.0f} vs fp32 "
+            f"{fp32_row['value']:.0f} env-frames/s = {vs_fp32:.2f}x "
+            f"at batch {best['batch']}",
+            file=sys.stderr,
+        )
+    out = {
+        **best,
+        "metric": "learner_env_frames_per_sec_per_chip",
+        "vs_fp32": round(vs_fp32, 3),
+    }
+    if not batch:
+        out["matrix"] = [
+            {
+                "batch": r["batch"],
+                "value": r["value"],
+                "updates_per_sec": r["updates_per_sec"],
+            }
+            for r in rows
+        ]
+    if precision == "both" and fp32_row is not None:
+        out["fp32"] = {
+            "batch": fp32_row["batch"],
+            "value": fp32_row["value"],
+            "updates_per_sec": fp32_row["updates_per_sec"],
+        }
+    print(json.dumps(out))
 
 
 def tiered_main(
@@ -492,6 +545,7 @@ def tiered_main(
     batch: int = 0,
     capacity: int = 2_000_000,
     K: int = 16,
+    precision: str = "bf16",
 ):
     """Tiered-plane learner throughput AT FULL REPLAY CAPACITY: the store
     holds `capacity` transitions in host RAM (2M default — the paper's
@@ -509,10 +563,10 @@ def tiered_main(
     from r2d2_tpu.utils.profiling import TransferTimer
 
     cfg = default_atari().replace(
-        compute_dtype="bfloat16",
         buffer_capacity=capacity,
         replay_plane="tiered",
         updates_per_dispatch=K,
+        **_precision_overrides("bf16" if precision == "both" else precision),
         **_core_overrides(core, lru_chunk),
     )
     if batch:
@@ -604,27 +658,19 @@ def tiered_main(
                 "replay_capacity_transitions": capacity,
                 "batch": cfg.batch_size,
                 "core": cfg.recurrent_core + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
+                "precision": cfg.precision,
                 **timer.stats(),
             }
         )
     )
 
 
-def serve_main(
-    core: str = "lstm",
-    lru_chunk: int = 0,
-    sessions: int = 32,
-    seconds: float = 30.0,
-):
-    """Serving-plane load test: `sessions` concurrent CatchHostEnv session
-    threads drive the full-size network through r2d2_tpu.serve's
+def _serve_load(cfg, sessions: int, seconds: float) -> dict:
+    """One serving-plane load arm: `sessions` concurrent CatchHostEnv
+    session threads drive the full-size network through r2d2_tpu.serve's
     LocalClient for `seconds`, with a checkpoint hot-reload fired
-    mid-window to prove reloads don't dent the latency tail. Reports
-    sustained requests/s plus p50/p95/p99 request latency (submit ->
-    action), batch occupancy, and the reload count.
-
-    No baseline row exists yet for serving — vs_baseline is null until a
-    BENCH_*.json round records the first trajectory point."""
+    mid-window to prove reloads don't dent the latency tail. Returns the
+    measured numbers; serve_main decides which arm is the headline."""
     import os
     import shutil
     import tempfile
@@ -633,7 +679,6 @@ def serve_main(
     from r2d2_tpu.serve import LocalClient, PolicyServer, ServeConfig
     from r2d2_tpu.utils.checkpoint import save_checkpoint
 
-    cfg = _system_cfg(core=core, lru_chunk=lru_chunk)
     serve_cfg = ServeConfig(
         buckets=(2, 4, 8, 16, 32),
         max_wait_ms=2.0,
@@ -647,7 +692,11 @@ def serve_main(
         save_checkpoint(ckpt_dir, server._template, 0, 0.0)  # step-0 series
         t0 = time.time()
         server.warmup()
-        print(f"[serve] warmup (all buckets) in {time.time() - t0:.1f}s", file=sys.stderr)
+        print(
+            f"[serve:{cfg.precision}] warmup (all buckets) in "
+            f"{time.time() - t0:.1f}s",
+            file=sys.stderr,
+        )
         server.start()
         client = LocalClient(server)
         stop = threading.Event()
@@ -696,36 +745,77 @@ def serve_main(
             float(np.percentile(all_lat, p) * 1e3) for p in (50, 95, 99)
         )
         print(
-            f"{n} requests over {sessions} sessions in {elapsed:.1f}s "
-            f"(reloads={stats['reloads']}, occupancy="
+            f"[serve:{cfg.precision}] {n} requests over {sessions} sessions "
+            f"in {elapsed:.1f}s (reloads={stats['reloads']}, occupancy="
             f"{stats['mean_batch_occupancy']:.1f})",
             file=sys.stderr,
         )
-        print(
-            json.dumps(
-                {
-                    "metric": "serve_requests_per_sec",
-                    "value": round(rps, 1),
-                    "unit": "requests/s",
-                    "vs_baseline": None,
-                    "p50_latency_ms": round(p50, 2),
-                    "p95_latency_ms": round(p95, 2),
-                    "p99_latency_ms": round(p99, 2),
-                    "sessions": sessions,
-                    "mean_batch_occupancy": round(stats["mean_batch_occupancy"], 2),
-                    "bucket_fill": round(stats["bucket_fill"], 3),
-                    "reloads": stats["reloads"],
-                    "trace_count": stats["trace_count"],
-                    "core": cfg.recurrent_core
-                    + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
-                }
-            )
-        )
+        return {
+            "value": round(rps, 1),
+            "p50_latency_ms": round(p50, 2),
+            "p95_latency_ms": round(p95, 2),
+            "p99_latency_ms": round(p99, 2),
+            "mean_batch_occupancy": round(stats["mean_batch_occupancy"], 2),
+            "bucket_fill": round(stats["bucket_fill"], 3),
+            "reloads": stats["reloads"],
+            "trace_count": stats["trace_count"],
+            # carry-cache precision footprint (serve/state_cache.py stats)
+            "cache_dtype": stats["cache_dtype"],
+            "session_carry_bytes": stats["session_carry_bytes"],
+        }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def long_context_main(core: str = "lstm", lru_chunk: int = 0):
+def serve_main(
+    core: str = "lstm",
+    lru_chunk: int = 0,
+    sessions: int = 32,
+    seconds: float = 30.0,
+    precision: str = "bf16",
+):
+    """Serving-plane load test driver. Under --precision bf16/both an fp32
+    reference arm runs first, so the headline row carries `vs_fp32` on
+    requests/s measured at the identical session load; `both` also
+    attaches the fp32 arm's numbers. Reports sustained requests/s plus
+    p50/p95/p99 request latency (submit -> action), batch occupancy,
+    reload count, and the carry-cache precision footprint.
+
+    No baseline row exists yet for serving — vs_baseline is null until a
+    BENCH_*.json round records the first trajectory point."""
+    head_arm = "bf16" if precision in ("bf16", "both") else "fp32"
+    arms = {}
+    for arm in (["fp32"] if head_arm == "fp32" else ["fp32", "bf16"]):
+        cfg = _system_cfg(core=core, lru_chunk=lru_chunk, precision=arm)
+        arms[arm] = _serve_load(cfg, sessions, seconds)
+    head = arms[head_arm]
+    vs_fp32 = head["value"] / arms["fp32"]["value"]
+    if head_arm != "fp32":
+        print(
+            f"[precision] serve bf16 {head['value']:.0f} vs fp32 "
+            f"{arms['fp32']['value']:.0f} requests/s = {vs_fp32:.2f}x "
+            f"(p50 {head['p50_latency_ms']:.2f} vs "
+            f"{arms['fp32']['p50_latency_ms']:.2f} ms)",
+            file=sys.stderr,
+        )
+    row = {
+        "metric": "serve_requests_per_sec",
+        **head,
+        "unit": "requests/s",
+        "vs_baseline": None,
+        "vs_fp32": round(vs_fp32, 3),
+        "sessions": sessions,
+        "core": cfg.recurrent_core
+        + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
+        "precision": head_arm,
+    }
+    if precision == "both":
+        row["fp32"] = arms["fp32"]
+    print(json.dumps(row))
+
+
+def long_context_main(core: str = "lstm", lru_chunk: int = 0,
+                      precision: str = "bf16"):
     """Stretch configuration (BASELINE.json config 5): seq_len = 64 burn-in
     + 512 learning + 5 forward = 581 per sequence — at batch 32, ~3.4x the
     frame volume per update of the reference shape (32 x 581 vs 64 x 85).
@@ -739,8 +829,8 @@ def long_context_main(core: str = "lstm", lru_chunk: int = 0):
     from r2d2_tpu.config import long_context
 
     cfg = long_context().replace(
-        compute_dtype="bfloat16",
         batch_size=32,  # 32 x 581 frames/update fits HBM alongside the store
+        **_precision_overrides("bf16" if precision == "both" else precision),
         buffer_capacity=102_400,  # 200 slots x 512 ~= 0.8 GB obs store
         # pin the benched shapes to the config-5 spec (84x84 Nature/512,
         # seq 581) regardless of what game/geometry the preset's DEFAULT
@@ -813,6 +903,17 @@ if __name__ == "__main__":
              "chunked triangular matmuls on the MXU (requires --core lru)",
     )
     p.add_argument(
+        "--precision", default=None, choices=["fp32", "bf16", "both"],
+        help="mixed-precision arm (config.precision). fp32: full float32 "
+             "everywhere — the speedup denominator. bf16: bf16 matmuls, "
+             "fp32 master params + fp32 loss/target/priority islands, "
+             "bf16 recurrent-state storage in replay and the serve cache. "
+             "both: run fp32 then bf16 and report the speedup. Default: "
+             "bf16 for throughput modes, fp32 for recovery (the recovery "
+             "row's historical config; pass bf16 to drill the bf16 "
+             "snapshot round trip under preemption)",
+    )
+    p.add_argument(
         "--batch", type=int, default=0,
         help="learner mode: override batch_size (shape-granularity probe; "
              "0 = best-of-matrix sweep over {64, 128})",
@@ -836,17 +937,23 @@ if __name__ == "__main__":
         help="serve mode: measurement window (a hot reload fires halfway)",
     )
     args = p.parse_args()
+    precision = args.precision or (
+        "fp32" if args.mode == "recovery" else "bf16"
+    )
     if args.mode == "recovery":
-        recovery_main()
+        recovery_main(precision)
     elif args.mode == "serve":
-        serve_main(args.core, args.lru_chunk, args.sessions, args.serve_seconds)
+        serve_main(args.core, args.lru_chunk, args.sessions,
+                   args.serve_seconds, precision)
     elif args.mode == "system":
-        system_main(args.core, args.lru_chunk)
+        system_main(args.core, args.lru_chunk, precision)
     elif args.mode == "fused":
-        fused_system_main(args.collect_every, args.core, args.lru_chunk)
+        fused_system_main(args.collect_every, args.core, args.lru_chunk,
+                          precision)
     elif args.mode == "long_context":
-        long_context_main(args.core, args.lru_chunk)
+        long_context_main(args.core, args.lru_chunk, precision)
     elif args.plane == "tiered":
-        tiered_main(args.core, args.lru_chunk, args.batch, args.capacity)
+        tiered_main(args.core, args.lru_chunk, args.batch, args.capacity,
+                    precision=precision)
     else:
-        learner_matrix_main(args.core, args.lru_chunk, args.batch)
+        learner_matrix_main(args.core, args.lru_chunk, args.batch, precision)

@@ -248,7 +248,10 @@ def make_collect_core(
             "action": f_action.astype(jnp.int32),
             "n_step_reward": f_R,
             "gamma": f_gamma,
-            "hidden": hid_seq,
+            # downcast to the store dtype at pack time (f32 | bf16): the
+            # donated slab write into the HBM store requires exact dtype
+            # match with store_field_specs
+            "hidden": hid_seq.astype(jnp.dtype(cfg.state_dtype)),
             "burn_in": burn.astype(jnp.int32),
             "learning": learn.astype(jnp.int32),
             "forward": fwd.astype(jnp.int32),

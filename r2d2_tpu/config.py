@@ -112,6 +112,19 @@ class R2D2Config:
     # double rate on TPU.
     compute_dtype: str = "float32"  # "float32" | "bfloat16"
     param_dtype: str = "float32"
+    # Master mixed-precision policy. "fp32" keeps the golden path bit-exact:
+    # compute follows the compute_dtype knob above and recurrent-state
+    # STORAGE stays float32 everywhere. "bf16" switches the whole compute
+    # plane to bfloat16 (overriding compute_dtype — see
+    # resolved_compute_dtype) AND stores LSTM/LRU carries in bfloat16
+    # across every replay plane, replay snapshots, and the serve state
+    # cache: half the hidden-state HBM footprint and H2D staging bytes.
+    # Params + optimizer state stay float32 master copies regardless
+    # (the model casts on use), as do the fp32 correctness islands:
+    # Q-head/dueling math, value rescale, n-step target folding, TD
+    # error / priorities, IS weighting, and the loss reduction
+    # (learner.py loss_fn, models/r2d2.py _dueling).
+    precision: str = "fp32"  # "fp32" | "bf16"
 
     # --- parallelism ------------------------------------------------------
     # Data-parallel learner shards the batch over the "dp" mesh axis;
@@ -200,6 +213,32 @@ class R2D2Config:
 
     # --- derived ----------------------------------------------------------
     @property
+    def resolved_compute_dtype(self) -> str:
+        """Effective matmul/activation dtype for the model cores.
+
+        precision="bf16" forces bfloat16 compute; precision="fp32" defers
+        to the legacy compute_dtype knob, so pre-policy presets (bf16
+        matmuls + f32 state) keep their exact behavior and goldens."""
+        return "bfloat16" if self.precision == "bf16" else self.compute_dtype
+
+    @property
+    def state_dtype(self):
+        """Numpy dtype for STORED recurrent carries — the single source of
+        truth read by every replay plane's hidden store
+        (replay/block.store_field_specs, ReplayBuffer.hidden_store,
+        DeviceReplayBuffer.pad_block_fields), the device collector's block
+        packing, and the serve RecurrentStateCache. bfloat16 is numpy-side
+        ml_dtypes.bfloat16 (a jax dependency), so host slabs, npz
+        snapshots, and device stores all agree on the byte layout."""
+        import numpy as np  # deferred: config stays import-light
+
+        if self.precision == "bf16":
+            import ml_dtypes
+
+            return np.dtype(ml_dtypes.bfloat16)
+        return np.dtype(np.float32)
+
+    @property
     def tp_shards_params(self) -> bool:
         """True when tp>1 actually shards the LSTM kernels via GSPMD (the
         rule lives here ONCE: config validation, the model's LSTM backend
@@ -251,6 +290,14 @@ class R2D2Config:
             raise ValueError("action_dim > 256 would overflow uint8 replay storage")
         if self.encoder not in ("nature", "impala", "mlp"):
             raise ValueError(f"unknown encoder {self.encoder!r}")
+        if self.precision not in ("fp32", "bf16"):
+            raise ValueError(
+                f"unknown precision {self.precision!r}; 'fp32' keeps the "
+                "bit-exact golden path, 'bf16' enables the mixed-precision "
+                "compute plane + half-width carry storage"
+            )
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
         if self.lstm_backend not in ("auto", "scan", "pallas"):
             raise ValueError(f"unknown lstm_backend {self.lstm_backend!r}")
         if self.recurrent_core not in ("lstm", "lru"):

@@ -139,17 +139,27 @@ def _raw_train_step(cfg: R2D2Config, net: R2D2Network, axis_name: Optional[str] 
             target_params, b.obs, b.last_action, b.last_reward, b.hidden,
             b.burn_in_steps, b.learning_steps, b.forward_steps,
         )
+        # fp32 island (precision policy, config.precision): Q-target math,
+        # value rescaling, n-step folding, TD/priorities, IS weighting,
+        # and the loss reduction stay float32 no matter the compute dtype.
+        # The heads already emit f32 (models/r2d2.py _dueling); the casts
+        # pin the contract so a future bf16 head cannot silently narrow
+        # the target math (tests/test_precision.py asserts the island).
         # double-Q: online selects, target evaluates (worker.py:402-406)
         a_star = jnp.argmax(jax.lax.stop_gradient(q_boot_online), axis=-1)  # (B, L)
         q_tgt = jnp.take_along_axis(q_boot_target, a_star[..., None], axis=-1)[..., 0]
+        q_tgt = q_tgt.astype(jnp.float32)
         y = value_rescale(
-            b.n_step_reward + b.gamma * inverse_value_rescale(q_tgt, eps), eps
+            b.n_step_reward.astype(jnp.float32)
+            + b.gamma.astype(jnp.float32) * inverse_value_rescale(q_tgt, eps),
+            eps,
         )
         y = jax.lax.stop_gradient(y)
 
         q_taken = jnp.take_along_axis(q_learn, b.action[..., None], axis=-1)[..., 0]
+        q_taken = q_taken.astype(jnp.float32)
         td = y - q_taken
-        w = b.is_weights[:, None]
+        w = b.is_weights.astype(jnp.float32)[:, None]
         loss = jnp.sum(w * jnp.square(td) * mask) / denom
 
         abs_td = jnp.abs(td) * mask

@@ -74,7 +74,9 @@ class R2D2Network(nn.Module):
             learning_steps=cfg.learning_steps,
             forward_steps=cfg.forward_steps,
             encoder=cfg.encoder,
-            compute_dtype=cfg.compute_dtype,
+            # precision="bf16" forces bfloat16 compute; fp32 precision
+            # defers to the legacy compute_dtype knob (config.py)
+            compute_dtype=cfg.resolved_compute_dtype,
             impala_channels=tuple(cfg.impala_channels),
             scan_chunk=cfg.scan_chunk,
             lstm_backend=backend,

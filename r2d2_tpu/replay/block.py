@@ -37,8 +37,10 @@ class Block:
     n_step_reward: np.ndarray
     # (T,) float32 — bootstrap discount gamma_n(t); 0 past a terminal
     gamma: np.ndarray
-    # (num_sequences, 2, hidden_dim) float32 — LSTM (h, c) at the TRUE
-    # replay-window start of each sequence (fixes SURVEY.md quirk 1)
+    # (num_sequences, 2, hidden_dim) — LSTM (h, c) at the TRUE replay-
+    # window start of each sequence (fixes SURVEY.md quirk 1). Packed
+    # float32 by the accumulator; the stores downcast to cfg.state_dtype
+    # (bfloat16 under precision="bf16") at write time.
     hidden: np.ndarray
     num_sequences: int
     # (num_sequences,) int32 each
@@ -64,7 +66,10 @@ def store_field_specs(cfg):
         "action": ((bl,), np.int32),
         "n_step_reward": ((bl,), np.float32),
         "gamma": ((bl,), np.float32),
-        "hidden": ((S, 2, cfg.hidden_dim), np.float32),
+        # carries store at cfg.state_dtype: float32 on the golden path,
+        # bfloat16 under precision="bf16" (half the HBM/H2D bytes; the
+        # model cores cast back to their compute dtype on use)
+        "hidden": ((S, 2, cfg.hidden_dim), cfg.state_dtype),
         "burn_in": ((S,), np.int32),
         "learning": ((S,), np.int32),
         "forward": ((S,), np.int32),

@@ -113,7 +113,9 @@ class DeviceReplayBuffer(ReplayControlPlane):
             "action": pad(block.action.astype(np.int32), bl, np.int32),
             "n_step_reward": pad(block.n_step_reward, bl, np.float32),
             "gamma": pad(block.gamma, bl, np.float32),
-            "hidden": pad(block.hidden, S, np.float32),
+            # store dtype (f32 | bf16) — the donated jitted writes require
+            # vals to match store_field_specs exactly
+            "hidden": pad(block.hidden, S, cfg.state_dtype),
             "burn_in": pad(block.burn_in_steps, S, np.int32),
             "learning": pad(block.learning_steps, S, np.int32),
             "forward": pad(block.forward_steps, S, np.int32),

@@ -37,7 +37,7 @@ class SampledBatch:
     obs: np.ndarray            # (B, seq_len, *obs_shape) uint8
     last_action: np.ndarray    # (B, seq_len) uint8 scalar actions
     last_reward: np.ndarray    # (B, seq_len) float32
-    hidden: np.ndarray         # (B, 2, H) float32
+    hidden: np.ndarray         # (B, 2, H) cfg.state_dtype (f32 | bf16)
     action: np.ndarray         # (B, L) int32
     n_step_reward: np.ndarray  # (B, L) float32
     gamma: np.ndarray          # (B, L) float32
@@ -64,7 +64,10 @@ class ReplayBuffer(ReplayControlPlane):
         self.action_store = np.zeros((nb, cfg.block_length), dtype=np.uint8)
         self.n_step_reward_store = np.zeros((nb, cfg.block_length), dtype=np.float32)
         self.gamma_store = np.zeros((nb, cfg.block_length), dtype=np.float32)
-        self.hidden_store = np.zeros((nb, S, 2, cfg.hidden_dim), dtype=np.float32)
+        # cfg.state_dtype: float32, or bfloat16 under precision="bf16" —
+        # halves the carry slab and every sampled batch's hidden bytes
+        # (block.hidden arrives float32; the slab assignment downcasts)
+        self.hidden_store = np.zeros((nb, S, 2, cfg.hidden_dim), dtype=cfg.state_dtype)
         self.burn_in_store = np.zeros((nb, S), dtype=np.int32)
         self.learning_store = np.zeros((nb, S), dtype=np.int32)
         self.forward_store = np.zeros((nb, S), dtype=np.int32)

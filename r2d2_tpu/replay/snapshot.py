@@ -35,6 +35,7 @@ import os
 from typing import Dict, Optional
 
 import jax
+import ml_dtypes
 import numpy as np
 
 from r2d2_tpu.replay.control_plane import ReplayControlPlane
@@ -110,12 +111,49 @@ def _validated_stores(
     return out
 
 
+# bfloat16 stores (precision="bf16" carry slabs, and actor carries in the
+# extras payload under bf16 compute) cannot ride npz directly: np.savez
+# writes the ml_dtypes extension dtype but np.load hands it back as raw
+# void bytes. Round-trip them as uint16 bit-views plus a key manifest —
+# the restore side views them back, so _validated_stores still sees the
+# exact storage dtype and `--resume` stays bit-exact per plane.
+_BF16 = np.dtype(ml_dtypes.bfloat16)
+_BF16_KEYS = "bf16_keys"
+
+
+def _encode_bf16(payload: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    keys = sorted(k for k, v in payload.items() if v.dtype == _BF16)
+    if not keys:
+        return payload
+    out = dict(payload)
+    for k in keys:
+        out[k] = payload[k].view(np.uint16)
+    out[_BF16_KEYS] = np.asarray(keys)
+    return out
+
+
+class _Bf16NpzView:
+    """Read-side counterpart of _encode_bf16: an NpzFile facade that hands
+    back bfloat16 arrays with their dtype restored."""
+
+    def __init__(self, npz):
+        self._npz = npz
+        self._bf16 = (
+            {str(k) for k in npz[_BF16_KEYS]} if _BF16_KEYS in npz.files else set()
+        )
+        self.files = [k for k in npz.files if k != _BF16_KEYS]
+
+    def __getitem__(self, k):
+        v = self._npz[k]
+        return v.view(_BF16) if k in self._bf16 else v
+
+
 def _atomic_savez(path: str, payload: Dict[str, np.ndarray]) -> None:
     # keep the .npz suffix on the temp name: np.savez APPENDS .npz to
     # filenames without it, which would break the rename
     fault_point("snapshot.write")
     tmp = path + ".tmp.npz"
-    np.savez(tmp, **payload)
+    np.savez(tmp, **_encode_bf16(payload))
     os.replace(tmp, path)
 
 
@@ -183,7 +221,8 @@ def restore_replay(replay, path: str) -> Dict[str, np.ndarray]:
     from r2d2_tpu.replay.multihost_store import MultiHostShardedReplay
     from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
 
-    with np.load(path, allow_pickle=False) as d:
+    with np.load(path, allow_pickle=False) as npz:
+        d = _Bf16NpzView(npz)
         kind = str(d["kind"])
         # materialize extras before the NpzFile closes
         extras = {

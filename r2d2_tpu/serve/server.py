@@ -159,7 +159,11 @@ class PolicyServer:
                 f"cache_capacity ({serve_cfg.cache_capacity}) must be >= the "
                 f"largest batch bucket ({max(serve_cfg.buckets)})"
             )
-        self.cache = RecurrentStateCache(serve_cfg.cache_capacity, cfg.hidden_dim)
+        # carries cache at cfg.state_dtype (bf16 under precision="bf16"):
+        # half the per-session HBM and gather/scatter bytes per batch
+        self.cache = RecurrentStateCache(
+            serve_cfg.cache_capacity, cfg.hidden_dim, dtype=cfg.state_dtype
+        )
         self.batcher = MicroBatcher(
             buckets=serve_cfg.buckets,
             max_wait_s=serve_cfg.max_wait_ms / 1000.0,
@@ -206,8 +210,10 @@ class PolicyServer:
             # scatter back: pad rows all target the scratch slot (their
             # writes collide there harmlessly; real slots are unique by the
             # batcher's one-session-per-batch rule)
-            h_store = h_store.at[slots].set(h_new)
-            c_store = c_store.at[slots].set(c_new)
+            # explicit downcast to the cache dtype (act may compute at a
+            # wider dtype than the bf16 store holds)
+            h_store = h_store.at[slots].set(h_new.astype(h_store.dtype))
+            c_store = c_store.at[slots].set(c_new.astype(c_store.dtype))
             la_store = la_store.at[slots].set(action)
             lr_store = lr_store.at[slots].set(lr)
             return q, action, h_store, c_store, la_store, lr_store

@@ -39,14 +39,17 @@ class RecurrentStateCache:
     thread.
     """
 
-    def __init__(self, capacity: int, hidden_dim: int):
+    def __init__(self, capacity: int, hidden_dim: int, dtype=jnp.float32):
         if capacity < 1:
             raise ValueError("cache capacity must be >= 1")
         self.capacity = capacity
         self.hidden_dim = hidden_dim
+        # carry storage dtype: float32, or bfloat16 under the bf16
+        # precision policy (cfg.state_dtype) — halves per-session HBM
+        self.dtype = jnp.dtype(dtype)
         # +1 scratch row for bucket padding (gathered/scattered harmlessly)
-        self.h = jnp.zeros((capacity + 1, hidden_dim), jnp.float32)
-        self.c = jnp.zeros((capacity + 1, hidden_dim), jnp.float32)
+        self.h = jnp.zeros((capacity + 1, hidden_dim), self.dtype)
+        self.c = jnp.zeros((capacity + 1, hidden_dim), self.dtype)
         self.last_action = jnp.zeros((capacity + 1,), jnp.int32)
         self.last_reward = jnp.zeros((capacity + 1,), jnp.float32)
         self._slots: "OrderedDict[str, int]" = OrderedDict()
@@ -128,6 +131,11 @@ class RecurrentStateCache:
         self.h, self.c = h, c
         self.last_action, self.last_reward = last_action, last_reward
 
+    @property
+    def session_carry_bytes(self) -> int:
+        """Device bytes of recurrent state per session: h + c rows."""
+        return 2 * self.hidden_dim * self.dtype.itemsize
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -135,4 +143,6 @@ class RecurrentStateCache:
                 "cache_capacity": self.capacity,
                 "cache_evictions": self.evictions,
                 "cache_admissions": self.admissions,
+                "cache_dtype": self.dtype.name,
+                "session_carry_bytes": self.session_carry_bytes,
             }
