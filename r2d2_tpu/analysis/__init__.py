@@ -1,0 +1,17 @@
+"""Static-analysis plane: AST lints + jaxpr scanners with one findings
+model and a CLI (`python -m r2d2_tpu.analysis`, console script
+`r2d2-analyze`). See ARCHITECTURE.md "The analysis plane" for the rule
+catalog and suppression syntax.
+
+Import surface: `findings` and `ast_rules` are light (stdlib + the faults
+site registry); `jaxpr_rules` pulls in jax and the model stack and is
+imported lazily by the CLI's --jaxpr mode and the tests.
+"""
+
+from r2d2_tpu.analysis.findings import (  # noqa: F401
+    SEVERITIES,
+    Finding,
+    render_json,
+    render_text,
+    stable_sort,
+)

@@ -1,0 +1,5 @@
+import sys
+
+from r2d2_tpu.analysis.cli import main
+
+sys.exit(main())

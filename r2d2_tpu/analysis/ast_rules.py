@@ -1,0 +1,671 @@
+"""AST lint rules over the package source.
+
+Each rule encodes an invariant that Python cannot enforce at runtime until
+it is too late on hardware: a host sync inside a hot loop stalls the
+dispatch pipeline for a full device round trip, a silent recompile costs
+seconds per occurrence, a float64 op doubles memory and falls off the MXU,
+an unregistered fault site silently drops out of the chaos sweep, and an
+unlocked write to lock-guarded state is a data race waiting for a thread
+interleaving. The rules are deliberately conservative approximations —
+they flag the syntactic patterns that produce those failures, and a
+deliberate exception is silenced in place with
+
+    # r2d2: disable=<rule>[,<rule>...]          (same line or line above)
+
+so every suppression is visible in the diff it rides in on.
+
+Rule catalog (ids, severities — the table in ARCHITECTURE.md mirrors this):
+
+- host-sync-in-hot-path  (warning)  `.item()` / `jax.device_get` /
+  `np.asarray` / `np.array` / `float(x)` / `bool(x)` inside a for/while
+  body in the hot-path modules (learner.py, collect.py, megastep.py,
+  serve/*): each call can force a device->host sync per iteration.
+- jit-in-loop            (error)    `jax.jit(...)` called inside a
+  for/while body — a fresh jit wrapper per iteration retraces every call.
+- unhashable-static-arg  (error)    a jit static parameter whose default
+  is a mutable literal (list/dict/set): jit's cache key hashes static
+  args, so the first call raises (or, with a custom __hash__, silently
+  retraces).
+- shape-branch-in-jit    (warning)  an `if` on `.shape` inside a jitted
+  function whose body does real work (not just a guard `raise`): each new
+  shape traces a new program variant. Guard-raises are exempt — shape
+  validation at trace time is the idiom.
+- float64-op             (error)    device-plane float64: `jnp.float64`,
+  a float64 dtype passed to a jnp/jax constructor, or enabling
+  jax_enable_x64. Host-side numpy float64 (sum-tree prefix sums, env
+  reward accumulators) is fine and not flagged.
+- unknown-fault-site     (error)    `fault_point("site")` whose literal is
+  not registered in faults.KNOWN_SITES — the site would be invisible to
+  chaos sweeps and the R2D2_FAULTS operator surface.
+- dynamic-fault-site     (warning)  `fault_point(expr)` with a non-literal
+  argument — statically uncheckable, and sweeps cannot enumerate it.
+- lock-discipline        (warning)  a class that guards attribute writes
+  with `with self.<lock>:` in one method but writes the same attributes
+  bare in another (non-__init__) method — the trainer/serve/watcher
+  threads share these objects, so the bare write races the guarded one.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from r2d2_tpu.analysis.findings import Finding
+from r2d2_tpu.utils.faults import KNOWN_SITES
+
+ALL_RULES = (
+    "host-sync-in-hot-path",
+    "jit-in-loop",
+    "unhashable-static-arg",
+    "shape-branch-in-jit",
+    "float64-op",
+    "unknown-fault-site",
+    "dynamic-fault-site",
+    "lock-discipline",
+)
+
+# hot-path modules for the host-sync rule: the learner/collection dispatch
+# loops and the whole serving plane
+HOT_BASENAMES = {"learner.py", "collect.py", "megastep.py"}
+HOT_DIRNAMES = {"serve"}
+
+_SYNC_CALLS = {
+    "np.asarray": "np.asarray",
+    "np.array": "np.array",
+    "numpy.asarray": "np.asarray",
+    "numpy.array": "np.array",
+    "jax.device_get": "jax.device_get",
+}
+
+_DISABLE_RE = re.compile(r"#\s*r2d2:\s*disable=([A-Za-z0-9_,\s-]+)")
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+
+
+def is_hot_path(path: str) -> bool:
+    parts = path.replace(os.sep, "/").split("/")
+    return parts[-1] in HOT_BASENAMES or bool(HOT_DIRNAMES & set(parts[:-1]))
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.numpy.float64' for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _suppressions(src_lines: Sequence[str]) -> Dict[int, Set[str]]:
+    """Line -> suppressed rule set. A trailing `# r2d2: disable=` comment
+    covers its own line; a comment-ONLY line covers itself and the line
+    below (so it can sit above a long statement without leaking onto
+    unrelated neighbors)."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(src_lines, start=1):
+        m = _DISABLE_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        targets = (i, i + 1) if line.lstrip().startswith("#") else (i,)
+        for target in targets:
+            out.setdefault(target, set()).update(rules)
+    return out
+
+
+def _is_float64(node: ast.AST) -> bool:
+    d = _dotted(node)
+    if d in ("np.float64", "numpy.float64", "jnp.float64", "jax.numpy.float64"):
+        return True
+    return isinstance(node, ast.Constant) and node.value == "float64"
+
+
+# ---------------------------------------------------------------- the rules
+
+
+def _rule_host_sync(tree: ast.AST, path: str) -> List[Finding]:
+    if not is_hot_path(path):
+        return []
+    out: List[Finding] = []
+    seen: Set[Tuple[int, int]] = set()
+
+    def flag(node: ast.AST, what: str) -> None:
+        key = (node.lineno, node.col_offset)
+        if key in seen:
+            return
+        seen.add(key)
+        out.append(
+            Finding(
+                rule="host-sync-in-hot-path",
+                severity="warning",
+                path=path,
+                line=node.lineno,
+                col=node.col_offset,
+                message=f"{what} inside a hot-path loop body forces a "
+                "device->host sync per iteration",
+                hint="hoist the transfer out of the loop (batch it), or "
+                "mark a deliberate readback with "
+                "`# r2d2: disable=host-sync-in-hot-path`",
+            )
+        )
+
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for stmt in list(loop.body) + list(loop.orelse):
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func)
+                if d in _SYNC_CALLS:
+                    flag(node, f"{_SYNC_CALLS[d]}(...)")
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                    and not node.args
+                ):
+                    flag(node, ".item()")
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in ("float", "bool")
+                    and len(node.args) == 1
+                    and not isinstance(node.args[0], ast.Constant)
+                ):
+                    flag(node, f"{node.func.id}(...) on a possible device value")
+    return out
+
+
+def _jit_calls(tree: ast.AST) -> List[ast.Call]:
+    """Every `jax.jit(...)` call, including the `functools.partial(jax.jit,
+    ...)` decorator form (the partial call itself is returned)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if d == "jax.jit":
+            out.append(node)
+        elif d in ("functools.partial", "partial") and node.args:
+            if _dotted(node.args[0]) == "jax.jit":
+                out.append(node)
+    return out
+
+
+def _rule_jit_in_loop(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    jit_positions = {(c.lineno, c.col_offset) for c in _jit_calls(tree)}
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for stmt in list(loop.body) + list(loop.orelse):
+            for node in ast.walk(stmt):
+                if (
+                    isinstance(node, ast.Call)
+                    and (node.lineno, node.col_offset) in jit_positions
+                ):
+                    out.append(
+                        Finding(
+                            rule="jit-in-loop",
+                            severity="error",
+                            path=path,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            message="jax.jit called inside a loop body: each "
+                            "iteration builds a fresh wrapper with an empty "
+                            "trace cache",
+                            hint="build the jitted callable once outside the "
+                            "loop and reuse it",
+                        )
+                    )
+    return out
+
+
+def _function_defs(tree: ast.AST) -> Dict[str, ast.FunctionDef]:
+    defs: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    return defs
+
+
+def _static_params(call: ast.Call, fn: ast.FunctionDef) -> List[ast.arg]:
+    """Parameters of `fn` marked static by a jit call's static_argnames /
+    static_argnums keywords (literal values only)."""
+    params = list(fn.args.posonlyargs) + list(fn.args.args)
+    out: List[ast.arg] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames" and isinstance(kw.value, (ast.Tuple, ast.List)):
+            names = {
+                e.value
+                for e in kw.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            }
+            out.extend(p for p in params if p.arg in names)
+        elif kw.arg == "static_argnames" and isinstance(kw.value, ast.Constant):
+            out.extend(p for p in params if p.arg == kw.value.value)
+        elif kw.arg == "static_argnums":
+            nums = []
+            if isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, int):
+                nums = [kw.value.value]
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                nums = [
+                    e.value
+                    for e in kw.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                ]
+            out.extend(params[n] for n in nums if 0 <= n < len(params))
+    return out
+
+
+def _param_default(fn: ast.FunctionDef, param: ast.arg) -> Optional[ast.AST]:
+    params = list(fn.args.posonlyargs) + list(fn.args.args)
+    defaults = list(fn.args.defaults)
+    offset = len(params) - len(defaults)
+    for i, p in enumerate(params):
+        if p is param and i >= offset:
+            return defaults[i - offset]
+    for p, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+        if p is param and d is not None:
+            return d
+    return None
+
+
+def _jitted_defs(tree: ast.AST) -> List[Tuple[ast.Call, ast.FunctionDef]]:
+    """(jit call, wrapped FunctionDef) pairs resolvable statically: a bare
+    `jax.jit(name, ...)` over a same-module def, or a decorator (`@jax.jit`
+    / `@functools.partial(jax.jit, ...)`)."""
+    defs = _function_defs(tree)
+    pairs: List[Tuple[ast.Call, ast.FunctionDef]] = []
+    for call in _jit_calls(tree):
+        target = None
+        if _dotted(call.func) == "jax.jit" and call.args:
+            if isinstance(call.args[0], ast.Name):
+                target = defs.get(call.args[0].id)
+        elif call.args and len(call.args) >= 1:
+            # partial(jax.jit, ...) form: the decorated def is found below
+            pass
+        if target is not None:
+            pairs.append((call, target))
+    for fn in defs.values():
+        for dec in fn.decorator_list:
+            if _dotted(dec) == "jax.jit":
+                pairs.append((ast.Call(func=dec, args=[], keywords=[]), fn))
+            elif isinstance(dec, ast.Call) and dec in _jit_calls(tree):
+                pairs.append((dec, fn))
+    return pairs
+
+
+def _rule_unhashable_static_arg(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for call, fn in _jitted_defs(tree):
+        for param in _static_params(call, fn):
+            default = _param_default(fn, param)
+            if default is not None and isinstance(default, _MUTABLE_LITERALS):
+                out.append(
+                    Finding(
+                        rule="unhashable-static-arg",
+                        severity="error",
+                        path=path,
+                        line=param.lineno,
+                        col=param.col_offset,
+                        message=f"static jit parameter {param.arg!r} defaults "
+                        "to a mutable (unhashable) literal: jit hashes static "
+                        "args for its cache key",
+                        hint="use a tuple / frozen value, or drop the "
+                        "parameter from static_argnames",
+                    )
+                )
+    return out
+
+
+def _rule_shape_branch_in_jit(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    seen: Set[Tuple[int, int]] = set()
+    for _, fn in _jitted_defs(tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.If):
+                continue
+            has_shape = any(
+                isinstance(sub, ast.Attribute) and sub.attr == "shape"
+                for sub in ast.walk(node.test)
+            )
+            if not has_shape:
+                continue
+            # guard-raise idiom (shape validation at trace time) is exempt
+            if all(isinstance(stmt, ast.Raise) for stmt in node.body) and not node.orelse:
+                continue
+            key = (node.lineno, node.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(
+                Finding(
+                    rule="shape-branch-in-jit",
+                    severity="warning",
+                    path=path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message="shape-dependent branch inside a jitted function: "
+                    "every distinct shape traces (and compiles) a new variant",
+                    hint="pad to a fixed shape, lift the branch to the "
+                    "builder, or keep only a guard `raise`",
+                )
+            )
+    return out
+
+
+def _rule_float64(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    seen: Set[Tuple[int, int]] = set()
+
+    def flag(node: ast.AST, message: str, hint: str) -> None:
+        key = (node.lineno, node.col_offset)
+        if key in seen:
+            return
+        seen.add(key)
+        out.append(
+            Finding(
+                rule="float64-op",
+                severity="error",
+                path=path,
+                line=node.lineno,
+                col=node.col_offset,
+                message=message,
+                hint=hint,
+            )
+        )
+
+    for node in ast.walk(tree):
+        d = _dotted(node) if isinstance(node, ast.Attribute) else None
+        if d in ("jnp.float64", "jax.numpy.float64"):
+            flag(
+                node,
+                "jnp.float64 violates the precision policy (x64 is off; the "
+                "op silently produces f32 or, with x64 on, doubles memory "
+                "and falls off the MXU)",
+                "use jnp.float32; host-side accumulation may use np.float64",
+            )
+        elif isinstance(node, ast.Call):
+            cd = _dotted(node.func)
+            if (
+                cd == "jax.config.update"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "jax_enable_x64"
+                and len(node.args) > 1
+                and isinstance(node.args[1], ast.Constant)
+                and node.args[1].value is True
+            ):
+                flag(
+                    node,
+                    "enabling jax_enable_x64 turns every default float into "
+                    "f64 device-wide",
+                    "keep x64 off; widen individual host-side numpy arrays "
+                    "instead",
+                )
+            elif cd is not None and cd.split(".")[0] in ("jnp", "jax"):
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if _is_float64(arg):
+                        flag(
+                            arg,
+                            f"float64 dtype passed to {cd}: device arrays "
+                            "must stay <= 32-bit under the precision policy",
+                            "use float32 (or bf16 via config.precision)",
+                        )
+    return out
+
+
+def _rule_fault_sites(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if d is None or d.split(".")[-1] != "fault_point":
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value not in KNOWN_SITES:
+                out.append(
+                    Finding(
+                        rule="unknown-fault-site",
+                        severity="error",
+                        path=path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=f"fault site {arg.value!r} is not registered "
+                        "in faults.KNOWN_SITES: chaos sweeps and the "
+                        "R2D2_FAULTS operator surface cannot see it",
+                        hint="add the site to KNOWN_SITES (utils/faults.py) "
+                        "or fix the typo",
+                    )
+                )
+        else:
+            out.append(
+                Finding(
+                    rule="dynamic-fault-site",
+                    severity="warning",
+                    path=path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message="fault_point called with a non-literal site name: "
+                    "statically uncheckable and unenumerable by sweeps",
+                    hint="pass a string literal registered in KNOWN_SITES",
+                )
+            )
+    return out
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (
+            isinstance(node.value, ast.Call)
+            and _dotted(node.value.func) in ("threading.Lock", "threading.RLock")
+        ):
+            continue
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                locks.add(t.attr)
+    return locks
+
+
+def _self_attr_writes(node: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """(attr name, node) for every `self.X = / self.X op= / self.X[...] =`
+    in the subtree, NOT descending into nested function defs."""
+    out: List[Tuple[str, ast.AST]] = []
+
+    def targets_of(stmt) -> List[ast.AST]:
+        if isinstance(stmt, ast.Assign):
+            return list(stmt.targets)
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            return [stmt.target]
+        return []
+
+    def visit(n: ast.AST) -> None:
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            for t in targets_of(child):
+                base = t
+                if isinstance(base, ast.Subscript):
+                    base = base.value
+                if (
+                    isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"
+                ):
+                    out.append((base.attr, child))
+            visit(child)
+
+    visit(node)
+    return out
+
+
+def _rule_lock_discipline(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = _lock_attrs(cls)
+        if not locks:
+            continue
+        methods = [
+            n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+
+        def lock_blocks(method) -> List[ast.With]:
+            blocks = []
+            for node in ast.walk(method):
+                if not isinstance(node, ast.With):
+                    continue
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Call):  # e.g. lock.acquire-style wrappers
+                        ctx = ctx.func
+                    if (
+                        isinstance(ctx, ast.Attribute)
+                        and isinstance(ctx.value, ast.Name)
+                        and ctx.value.id == "self"
+                        and ctx.attr in locks
+                    ):
+                        blocks.append(node)
+                        break
+            return blocks
+
+        guarded: Set[str] = set()
+        per_method_blocks: Dict[str, List[ast.With]] = {}
+        for m in methods:
+            blocks = lock_blocks(m)
+            per_method_blocks[m.name] = blocks
+            for b in blocks:
+                for attr, _ in _self_attr_writes(b):
+                    guarded.add(attr)
+        guarded -= locks
+        if not guarded:
+            continue
+
+        for m in methods:
+            if m.name == "__init__":
+                continue
+            locked_nodes: Set[int] = set()
+            for b in per_method_blocks[m.name]:
+                for sub in ast.walk(b):
+                    locked_nodes.add(id(sub))
+            for attr, node in _self_attr_writes(m):
+                if attr in guarded and id(node) not in locked_nodes:
+                    out.append(
+                        Finding(
+                            rule="lock-discipline",
+                            severity="warning",
+                            path=path,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            message=f"self.{attr} is written under "
+                            f"`with self.<lock>` elsewhere in "
+                            f"{cls.name} but bare here: the write races "
+                            "the guarded ones across threads",
+                            hint="take the lock, or mark a single-threaded "
+                            "phase with `# r2d2: disable=lock-discipline`",
+                        )
+                    )
+    return out
+
+
+_RULES = (
+    _rule_host_sync,
+    _rule_jit_in_loop,
+    _rule_unhashable_static_arg,
+    _rule_shape_branch_in_jit,
+    _rule_float64,
+    _rule_fault_sites,
+    _rule_lock_discipline,
+)
+
+
+# ---------------------------------------------------------------- driver
+
+
+def analyze_source(
+    text: str, path: str
+) -> Tuple[List[Finding], List[Finding]]:
+    """Run every AST rule over one file's source. Returns
+    (findings, suppressed) — suppressed findings matched a
+    `# r2d2: disable=` comment and do not gate."""
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return (
+            [
+                Finding(
+                    rule="syntax-error",
+                    severity="error",
+                    path=path,
+                    line=e.lineno or 0,
+                    col=e.offset or 0,
+                    message=f"file does not parse: {e.msg}",
+                )
+            ],
+            [],
+        )
+    src_lines = text.splitlines()
+    suppress = _suppressions(src_lines)
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    for rule_fn in _RULES:
+        for f in rule_fn(tree, path):
+            rules_here = suppress.get(f.line, set())
+            if f.rule in rules_here or "all" in rules_here:
+                suppressed.append(f)
+            else:
+                findings.append(f)
+    return findings, suppressed
+
+
+def collect_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs if d != "__pycache__" and not d.startswith(".")
+                )
+                out.extend(
+                    os.path.join(root, f) for f in sorted(files) if f.endswith(".py")
+                )
+        elif p.endswith(".py") and os.path.exists(p):
+            out.append(p)
+    return sorted(dict.fromkeys(out))
+
+
+def analyze_paths(
+    paths: Iterable[str],
+) -> Tuple[List[Finding], List[Finding]]:
+    """AST-lint every .py file under `paths` (files or directories).
+    Returns (findings, suppressed), stable-sorted."""
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    for path in collect_py_files(paths):
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        f, s = analyze_source(text, path)
+        findings.extend(f)
+        suppressed.extend(s)
+    findings.sort(key=Finding.sort_key)
+    suppressed.sort(key=Finding.sort_key)
+    return findings, suppressed

@@ -1,0 +1,94 @@
+"""CLI for the analysis plane.
+
+    python -m r2d2_tpu.analysis [--format text|json] [--changed-only]
+                                [--jaxpr] [paths...]
+
+Default paths: the installed r2d2_tpu package tree. Exit status 1 when any
+unsuppressed finding remains (suppressed ones are counted in text mode but
+never gate). `--changed-only` narrows to files reported by
+`git diff --name-only HEAD` plus untracked .py files — the fast local
+loop. `--jaxpr` additionally traces the canonical entry points at both
+precisions (slower: pulls in jax and the model stack).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import List
+
+from r2d2_tpu.analysis import ast_rules
+from r2d2_tpu.analysis.findings import render_json, render_text
+
+
+def _changed_files(repo_root: str) -> List[str]:
+    """Tracked-modified plus untracked .py files, absolute paths."""
+    out: List[str] = []
+    for args in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(
+                args, cwd=repo_root, capture_output=True, text=True, check=True
+            )
+        except (OSError, subprocess.CalledProcessError):
+            continue
+        out.extend(
+            os.path.join(repo_root, line)
+            for line in res.stdout.splitlines()
+            if line.endswith(".py")
+        )
+    return sorted(dict.fromkeys(out))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="r2d2-analyze",
+        description="JAX-aware static analysis: dtype/recompile/host-sync/"
+        "donation/fault-site lints",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: the r2d2_tpu package)",
+    )
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument(
+        "--changed-only", action="store_true",
+        help="lint only git-changed/untracked .py files (fast local loop)",
+    )
+    parser.add_argument(
+        "--jaxpr", action="store_true",
+        help="also trace the canonical train/act/serve entry points at both "
+        "precisions and run the jaxpr checkers (slow: imports jax)",
+    )
+    args = parser.parse_args(argv)
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if args.changed_only:
+        repo_root = os.path.dirname(pkg_root)
+        paths = _changed_files(repo_root)
+    elif args.paths:
+        paths = args.paths
+    else:
+        paths = [pkg_root]
+
+    findings, suppressed = ast_rules.analyze_paths(paths)
+    if args.jaxpr:
+        from r2d2_tpu.analysis import jaxpr_rules
+
+        findings = findings + jaxpr_rules.scan_entry_points()
+
+    if args.format == "json":
+        print(render_json(findings))
+    else:
+        print(render_text(findings))
+        if suppressed:
+            print(f"({len(suppressed)} suppressed)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""Findings model for the static-analysis plane.
+
+One shape for every checker — AST lints and jaxpr scanners alike — so the
+CLI, the tier-1 gate (tests/test_analysis.py), and ad-hoc callers all
+consume the same records: rule id, severity, file:line, message, and a fix
+hint. JSON output is stable-sorted (path, line, col, rule, message) so two
+runs over the same tree diff clean.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterable, List
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str  # one of SEVERITIES
+    path: str  # source file, or "<jaxpr:label>" for traced-program findings
+    line: int  # 1-based; 0 for whole-program (jaxpr) findings
+    col: int  # 0-based column; 0 for jaxpr findings
+    message: str
+    hint: str = ""
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"unknown severity {self.severity!r}")
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule, self.message)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "hint": self.hint,
+        }
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        out = f"{loc} [{self.severity}] {self.rule}: {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+def stable_sort(findings: Iterable[Finding]) -> List[Finding]:
+    return sorted(findings, key=Finding.sort_key)
+
+
+def render_text(findings: Iterable[Finding]) -> str:
+    fs = stable_sort(findings)
+    if not fs:
+        return "no findings"
+    lines = [f.render() for f in fs]
+    lines.append(f"{len(fs)} finding{'s' if len(fs) != 1 else ''}")
+    return "\n".join(lines)
+
+
+def render_json(findings: Iterable[Finding]) -> str:
+    fs = stable_sort(findings)
+    return json.dumps(
+        {"count": len(fs), "findings": [f.to_dict() for f in fs]},
+        indent=2,
+        sort_keys=True,
+    )

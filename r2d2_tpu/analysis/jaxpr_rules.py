@@ -1,0 +1,506 @@
+"""Jaxpr scanners over the canonical compiled entry points.
+
+The AST rules catch what the *source* says; these catch what the *traced
+program* actually does. Each scanner traces one canonical entry point —
+the stacked-batch train step (the tiered plane's consumer), the batched
+act step (the actor fleet's policy call), and the serve step — at a given
+precision and asserts the dtype/donation contracts the precision policy
+promises:
+
+- no float64 anywhere, either precision (x64 is off; an f64 op on TPU
+  would double memory and fall off the MXU);
+- the fp32 golden path is bf16-free (bit-exactness contract);
+- the bf16 path keeps its fp32 islands (loss/target/priority math) AND
+  actually computes in bf16 (otherwise the precision knob is dead);
+- donated TrainState buffers are fully consumed: every donated leaf's
+  (shape, dtype) reappears in the outputs, so XLA can alias in place
+  (the silent-copy failure mode);
+- host-padded block fields agree exactly with `store_field_specs` — the
+  donated device-store `_write` requires vals dtypes to match the store
+  buffers (the PR-4 `pad_block_fields` bug class: a float32 `hidden` slab
+  against a bf16 store).
+
+Traces are tiny (config.tiny_test shapes) and cached with lru_cache keyed
+by precision, so the tier-1 gate and the per-precision tests share one
+trace per entry point per precision across the whole pytest process.
+
+Findings use path "<jaxpr:LABEL>" with line 0 — there is no source line
+for a traced program; the label names the entry point and precision.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from r2d2_tpu.analysis.findings import Finding
+
+# jax and the model stack import lazily inside the cached helpers so that
+# `python -m r2d2_tpu.analysis` (AST lints only) stays cheap.
+
+
+def _finding(rule: str, label: str, message: str, hint: str = "",
+             severity: str = "error") -> Finding:
+    return Finding(
+        rule=rule, severity=severity, path=f"<jaxpr:{label}>",
+        line=0, col=0, message=message, hint=hint,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _cfg(precision: str):
+    from r2d2_tpu.config import tiny_test
+
+    return tiny_test().replace(precision=precision)
+
+
+@functools.lru_cache(maxsize=None)
+def _net_and_state(precision: str):
+    import jax
+
+    from r2d2_tpu.learner import init_train_state
+
+    cfg = _cfg(precision)
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    return net, state
+
+
+def _stacked_batch_struct(precision: str, num_steps: int):
+    """ShapeDtypeStructs of a (K, B, ...) stacked DeviceBatch at tiny_test
+    shapes — tracing needs only avals, not data."""
+    import jax
+
+    from r2d2_tpu.learner import DeviceBatch
+
+    cfg = _cfg(precision)
+    K, B, T, L = num_steps, cfg.batch_size, cfg.seq_len, cfg.learning_steps
+    sds = jax.ShapeDtypeStruct
+    return DeviceBatch(
+        obs=sds((K, B, T, *cfg.obs_shape), np.uint8),
+        last_action=sds((K, B, T), np.int32),
+        last_reward=sds((K, B, T), np.float32),
+        hidden=sds((K, B, 2, cfg.hidden_dim), cfg.state_dtype),
+        action=sds((K, B, L), np.int32),
+        n_step_reward=sds((K, B, L), np.float32),
+        gamma=sds((K, B, L), np.float32),
+        burn_in_steps=sds((K, B), np.int32),
+        learning_steps=sds((K, B), np.int32),
+        forward_steps=sds((K, B), np.int32),
+        is_weights=sds((K, B), np.float32),
+    )
+
+
+_NUM_STEPS = 2  # K of the stacked train step: >1 so the scan is real
+
+
+@functools.lru_cache(maxsize=None)
+def train_step_jaxpr(precision: str) -> str:
+    """Jaxpr text of the stacked-batch train step (the canonical learner
+    entry point: every other step builder shares its _raw_train_step
+    body)."""
+    import jax
+
+    from r2d2_tpu.learner import make_stacked_batch_train_step
+
+    cfg = _cfg(precision)
+    net, state = _net_and_state(precision)
+    step = make_stacked_batch_train_step(cfg, net, _NUM_STEPS, donate=False)
+    return str(jax.make_jaxpr(step)(state, _stacked_batch_struct(precision, _NUM_STEPS)))
+
+
+@functools.lru_cache(maxsize=None)
+def act_jaxpr(precision: str, num_envs: int = 4) -> str:
+    """Jaxpr text of the batched act step (VectorizedActor._policy's
+    body: one net.act over the env fleet)."""
+    import jax
+
+    cfg = _cfg(precision)
+    net, state = _net_and_state(precision)
+    sds = jax.ShapeDtypeStruct
+    E, H = num_envs, cfg.hidden_dim
+
+    def policy(params, obs, la, lr, carry):
+        return net.apply(params, obs, la, lr, carry, method=net.act)
+
+    return str(
+        jax.make_jaxpr(policy)(
+            state.params,
+            sds((E, *cfg.obs_shape), np.uint8),
+            sds((E,), np.int32),
+            sds((E,), np.float32),
+            (sds((E, H), np.float32), sds((E, H), np.float32)),
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _serve_server(precision: str):
+    from r2d2_tpu.serve.server import PolicyServer, ServeConfig
+
+    cfg = _cfg(precision)
+    # smallest legal serve plane: one bucket, cache == bucket; never started
+    return PolicyServer(cfg, ServeConfig(buckets=(2,), cache_capacity=2))
+
+
+@functools.lru_cache(maxsize=None)
+def serve_step_jaxpr(precision: str) -> str:
+    """Jaxpr text of the serve step (PolicyServer._build_step's jitted
+    body) at the smallest bucket."""
+    import jax
+
+    cfg = _cfg(precision)
+    server = _serve_server(precision)
+    bucket = server.batcher.buckets[0]
+    h, c, la, lr = server.cache.arrays()
+    sds = jax.ShapeDtypeStruct
+    return str(
+        jax.make_jaxpr(server._step)(
+            server._published[0], h, c, la, lr,
+            sds((bucket, *cfg.obs_shape), np.uint8),
+            sds((bucket,), np.float32),
+            sds((bucket,), np.int32),
+            sds((bucket,), bool),
+            sds((bucket,), bool),
+            sds((bucket,), np.int32),
+        )
+    )
+
+
+# ----------------------------------------------------------- dtype checkers
+
+
+def check_no_float64(jaxpr_text: str, label: str) -> List[Finding]:
+    """No f64 arrays anywhere in the traced program, either precision."""
+    if "f64[" in jaxpr_text:
+        return [
+            _finding(
+                "jaxpr-float64", label,
+                "traced program materializes float64 arrays: x64 must stay "
+                "off (f64 doubles memory and falls off the MXU)",
+                hint="find the widening op (np.float64 scalar reaching a "
+                "jnp op is the usual source) and pin float32",
+            )
+        ]
+    return []
+
+
+def check_no_bf16(jaxpr_text: str, label: str) -> List[Finding]:
+    """The fp32 golden path must be bf16-free (bit-exactness contract)."""
+    if "bf16[" in jaxpr_text:
+        return [
+            _finding(
+                "jaxpr-bf16-in-fp32", label,
+                "bf16 arrays inside the fp32 golden path: the bit-exact "
+                "contract (precision='fp32') is broken",
+                hint="a cast to cfg.resolved_compute_dtype is leaking; the "
+                "golden path must stay float32 end to end",
+            )
+        ]
+    return []
+
+
+def check_fp32_island(jaxpr_text: str, label: str) -> List[Finding]:
+    """Under bf16 the program must BOTH compute in bf16 (else the precision
+    knob is dead) AND keep f32 ops (the loss/target/priority islands)."""
+    out: List[Finding] = []
+    if "bf16[" not in jaxpr_text:
+        out.append(
+            _finding(
+                "jaxpr-no-bf16-under-bf16", label,
+                "precision='bf16' traced a program with no bf16 arrays: the "
+                "compute plane silently stayed float32",
+                hint="check resolved_compute_dtype reaches the model cores",
+            )
+        )
+    if "f32[" not in jaxpr_text:
+        out.append(
+            _finding(
+                "jaxpr-missing-fp32-island", label,
+                "no float32 ops under bf16: the fp32 correctness islands "
+                "(Q-target/value-rescale/TD/loss math) have been narrowed",
+                hint="learner.loss_fn must cast target/TD math to float32 "
+                "regardless of compute dtype",
+            )
+        )
+    return out
+
+
+# -------------------------------------------------------- donation checkers
+
+
+def _leaf_specs(tree) -> List[Tuple[Tuple[int, ...], str]]:
+    import jax
+
+    return sorted(
+        (tuple(l.shape), str(l.dtype)) for l in jax.tree.leaves(tree)
+    )
+
+
+def compare_donated_leaves(donated_tree, out_tree, label: str) -> List[Finding]:
+    """Core of the donation rule, reusable on any (donated input, output)
+    pytree pair: every donated leaf's (shape, dtype) must reappear in the
+    outputs (multiset match) or XLA silently copies instead of aliasing."""
+    missing = []
+    out_specs = _leaf_specs(out_tree)
+    for spec in _leaf_specs(donated_tree):
+        if spec in out_specs:
+            out_specs.remove(spec)
+        else:
+            missing.append(spec)
+    if missing:
+        return [
+            _finding(
+                "jaxpr-donation-mismatch", label,
+                f"donated leaves with no matching output buffer "
+                f"(shape, dtype): {missing[:4]}{'...' if len(missing) > 4 else ''} "
+                "— XLA cannot alias them and falls back to a copy",
+                hint="keep the output leaf shapes/dtypes identical to the "
+                "donated input's",
+            )
+        ]
+    return []
+
+
+def check_train_state_donation(precision: str) -> List[Finding]:
+    """Donated TrainState must be FULLY consumed: the output state's leaf
+    (shape, dtype) multiset must equal the input's, leaf for leaf, or XLA
+    silently copies instead of aliasing (and on real HBM the 'donated'
+    buffer is wasted)."""
+    import jax
+
+    from r2d2_tpu.learner import make_stacked_batch_train_step
+
+    label = f"train_step[{precision}].donation"
+    cfg = _cfg(precision)
+    net, state = _net_and_state(precision)
+    step = make_stacked_batch_train_step(cfg, net, _NUM_STEPS, donate=True)
+    out_state, _, _ = jax.eval_shape(
+        step, state, _stacked_batch_struct(precision, _NUM_STEPS)
+    )
+    return compare_donated_leaves(state, out_state, label)
+
+
+def compare_store_fields(vals: Dict[str, np.ndarray], specs, label: str) -> List[Finding]:
+    """Core of the store-dtype rule, reusable on any (padded vals, field
+    specs) pair: the donated device-store writes require an exact
+    shape+dtype match per field."""
+    out: List[Finding] = []
+    for k, (shape, dtype) in specs.items():
+        if k not in vals:
+            out.append(
+                _finding(
+                    "jaxpr-store-field-mismatch", label,
+                    f"store field {k!r} has a spec but pad_block_fields "
+                    "does not produce it",
+                    hint="extend pad_block_fields alongside store_field_specs",
+                )
+            )
+            continue
+        got = vals[k]
+        if got.dtype != np.dtype(dtype) or got.shape != tuple(shape):
+            out.append(
+                _finding(
+                    "jaxpr-store-field-mismatch", label,
+                    f"store field {k!r}: padded block gives "
+                    f"{got.dtype}{list(got.shape)}, store expects "
+                    f"{np.dtype(dtype)}{list(shape)} — the donated _write "
+                    "jit needs an exact match",
+                    hint="pad with the spec's dtype/shape from "
+                    "store_field_specs (single source of truth)",
+                )
+            )
+    for k in vals:
+        if k not in specs:
+            out.append(
+                _finding(
+                    "jaxpr-store-field-mismatch", label,
+                    f"pad_block_fields produces {k!r} with no store spec",
+                    hint="extend store_field_specs alongside pad_block_fields",
+                )
+            )
+    return out
+
+
+def check_store_field_dtypes(precision: str) -> List[Finding]:
+    """pad_block_fields output must agree with store_field_specs exactly —
+    the device store's donated `_write` jit requires vals dtypes == store
+    dtypes (the PR-4 bug class: an f32 hidden slab against a bf16 store
+    retraces or fails the donation)."""
+    from r2d2_tpu.replay.block import Block, store_field_specs
+    from r2d2_tpu.replay.device_store import DeviceReplayBuffer
+
+    label = f"store_write[{precision}].dtypes"
+    cfg = _cfg(precision)
+    S, n, bl = cfg.seqs_per_block, cfg.block_slot_len - 1, cfg.block_length
+    # accumulator-packed dtypes: uint8 actions, float32 hidden (the store
+    # downcasts at write time)
+    block = Block(
+        obs=np.zeros((n, *cfg.obs_shape), np.uint8),
+        last_action=np.zeros(n, np.uint8),
+        last_reward=np.zeros(n, np.float32),
+        action=np.zeros(bl, np.uint8),
+        n_step_reward=np.zeros(bl, np.float32),
+        gamma=np.zeros(bl, np.float32),
+        hidden=np.zeros((S, 2, cfg.hidden_dim), np.float32),
+        num_sequences=S,
+        burn_in_steps=np.full(S, cfg.burn_in_steps, np.int32),
+        learning_steps=np.full(S, cfg.learning_steps, np.int32),
+        forward_steps=np.full(S, cfg.forward_steps, np.int32),
+    )
+    vals = DeviceReplayBuffer.pad_block_fields(cfg, block)
+    return compare_store_fields(vals, store_field_specs(cfg), label)
+
+
+def check_trace_budget(trace_count: int, buckets: Sequence[int],
+                       label: str = "serve_step") -> List[Finding]:
+    """The serve step may trace at most once per batch bucket; more means
+    an unstable cache key (a recompile per request shape) slipped in."""
+    if trace_count > len(buckets):
+        return [
+            _finding(
+                "jaxpr-trace-budget", label,
+                f"serve step traced {trace_count} times for "
+                f"{len(buckets)} bucket shape(s): some input's shape/dtype "
+                "or a static arg is varying per call",
+                hint="pad requests to the bucket shapes; keep every other "
+                "input's aval fixed",
+            )
+        ]
+    return []
+
+
+# ----------------------------------------------------------- entry points
+
+
+def scan_train_step(precision: str) -> List[Finding]:
+    label = f"train_step[{precision}]"
+    text = train_step_jaxpr(precision)
+    out = check_no_float64(text, label)
+    if precision == "fp32":
+        out += check_no_bf16(text, label)
+    else:
+        out += check_fp32_island(text, label)
+    out += _check_train_outputs(precision)
+    return out
+
+
+def _check_train_outputs(precision: str) -> List[Finding]:
+    """Metrics/priorities leave the step float32 at either precision (the
+    host-side consumers — priority tree, jsonl metrics — assume it)."""
+    import jax
+
+    from r2d2_tpu.learner import make_stacked_batch_train_step
+
+    label = f"train_step[{precision}].outputs"
+    cfg = _cfg(precision)
+    net, state = _net_and_state(precision)
+    step = make_stacked_batch_train_step(cfg, net, _NUM_STEPS, donate=False)
+    _, metrics, prios = jax.eval_shape(
+        step, state, _stacked_batch_struct(precision, _NUM_STEPS)
+    )
+    out: List[Finding] = []
+    if str(prios.dtype) != "float32":
+        out.append(
+            _finding(
+                "jaxpr-output-dtype", label,
+                f"priorities leave the train step as {prios.dtype}, host "
+                "priority tree expects float32",
+                hint="mixed_td_priorities runs in the fp32 island; keep it",
+            )
+        )
+    for k, v in metrics.items():
+        if str(v.dtype) != "float32":
+            out.append(
+                _finding(
+                    "jaxpr-output-dtype", label,
+                    f"metric {k!r} leaves the train step as {v.dtype}, "
+                    "expected float32",
+                    hint="metrics are loss-island values; keep them f32",
+                )
+            )
+    return out
+
+
+def scan_act(precision: str) -> List[Finding]:
+    label = f"act[{precision}]"
+    text = act_jaxpr(precision)
+    out = check_no_float64(text, label)
+    if precision == "fp32":
+        out += check_no_bf16(text, label)
+    else:
+        # act has no loss island: only the no-silent-fp32 half applies
+        out += [
+            f for f in check_fp32_island(text, label)
+            if f.rule == "jaxpr-no-bf16-under-bf16"
+        ]
+    return out
+
+
+def scan_serve_step(precision: str) -> List[Finding]:
+    import jax
+
+    label = f"serve_step[{precision}]"
+    text = serve_step_jaxpr(precision)
+    out = check_no_float64(text, label)
+    if precision == "fp32":
+        out += check_no_bf16(text, label)
+    # q must come back f32 for the host-side argpartition/audit path
+    cfg = _cfg(precision)
+    server = _serve_server(precision)
+    bucket = server.batcher.buckets[0]
+    h, c, la, lr = server.cache.arrays()
+    sds = jax.ShapeDtypeStruct
+    q, action, h2, c2, *_ = jax.eval_shape(
+        server._step,
+        server._published[0], h, c, la, lr,
+        sds((bucket, *cfg.obs_shape), np.uint8),
+        sds((bucket,), np.float32),
+        sds((bucket,), np.int32),
+        sds((bucket,), bool),
+        sds((bucket,), bool),
+        sds((bucket,), np.int32),
+    )
+    if str(q.dtype) != "float32":
+        out.append(
+            _finding(
+                "jaxpr-output-dtype", label,
+                f"served q values leave the step as {q.dtype}, expected "
+                "float32 (dueling head math is an fp32 island)",
+            )
+        )
+    if (h2.dtype, h2.shape) != (h.dtype, h.shape) or (c2.dtype, c2.shape) != (
+        c.dtype, c.shape
+    ):
+        out.append(
+            _finding(
+                "jaxpr-donation-mismatch", label,
+                "serve step returns carry stores whose shape/dtype differ "
+                "from the donated input stores — in-place aliasing breaks "
+                "and the cache dtype contract drifts",
+                hint="cast h_new/c_new to the store dtype before the "
+                "scatter (server._build_step does this explicitly)",
+            )
+        )
+    return out
+
+
+def scan_donation(precision: str) -> List[Finding]:
+    return check_train_state_donation(precision) + check_store_field_dtypes(precision)
+
+
+def scan_entry_points(
+    precisions: Sequence[str] = ("fp32", "bf16"),
+) -> List[Finding]:
+    """The full jaxpr gate: every canonical entry point at every precision
+    plus the donation/store-dtype contracts. Zero findings on a healthy
+    tree (tier-1 asserts this)."""
+    out: List[Finding] = []
+    for p in precisions:
+        out += scan_train_step(p)
+        out += scan_act(p)
+        out += scan_serve_step(p)
+        out += scan_donation(p)
+    out.sort(key=Finding.sort_key)
+    return out

@@ -460,6 +460,8 @@ class DeviceCollector:
             "total_steps": np.asarray(self.total_steps, np.int64),
         }
         for j, leaf in enumerate(jax.tree.leaves(self.env_state)):
+            # deliberate readback: preemption carry runs once per snapshot,
+            # not per env step  # r2d2: disable=host-sync-in-hot-path
             d[f"env_{j}"] = np.asarray(leaf)
         return d
 

@@ -114,7 +114,9 @@ class DeviceReplayBuffer(ReplayControlPlane):
             "n_step_reward": pad(block.n_step_reward, bl, np.float32),
             "gamma": pad(block.gamma, bl, np.float32),
             # store dtype (f32 | bf16) — the donated jitted writes require
-            # vals to match store_field_specs exactly
+            # vals to match store_field_specs exactly; the analysis plane's
+            # check_store_field_dtypes (jaxpr_rules) pins the agreement in
+            # tier-1, so a drift here fails the gate before it hits _write
             "hidden": pad(block.hidden, S, cfg.state_dtype),
             "burn_in": pad(block.burn_in_steps, S, np.int32),
             "learning": pad(block.learning_steps, S, np.int32),

@@ -45,7 +45,11 @@ from typing import Callable, Dict, List, Optional, Tuple, Type
 
 # Every site wired into the codebase, for chaos suites that want to sweep
 # "kill at every registered site". Adding a fault_point at a new boundary
-# should add its name here (tests cross-check the wiring).
+# should add its name here — the analysis plane enforces it statically:
+# the unknown-fault-site lint (r2d2_tpu/analysis/ast_rules.py) flags any
+# fault_point("...") literal missing from this tuple, so a typo'd or
+# unregistered site fails the tier-1 analysis gate instead of silently
+# dropping out of sweeps.
 KNOWN_SITES = (
     "trainer.update",
     "actor.step",

@@ -19,6 +19,16 @@ mkdir -p runs/procmaze16_warm2/ckpt
 if [ ! -d runs/procmaze16_warm2/ckpt/step_30000 ]; then
   cp -r runs/procmaze8_r5/ckpt/step_30000 runs/procmaze16_warm2/ckpt/step_30000
 fi
+# --resume restores the LATEST step in the dir: a stale step_33750+ from an
+# earlier aborted attempt would silently override the freshly copied 8x8
+# warm start. Assert the dir holds ONLY step_30000 before training.
+stale=$(ls runs/procmaze16_warm2/ckpt | grep -v '^step_30000$' || true)
+if [ -n "$stale" ]; then
+  echo "=== ABORT: stale checkpoints in procmaze16_warm2/ckpt: $stale ==="
+  echo "=== clear them (or the whole dir) so --resume starts from the 8x8 seed ==="
+  echo R5H2_CHAIN_ALL_DONE
+  exit 1
+fi
 run_with_retry python -m r2d2_tpu.train --preset procgen_impala --env procmaze_shaped:16 \
   --mode fused --steps 60000 --updates-per-dispatch 16 --resume \
   --set checkpoint_dir=runs/procmaze16_warm2/ckpt \

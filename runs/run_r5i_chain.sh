@@ -1,6 +1,8 @@
 #!/bin/bash
-# Round-5 chain I (queued behind chain H): the zero-state CONTROL at the
-# newly-solved blind-270 rung.
+# Round-5 chain I (launched CONCURRENTLY with chain H rung 1 — see the
+# co-scheduling note below; an earlier draft queued it behind chain H,
+# but the serial gate was removed at relaunch): the zero-state CONTROL
+# at the newly-solved blind-270 rung.
 #
 # Chain G solved memory_catch:10:12 (blind ~270) with ring x n-step 80
 # (runs/long_context_mid12_ring_n80: 1.0/0.97/0.97 sustained). The

@@ -1,0 +1,479 @@
+"""Analysis plane: per-rule positive/negative fixtures, suppression
+syntax, the repo-wide zero-findings gate, the jaxpr entry-point gate, and
+the CLI. The jaxpr traces are lru_cached inside jaxpr_rules, so this file
+and tests/test_precision.py share one trace per entry point per precision
+across the pytest process (tier-1 timing)."""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.analysis import ast_rules
+from r2d2_tpu.analysis.findings import Finding, render_json, render_text
+
+PKG_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "r2d2_tpu")
+
+
+def lint(src: str, path: str = "learner.py"):
+    """AST-lint a snippet as if it lived at `path` (hot-path by default so
+    the host-sync rule is armed)."""
+    findings, suppressed = ast_rules.analyze_source(textwrap.dedent(src), path)
+    return findings, suppressed
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------ findings model
+
+
+def test_finding_model_and_rendering():
+    a = Finding("r", "error", "b.py", 2, 0, "m2")
+    b = Finding("r", "warning", "a.py", 9, 3, "m1", hint="do x")
+    text = render_text([a, b])
+    # stable sort: path first, so a.py renders before b.py
+    assert text.index("a.py:9:3") < text.index("b.py:2:0")
+    assert "hint: do x" in text and "2 findings" in text
+    payload = json.loads(render_json([a, b]))
+    assert payload["count"] == 2
+    assert [f["path"] for f in payload["findings"]] == ["a.py", "b.py"]
+    assert render_text([]) == "no findings"
+    with pytest.raises(ValueError):
+        Finding("r", "fatal", "a.py", 1, 0, "m")
+
+
+# ------------------------------------------------------------- host-sync rule
+
+
+def test_host_sync_fires_in_hot_loop():
+    src = """
+    import numpy as np
+    def drain(xs):
+        out = []
+        for x in xs:
+            out.append(x.item())
+            out.append(np.asarray(x))
+            flag = bool(x)
+        return out
+    """
+    findings, _ = lint(src)
+    assert rules_of(findings) == ["host-sync-in-hot-path"]
+    assert len(findings) == 3
+
+
+def test_host_sync_quiet_outside_loops_and_cold_files():
+    hoisted = """
+    import numpy as np
+    def f(x):
+        return np.asarray(x)  # no loop: one deliberate transfer
+    """
+    findings, _ = lint(hoisted)
+    assert findings == []
+    # same looped code in a non-hot-path module does not gate
+    loop = """
+    def g(xs):
+        return [x.item() for x in xs] or [x.item() for x in xs]
+    """
+    in_loop = """
+    def g(xs):
+        out = []
+        for x in xs:
+            out.append(x.item())
+        return out
+    """
+    findings, _ = lint(in_loop, path="utils/summaries.py")
+    assert findings == []
+    del loop
+
+
+def test_host_sync_serve_dir_is_hot():
+    src = """
+    def g(xs):
+        out = []
+        for x in xs:
+            out.append(x.item())
+        return out
+    """
+    findings, _ = lint(src, path="r2d2_tpu/serve/loop.py")
+    assert rules_of(findings) == ["host-sync-in-hot-path"]
+
+
+# ---------------------------------------------------------------- jit-in-loop
+
+
+def test_jit_in_loop_fires():
+    src = """
+    import jax
+    def f(fns, x):
+        for fn in fns:
+            x = jax.jit(fn)(x)
+        return x
+    """
+    findings, _ = lint(src, path="utils/tools.py")
+    assert rules_of(findings) == ["jit-in-loop"]
+    assert findings[0].severity == "error"
+
+
+def test_jit_outside_loop_clean():
+    src = """
+    import jax
+    def f(fn, xs):
+        jfn = jax.jit(fn)
+        out = []
+        for x in xs:
+            out.append(jfn(x))
+        return out
+    """
+    findings, _ = lint(src, path="utils/tools.py")
+    assert findings == []
+
+
+# ---------------------------------------------------- unhashable static args
+
+
+def test_unhashable_static_arg_fires():
+    src = """
+    import functools, jax
+    @functools.partial(jax.jit, static_argnames=("opts",))
+    def f(x, opts=[]):
+        return x
+    """
+    findings, _ = lint(src, path="ops/thing.py")
+    assert rules_of(findings) == ["unhashable-static-arg"]
+
+
+def test_hashable_static_arg_clean():
+    src = """
+    import functools, jax
+    @functools.partial(jax.jit, static_argnames=("interpret",))
+    def f(x, interpret=False):
+        return x
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def g(x, shape=(2, 2)):
+        return x
+    """
+    findings, _ = lint(src, path="ops/thing.py")
+    assert findings == []
+
+
+# ------------------------------------------------------------- shape branches
+
+
+def test_shape_branch_in_jit_fires():
+    src = """
+    import jax
+    @jax.jit
+    def f(x):
+        if x.shape[0] > 2:
+            x = x * 2
+        return x
+    """
+    findings, _ = lint(src, path="ops/thing.py")
+    assert rules_of(findings) == ["shape-branch-in-jit"]
+
+
+def test_shape_guard_raise_is_exempt():
+    src = """
+    import jax
+    @jax.jit
+    def f(x):
+        if x.shape[0] != 4:
+            raise ValueError("bad shape")
+        return x * 2
+    """
+    findings, _ = lint(src, path="ops/thing.py")
+    assert findings == []
+
+
+# ------------------------------------------------------------------- float64
+
+
+def test_float64_device_ops_fire():
+    src = """
+    import jax, jax.numpy as jnp
+    jax.config.update("jax_enable_x64", True)
+    def f(x):
+        y = jnp.asarray(x, jnp.float64)
+        return jnp.zeros(3, dtype="float64") + y
+    """
+    findings, _ = lint(src, path="ops/thing.py")
+    assert rules_of(findings) == ["float64-op"]
+    assert len(findings) == 3  # x64 flag + jnp.float64 attr + dtype kwarg
+
+
+def test_host_numpy_float64_is_fine():
+    src = """
+    import numpy as np
+    def prefix(tree):
+        # sum-tree/accumulator math is host-side and MAY be f64
+        return np.cumsum(np.asarray(tree, np.float64))
+    """
+    findings, _ = lint(src, path="replay/sum_tree.py")
+    assert findings == []
+
+
+# --------------------------------------------------------------- fault sites
+
+
+def test_unknown_fault_site_fires_known_clean():
+    src = """
+    from r2d2_tpu.utils.faults import fault_point
+    def f():
+        fault_point("trainer.update")
+        fault_point("trainer.updaet")
+    """
+    findings, _ = lint(src, path="train.py")
+    assert rules_of(findings) == ["unknown-fault-site"]
+    assert "trainer.updaet" in findings[0].message
+
+
+def test_dynamic_fault_site_fires():
+    src = """
+    from r2d2_tpu.utils.faults import fault_point
+    def f(site):
+        fault_point(site)
+    """
+    findings, _ = lint(src, path="train.py")
+    assert rules_of(findings) == ["dynamic-fault-site"]
+
+
+# ------------------------------------------------------------ lock discipline
+
+
+def test_lock_discipline_fires_on_bare_write():
+    src = """
+    import threading
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+        def add(self, n):
+            with self._lock:
+                self.count += n
+        def reset(self):
+            self.count = 0
+    """
+    findings, _ = lint(src, path="replay/thing.py")
+    assert rules_of(findings) == ["lock-discipline"]
+    assert findings[0].line == 11
+
+
+def test_lock_discipline_clean_when_guarded_everywhere():
+    src = """
+    import threading
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0  # __init__ is pre-publication: bare is fine
+        def add(self, n):
+            with self._lock:
+                self.count += n
+        def reset(self):
+            with self._lock:
+                self.count = 0
+    """
+    findings, _ = lint(src, path="replay/thing.py")
+    assert findings == []
+
+
+# ---------------------------------------------------------------- suppression
+
+
+def test_suppression_same_line_and_line_above():
+    src = """
+    def f(xs):
+        out = []
+        for x in xs:
+            out.append(x.item())  # r2d2: disable=host-sync-in-hot-path
+            # r2d2: disable=host-sync-in-hot-path
+            out.append(x.item())
+            out.append(x.item())
+        return out
+    """
+    findings, suppressed = lint(src)
+    assert len(findings) == 1  # only the third, uncommented call gates
+    assert len(suppressed) == 2
+    assert all(f.rule == "host-sync-in-hot-path" for f in suppressed)
+
+
+def test_suppression_disable_all_and_wrong_rule():
+    src = """
+    def f(xs):
+        out = []
+        for x in xs:
+            out.append(x.item())  # r2d2: disable=all
+            out.append(x.item())  # r2d2: disable=float64-op
+        return out
+    """
+    findings, suppressed = lint(src)
+    assert len(findings) == 1  # a disable for a DIFFERENT rule doesn't hide
+    assert len(suppressed) == 1
+
+
+# ------------------------------------------------------------ repo-wide gates
+
+
+def test_repo_wide_zero_findings():
+    """The shipped tree is lint-clean: every deliberate exception carries
+    its suppression comment in place. This is the tier-1 analysis gate."""
+    findings, suppressed = ast_rules.analyze_paths([PKG_DIR])
+    assert findings == [], render_text(findings)
+    # suppressions exist and each one actually masks a real finding
+    assert suppressed, "expected deliberate, documented suppressions in-tree"
+
+
+def test_jaxpr_entry_point_gate():
+    """Every canonical entry point at both precisions passes every jaxpr
+    checker — dtype policy, fp32 islands, donation, store-field dtypes."""
+    from r2d2_tpu.analysis import jaxpr_rules
+
+    findings = jaxpr_rules.scan_entry_points()
+    assert findings == [], render_text(findings)
+
+
+# --------------------------------------------------- jaxpr checker negatives
+
+
+def test_jaxpr_text_checkers_fire_on_synthetic_programs():
+    from r2d2_tpu.analysis import jaxpr_rules as j
+
+    assert rules_of(j.check_no_float64("a:f64[3] = add b c", "t")) == ["jaxpr-float64"]
+    assert j.check_no_float64("a:f32[3] = add b c", "t") == []
+    assert rules_of(j.check_no_bf16("a:bf16[3] = mul b c", "t")) == ["jaxpr-bf16-in-fp32"]
+    assert j.check_no_bf16("a:f32[3] = mul b c", "t") == []
+    # healthy bf16 program: both dtypes present
+    assert j.check_fp32_island("a:bf16[3] b:f32[]", "t") == []
+    assert rules_of(j.check_fp32_island("a:f32[3]", "t")) == ["jaxpr-no-bf16-under-bf16"]
+    assert rules_of(j.check_fp32_island("a:bf16[3]", "t")) == ["jaxpr-missing-fp32-island"]
+
+
+def test_donation_checker_fires_on_mismatch():
+    import jax
+
+    from r2d2_tpu.analysis import jaxpr_rules as j
+
+    sds = jax.ShapeDtypeStruct
+    ok = j.compare_donated_leaves(
+        {"w": sds((4, 4), np.float32)}, {"w": sds((4, 4), np.float32)}, "t"
+    )
+    assert ok == []
+    bad = j.compare_donated_leaves(
+        {"w": sds((4, 4), np.float32)}, {"w": sds((4, 4), np.float16)}, "t"
+    )
+    assert rules_of(bad) == ["jaxpr-donation-mismatch"]
+
+
+def test_store_field_checker_fires_on_pr4_bug_class():
+    """The exact PR-4 shape: a float32 hidden slab padded for a bf16
+    store. The shared checker must catch it."""
+    from r2d2_tpu.analysis import jaxpr_rules as j
+
+    specs = {"hidden": ((2, 2, 8), np.dtype("bfloat16"))}
+    good = {"hidden": np.zeros((2, 2, 8), np.dtype("bfloat16"))}
+    bad = {"hidden": np.zeros((2, 2, 8), np.float32)}
+    assert j.compare_store_fields(good, specs, "t") == []
+    assert rules_of(j.compare_store_fields(bad, specs, "t")) == [
+        "jaxpr-store-field-mismatch"
+    ]
+
+
+def test_trace_budget_checker():
+    from r2d2_tpu.analysis.jaxpr_rules import check_trace_budget
+
+    assert check_trace_budget(2, (2, 4)) == []
+    assert rules_of(check_trace_budget(3, (2, 4))) == ["jaxpr-trace-budget"]
+
+
+# ------------------------------------------------------------------------ CLI
+
+
+def _write(tmp_path, name, src):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return str(p)
+
+
+def test_cli_text_and_exit_codes(tmp_path, capsys):
+    from r2d2_tpu.analysis.cli import main
+
+    dirty = _write(
+        tmp_path, "learner.py",
+        """
+        def f(xs):
+            out = []
+            for x in xs:
+                out.append(x.item())
+            return out
+        """,
+    )
+    assert main([dirty]) == 1
+    out = capsys.readouterr().out
+    assert "host-sync-in-hot-path" in out and "1 finding" in out
+
+    clean = _write(tmp_path, "clean.py", "x = 1\n")
+    assert main([clean]) == 0
+    assert "no findings" in capsys.readouterr().out
+
+
+def test_cli_json_stable_sorted(tmp_path, capsys):
+    from r2d2_tpu.analysis.cli import main
+
+    _write(
+        tmp_path, "serve/b.py",
+        """
+        def f(xs):
+            for x in xs:
+                y = x.item()
+        """,
+    )
+    _write(
+        tmp_path, "serve/a.py",
+        """
+        def f(xs):
+            for x in xs:
+                y = x.item()
+                z = x.item()
+        """,
+    )
+    assert main(["--format", "json", str(tmp_path)]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["count"] == 3
+    keys = [
+        (f["path"], f["line"], f["col"], f["rule"]) for f in payload["findings"]
+    ]
+    assert keys == sorted(keys)  # stable-sorted for diffing
+    assert keys[0][0].endswith("a.py")
+
+
+def test_cli_changed_only(tmp_path, capsys, monkeypatch):
+    from r2d2_tpu.analysis import cli
+
+    dirty = _write(
+        tmp_path, "learner.py",
+        """
+        def f(xs):
+            for x in xs:
+                y = x.item()
+        """,
+    )
+    monkeypatch.setattr(cli, "_changed_files", lambda root: [dirty])
+    assert cli.main(["--changed-only"]) == 1
+    assert "host-sync-in-hot-path" in capsys.readouterr().out
+    monkeypatch.setattr(cli, "_changed_files", lambda root: [])
+    assert cli.main(["--changed-only"]) == 0
+
+
+def test_cli_syntax_error_reported(tmp_path, capsys):
+    from r2d2_tpu.analysis.cli import main
+
+    bad = _write(tmp_path, "broken.py", "def f(:\n")
+    assert main([bad]) == 1
+    assert "syntax-error" in capsys.readouterr().out

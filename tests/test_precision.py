@@ -117,24 +117,24 @@ def test_train_step_parity_bf16_vs_fp32():
 def test_fp32_train_step_has_no_bf16_casts():
     """The golden-path guarantee by construction: under precision=fp32 the
     train-step program contains no bfloat16 values at all, so the fp32
-    islands added for the bf16 plane are exact no-ops on existing runs."""
-    cfg = tiny_test()
-    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
-    step = make_train_step(cfg, net, donate=False)
-    jaxpr = str(jax.make_jaxpr(step)(state, random_batch(cfg)))
-    assert "bf16" not in jaxpr
+    islands added for the bf16 plane are exact no-ops on existing runs.
+    Backed by the shared analysis-plane scanner (the same trace the
+    tier-1 analysis gate and the CLI's --jaxpr mode check)."""
+    from r2d2_tpu.analysis import jaxpr_rules
+
+    assert jaxpr_rules.scan_train_step("fp32") == []
 
 
 def test_no_float64_in_train_step():
     """Tier-1 dtype-promotion guard: no op in either precision's train
     step promotes to float64 (a silent 2x memory + TPU-unsupported trap),
-    and the x64 flag stays off."""
+    and the x64 flag stays off. The float64 walk lives in the shared
+    scanner; bf16 additionally asserts the fp32 islands survive."""
+    from r2d2_tpu.analysis import jaxpr_rules
+
     assert not jax.config.jax_enable_x64
-    for cfg in (tiny_test(), bf16_cfg()):
-        net, state = init_train_state(cfg, jax.random.PRNGKey(0))
-        step = make_train_step(cfg, net, donate=False)
-        jaxpr = str(jax.make_jaxpr(step)(state, random_batch(cfg)))
-        assert "f64[" not in jaxpr
+    for precision in ("fp32", "bf16"):
+        assert jaxpr_rules.scan_train_step(precision) == []
 
 
 # ------------------------------------------------- carry storage + snapshot

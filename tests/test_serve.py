@@ -169,8 +169,18 @@ def test_state_cache_lru():
 
 def test_compile_count_bounded_by_buckets(base_server):
     """The whole module's traffic — warmup, parity threads, evictions —
-    may trace the serve step at most once per bucket shape."""
-    assert base_server.trace_count <= len(base_server.batcher.buckets)
+    may trace the serve step at most once per bucket shape. The budget
+    check is the analysis plane's shared scanner (one rule for tests and
+    live metrics audits alike)."""
+    from r2d2_tpu.analysis.jaxpr_rules import check_trace_budget
+
+    assert check_trace_budget(
+        base_server.trace_count, base_server.batcher.buckets
+    ) == []
+    # the scanner itself must fire when the budget is blown
+    assert check_trace_budget(
+        len(base_server.batcher.buckets) + 1, base_server.batcher.buckets
+    ) != []
 
 
 # ------------------------------------------------------------ micro-batcher
