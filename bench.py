@@ -176,6 +176,65 @@ def recovery_main(precision: str = "fp32"):
         )
     )
 
+    # phase 3: elastic recovery — the same drill across a CHANGED device
+    # topology. A sharded dp=2 run is preempted, then resumed as a dp=1
+    # device-plane run with cfg.reshard_on_resume: the measured interval
+    # additionally pays the manifest check + slab regather + re-deal
+    # (replay/reshard.py), the full cost of coming back on whatever the
+    # scheduler hands out. Needs 2 devices; skipped (with a note) on 1.
+    if len(jax.devices()) < 2:
+        print(
+            "skipping resume_across_topology_s: needs >= 2 devices",
+            file=sys.stderr,
+        )
+        return
+    workdir2 = tempfile.mkdtemp(prefix="bench_reshard_")
+    cfg_sh = cfg.replace(
+        replay_plane="sharded",
+        dp_size=2,
+        checkpoint_dir=os.path.join(workdir2, "ckpt"),
+        metrics_path=os.path.join(workdir2, "metrics.jsonl"),
+    )
+    faults.install(faults.FaultPlane(schedule={"trainer.update": {6: "sigterm"}}))
+    try:
+        trainer = Trainer(cfg_sh)
+        trainer.run_inline(env_steps_per_update=4)
+        assert trainer.preempted, "injected SIGTERM did not preempt the run"
+        cut_step = trainer._step
+    finally:
+        faults.uninstall()
+    print(
+        f"preempted sharded dp=2 at step {cut_step}; "
+        "resuming on device dp=1...",
+        file=sys.stderr,
+    )
+    cfg_dev = cfg_sh.replace(
+        replay_plane="device", dp_size=1, reshard_on_resume=True
+    )
+    t0 = time.time()
+    resumed = Trainer(cfg_dev, resume=True)
+    m, step = resumed._one_update(resumed.plane.sample())
+    jax.block_until_ready(resumed.state.params)
+    reshard_s = time.time() - t0
+    resumed.finish_updates()
+    assert step == cut_step + 1
+    print(
+        json.dumps(
+            {
+                "metric": "resume_across_topology_s",
+                "value": round(reshard_s, 3),
+                "unit": "s",
+                "cut_step": cut_step,
+                "resumed_step": step,
+                "saved_topology": "sharded dp=2",
+                "resumed_topology": "device dp=1",
+                "loss": round(float(m["loss"]), 4),
+                "core": cfg.recurrent_core,
+                "precision": cfg.precision,
+            }
+        )
+    )
+
 
 def fused_system_main(collect_every: int = 6, core: str = "lstm",
                       lru_chunk: int = 0, precision: str = "bf16"):

@@ -39,6 +39,11 @@ Rule catalog (ids, severities — the table in ARCHITECTURE.md mirrors this):
   chaos sweeps and the R2D2_FAULTS operator surface.
 - dynamic-fault-site     (warning)  `fault_point(expr)` with a non-literal
   argument — statically uncheckable, and sweeps cannot enumerate it.
+- snapshot-missing-topology (error) a `save_replay(...)` call site in the
+  package without an explicit `topology=` manifest: the writer relies on
+  the callee's default, and a snapshot written without a manifest cannot
+  be resharded onto a changed device/host layout (replay/reshard.py) or
+  asserted by the runs/ chain guards.
 - lock-discipline        (warning)  a class that guards attribute writes
   with `with self.<lock>:` in one method but writes the same attributes
   bare in another (non-__init__) method — the trainer/serve/watcher
@@ -63,6 +68,7 @@ ALL_RULES = (
     "float64-op",
     "unknown-fault-site",
     "dynamic-fault-site",
+    "snapshot-missing-topology",
     "lock-discipline",
 )
 
@@ -463,6 +469,35 @@ def _rule_fault_sites(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+def _rule_snapshot_topology(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if d is None or d.split(".")[-1] != "save_replay":
+            continue
+        # kw.arg is None for a **kwargs splat: statically unverifiable,
+        # give it the benefit of the doubt rather than false-positive
+        if any(kw.arg == "topology" or kw.arg is None for kw in node.keywords):
+            continue
+        out.append(
+            Finding(
+                rule="snapshot-missing-topology",
+                severity="error",
+                path=path,
+                line=node.lineno,
+                col=node.col_offset,
+                message="save_replay call without an explicit topology= "
+                "manifest: a snapshot written without one cannot be "
+                "resharded onto a changed device/host layout "
+                "(replay/reshard.py) or asserted by the runs/ chain guards",
+                hint="pass topology=snapshot_topology(replay, tp=cfg.tp_size)",
+            )
+        )
+    return out
+
+
 def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
     locks: Set[str] = set()
     for node in ast.walk(cls):
@@ -594,6 +629,7 @@ _RULES = (
     _rule_shape_branch_in_jit,
     _rule_float64,
     _rule_fault_sites,
+    _rule_snapshot_topology,
     _rule_lock_discipline,
 )
 

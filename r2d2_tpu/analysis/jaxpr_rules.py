@@ -111,6 +111,36 @@ def train_step_jaxpr(precision: str) -> str:
 
 
 @functools.lru_cache(maxsize=None)
+def resharded_train_step_jaxpr(precision: str, dp: int = 2) -> str:
+    """Jaxpr text of the sharded fused train step traced on a RESHARD-
+    target mesh shape (dp=2). Elastic resume (replay/reshard.py) compiles
+    the train step on whatever layout the scheduler hands back, not just
+    the dp the run started with — so the gate traces that layout too."""
+    import jax
+
+    from r2d2_tpu.learner import make_sharded_fused_train_step
+    from r2d2_tpu.parallel.mesh import make_mesh
+    from r2d2_tpu.replay.block import store_field_specs
+
+    cfg = _cfg(precision).replace(replay_plane="sharded", dp_size=dp)
+    net, state = _net_and_state(precision)
+    mesh = make_mesh(dp=dp, tp=1, devices=jax.devices()[:dp])
+    step = make_sharded_fused_train_step(cfg, net, mesh, donate=False)
+    sds = jax.ShapeDtypeStruct
+    stores = {
+        k: sds((cfg.num_blocks, *shape), dt)
+        for k, (shape, dt) in store_field_specs(cfg).items()
+    }
+    B = cfg.batch_size // dp
+    coords = (
+        sds((dp, B), np.int32),  # per-shard LOCAL block ids
+        sds((dp, B), np.int32),  # sequence-in-block
+        sds((dp, B), np.float32),  # IS weights
+    )
+    return str(jax.make_jaxpr(step)(state, stores, *coords))
+
+
+@functools.lru_cache(maxsize=None)
 def act_jaxpr(precision: str, num_envs: int = 4) -> str:
     """Jaxpr text of the batched act step (VectorizedActor._policy's
     body: one net.act over the env fleet)."""
@@ -423,6 +453,26 @@ def _check_train_outputs(precision: str) -> List[Finding]:
     return out
 
 
+def scan_resharded_train_step(precision: str, dp: int = 2) -> List[Finding]:
+    """The train step on a resharded mesh shape: a regression visible only
+    under the post-resume partitioning (a float64 creeping into the
+    re-split path, a bf16 leak under the dp=2 layout) fails statically
+    instead of at the first elastic resume on hardware. No-op when the
+    platform has fewer than dp devices."""
+    import jax
+
+    if len(jax.devices()) < dp:
+        return []
+    label = f"resharded_train_step[dp={dp},{precision}]"
+    text = resharded_train_step_jaxpr(precision, dp)
+    out = check_no_float64(text, label)
+    if precision == "fp32":
+        out += check_no_bf16(text, label)
+    else:
+        out += check_fp32_island(text, label)
+    return out
+
+
 def scan_act(precision: str) -> List[Finding]:
     label = f"act[{precision}]"
     text = act_jaxpr(precision)
@@ -499,6 +549,7 @@ def scan_entry_points(
     out: List[Finding] = []
     for p in precisions:
         out += scan_train_step(p)
+        out += scan_resharded_train_step(p)
         out += scan_act(p)
         out += scan_serve_step(p)
         out += scan_donation(p)

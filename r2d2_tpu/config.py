@@ -182,6 +182,13 @@ class R2D2Config:
     # crash between checkpoints then restarts from a recent replay
     # distribution instead of the run's start.
     snapshot_every: int = 0
+    # on --resume, a replay snapshot whose embedded topology manifest does
+    # not match the current (dp, tp, process_count) layout is regathered
+    # to logical block order and re-dealt across the new layout
+    # (replay/reshard.py) instead of aborting with TopologyMismatch. Same
+    # logical shard set => bit-exact resume; dp change => deterministic
+    # re-deal (bounded drift). CLI: --reshard.
+    reshard_on_resume: bool = False
     # tiered plane only: stage chunks synchronously on the consumer thread
     # instead of the prefetch pipeline. Removes the staging-thread RNG race
     # with priority write-backs, making the tiered sampling stream

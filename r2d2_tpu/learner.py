@@ -361,7 +361,7 @@ def make_sharded_fused_multi_train_step(
     make_multi_update_core — w carries raw priorities, normalized per
     update with a pmin over dp (the multihost K-dispatch path)."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from r2d2_tpu.parallel.jax_compat import shard_map
 
     multi = make_multi_update_core(
         cfg, net, num_steps, axis_name="dp", is_from_priorities=is_from_priorities
@@ -451,7 +451,7 @@ def make_sharded_gather_step(cfg: R2D2Config, mesh):
     every leaf's batch axis sharded over dp — ready for the plain-jit train
     step (XLA inserts the gradient psum)."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from r2d2_tpu.parallel.jax_compat import shard_map
 
     gather_batch = make_store_gather(cfg)
 
@@ -498,7 +498,7 @@ def make_sharded_fused_train_step(
     multihost_store.py) — each host only knows its local priorities, the
     collective finds the global min."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from r2d2_tpu.parallel.jax_compat import shard_map
 
     raw = _raw_train_step(cfg, net, axis_name="dp")
     gather_batch = make_store_gather(cfg)

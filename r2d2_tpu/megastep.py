@@ -428,7 +428,7 @@ def make_sharded_megastep(
     (make_multi_update_core) — the multihost runner's path, where hosts
     only know their local shards' priorities."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from r2d2_tpu.parallel.jax_compat import shard_map
 
     dp = mesh.shape["dp"]
     if num_envs % dp:

@@ -45,6 +45,27 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def slab_sharding(mesh: Mesh) -> NamedSharding:
+    """Replay-slab sharding: the block axis splits over dp, everything
+    else replicated — the spec every dp-sharded replay store uses
+    (sharded_store's flat stores, the reshard scatter's device_put)."""
+    return NamedSharding(mesh, P("dp"))
+
+
+def slab_partition_map(mesh: Mesh, num_blocks: int, axis: str = "dp"):
+    """The per-slab partition map that extends slab_sharding with explicit
+    block ownership: shard i on `axis` owns global block rows
+    [start, end). This is what snapshot topology manifests record and the
+    reshard-on-resume path (replay/reshard.py) re-splits against — the
+    NamedSharding alone says "split over dp", the map says exactly which
+    logical blocks each shard holds."""
+    n = int(mesh.shape[axis])
+    if num_blocks % n != 0:
+        raise ValueError(f"num_blocks {num_blocks} not divisible by {axis}={n}")
+    bps = num_blocks // n
+    return {i: (i * bps, (i + 1) * bps) for i in range(n)}
+
+
 def shard_batch(mesh: Mesh, batch_pytree):
     """device_put every leaf with its batch dim sharded over dp."""
     sh = batch_sharding(mesh)

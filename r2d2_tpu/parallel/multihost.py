@@ -85,6 +85,17 @@ def make_global_mesh(
     return make_mesh(dp=dp, tp=tp, devices=devices if devices is not None else jax.devices())
 
 
+def local_slab_ranges(mesh: Mesh, num_blocks: int, axis: str = "dp"):
+    """The rows of mesh.slab_partition_map owned by THIS process: global
+    block ranges [start, end) per local shard id. Snapshot topology
+    manifests embed these per host, so an elastic resume can place every
+    saved slab in logical order without knowing the saving layout."""
+    from r2d2_tpu.parallel.mesh import slab_partition_map
+
+    pmap = slab_partition_map(mesh, num_blocks, axis)
+    return {g: pmap[g] for g in local_axis_indices(mesh, axis)}
+
+
 def local_axis_indices(mesh: Mesh, axis: str = "dp") -> List[int]:
     """Indices along `axis` whose devices are addressable from THIS process.
 

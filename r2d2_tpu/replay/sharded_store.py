@@ -107,7 +107,7 @@ class ShardedDeviceReplay:
         # DeviceReplayBuffer._write_slab). vals must carry E % dp == 0
         # blocks (add_blocks_batch routes remainders through the
         # single-slot _write); starts: (dp,) LOCAL first slot per shard.
-        from jax import shard_map
+        from r2d2_tpu.parallel.jax_compat import shard_map
 
         def _slab_body(stores, starts, vals):
             # local views: stores (nb/dp, ...), starts (1,), vals (1, E/dp, ...)

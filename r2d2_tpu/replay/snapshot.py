@@ -61,6 +61,130 @@ _COUNTERS = (
 # or are lost atomically — one os.replace
 _EXTRA_PREFIX = "x_"
 
+# topology manifest keys ride under this prefix: every snapshot records
+# the (dp, tp, process_count) layout it was written under, the global
+# block ranges its slabs cover, and its RNG stream identity, so a resume
+# on a DIFFERENT layout can regather the slabs (replay/reshard.py)
+# instead of aborting
+_TOPO_PREFIX = "topo_"
+
+
+class TopologyMismatch(ValueError):
+    """A snapshot's recorded topology differs from the replay restoring it.
+
+    Carries structured `saved` and `current` dicts (plane, dp, tp,
+    process_count, local_ids, ...) so callers — the Trainer's resume path,
+    the reshard CLI — can decide programmatically; the message names the
+    escape hatch. Subclasses ValueError so pre-elasticity callers that
+    caught the bare layout error keep working."""
+
+    def __init__(self, saved: Dict, current: Dict, detail: str = ""):
+        self.saved = dict(saved)
+        self.current = dict(current)
+
+        def _fmt(t: Dict) -> str:
+            return (
+                f"plane={t.get('plane')} dp={t.get('dp')} tp={t.get('tp')} "
+                f"process_count={t.get('process_count')} "
+                f"local_ids={t.get('local_ids')}"
+            )
+
+        msg = f"snapshot topology [{_fmt(self.saved)}] != current [{_fmt(self.current)}]"
+        if detail:
+            msg += f" ({detail})"
+        msg += (
+            " — pass --reshard (cfg.reshard_on_resume) to regather the "
+            "replay slabs and re-split them across the new layout"
+        )
+        super().__init__(msg)
+
+
+def snapshot_topology(replay, tp: int = 1) -> Dict[str, np.ndarray]:
+    """The topology manifest a snapshot embeds: which layout wrote it.
+
+    Records the logical shard structure (dp, blocks per shard), the
+    process layout (process_count/index, the global shard ids THIS file
+    holds), the per-slab partition map rows this host owns (global block
+    ranges, mirroring parallel/mesh.slab_partition_map), and the
+    per-logical-shard RNG stream identity (the multihost draw stream is
+    keyed (seed, GLOBAL shard id, epoch) — layout-independent by design,
+    which is exactly what makes elastic resume deterministic per logical
+    shard). `tp` is the mesh's tensor-parallel degree; the replay object
+    alone cannot know it, so snapshot writers pass it explicitly (the
+    snapshot-missing-topology lint keeps them honest)."""
+    from r2d2_tpu.replay.multihost_store import MultiHostShardedReplay
+    from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
+
+    cfg = replay.cfg
+    nb = cfg.num_blocks
+    if isinstance(replay, MultiHostShardedReplay):
+        plane, dp = "multihost", replay.dp
+        local_ids = list(replay.local_ids)
+        bps = replay.blocks_per_shard
+        seed, epoch = replay._seed, replay._epoch
+    elif isinstance(replay, ShardedDeviceReplay):
+        plane, dp = "sharded", replay.dp
+        local_ids = list(range(replay.dp))
+        bps = replay.blocks_per_shard
+        seed = epoch = 0
+    elif isinstance(replay, DeviceReplayBuffer):
+        plane, dp, local_ids, bps, seed, epoch = "device", 1, [0], nb, 0, 0
+    elif isinstance(replay, ReplayBuffer):
+        plane, dp, local_ids, bps, seed, epoch = "host", 1, [0], nb, 0, 0
+    else:
+        raise TypeError(f"unknown replay type {type(replay).__name__}")
+    return {
+        "plane": np.asarray(plane),
+        "dp": np.asarray(dp, np.int64),
+        "tp": np.asarray(tp, np.int64),
+        "process_count": np.asarray(jax.process_count(), np.int64),
+        "process_index": np.asarray(jax.process_index(), np.int64),
+        "num_blocks": np.asarray(nb, np.int64),
+        "blocks_per_shard": np.asarray(bps, np.int64),
+        "seqs_per_block": np.asarray(cfg.seqs_per_block, np.int64),
+        "local_ids": np.asarray(local_ids, np.int64),
+        "slab_ranges": np.asarray(
+            [[g * bps, (g + 1) * bps] for g in local_ids], np.int64
+        ).reshape(len(local_ids), 2),
+        "rng_streams": np.asarray(local_ids, np.int64),
+        "rng_seed": np.asarray(seed, np.int64),
+        "rng_epoch": np.asarray(epoch, np.int64),
+    }
+
+
+def _plain(topo: Dict) -> Dict:
+    """A manifest as plain python scalars/lists (json-able, error-printable)."""
+    out = {}
+    for k, v in topo.items():
+        v = np.asarray(v)
+        if v.dtype.kind in ("U", "S"):
+            out[k] = str(v)
+        elif v.ndim == 0:
+            out[k] = int(v)
+        else:
+            out[k] = v.tolist()
+    return out
+
+
+def _topology_from(d) -> Optional[Dict]:
+    """Extract the plain-form manifest from an open npz (view); None for
+    pre-manifest snapshots."""
+    names = getattr(d, "files", None) or list(d)
+    if _TOPO_PREFIX + "plane" not in names:
+        return None
+    return _plain({
+        k[len(_TOPO_PREFIX):]: d[k]
+        for k in names
+        if k.startswith(_TOPO_PREFIX)
+    })
+
+
+def read_manifest(path: str) -> Optional[Dict]:
+    """The topology manifest embedded in a snapshot file, as plain python
+    values; None for pre-manifest snapshots."""
+    with np.load(path, allow_pickle=False) as npz:
+        return _topology_from(npz)
+
 
 def _plane_state(plane: ReplayControlPlane, prefix: str = "") -> Dict[str, np.ndarray]:
     d = {prefix + "tree_leaves": plane.tree.leaves()}
@@ -86,9 +210,13 @@ def _restore_plane(plane: ReplayControlPlane, d, prefix: str = "") -> None:
     plane.num_seq_store[:] = d[prefix + "num_seq_store"]
 
 
-def _check_kind(kind: str, want: str) -> None:
+def _check_kind(kind: str, want: str, replay, saved_topo: Optional[Dict]) -> None:
     if kind != want:
-        raise ValueError(f"snapshot kind {kind!r} != replay plane {want!r}")
+        raise TopologyMismatch(
+            saved_topo or {"plane": kind},
+            _plain(snapshot_topology(replay)),
+            f"snapshot kind {kind!r} != replay plane {want!r}",
+        )
 
 
 def _validated_stores(
@@ -157,13 +285,22 @@ def _atomic_savez(path: str, payload: Dict[str, np.ndarray]) -> None:
     os.replace(tmp, path)
 
 
-def save_replay(replay, path: str, extra: Optional[Dict[str, np.ndarray]] = None) -> None:
+def save_replay(
+    replay,
+    path: str,
+    extra: Optional[Dict[str, np.ndarray]] = None,
+    topology: Optional[Dict[str, np.ndarray]] = None,
+) -> None:
     """Snapshot any replay plane (host / device / sharded) to `path`.
 
     The payload (control state + a copy of every store) is captured under
     the buffer lock; the npz write happens after release. `extra` carries
     caller state (trainer RNG / actor / env / pending write-backs) in the
-    same file under a reserved prefix — restore_replay hands it back."""
+    same file under a reserved prefix — restore_replay hands it back.
+    `topology` is the snapshot_topology manifest; callers that know the
+    mesh pass snapshot_topology(replay, tp=...) explicitly (enforced by
+    the snapshot-missing-topology lint), None derives a tp=1 manifest —
+    either way EVERY snapshot embeds one."""
     from r2d2_tpu.replay.multihost_store import MultiHostShardedReplay
     from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
 
@@ -208,22 +345,29 @@ def save_replay(replay, path: str, extra: Optional[Dict[str, np.ndarray]] = None
         raise TypeError(f"unknown replay type {type(replay).__name__}")
     for k, v in (extra or {}).items():
         payload[_EXTRA_PREFIX + k] = np.asarray(v)
+    topo = topology if topology is not None else snapshot_topology(replay)
+    for k, v in topo.items():
+        payload[_TOPO_PREFIX + k] = np.asarray(v)
     _atomic_savez(path, payload)
 
 
 def restore_replay(replay, path: str) -> Dict[str, np.ndarray]:
     """Restore a snapshot into a freshly built replay of the SAME config.
 
-    Mismatches (different plane kind, capacity, obs shape, hidden dim, dp)
-    raise BEFORE any state is touched — a failed restore leaves the buffer
-    exactly as constructed. Returns the `extra` dict the snapshot was
-    saved with (empty for plain snapshots), fully materialized."""
+    Mismatches raise BEFORE any state is touched — a failed restore leaves
+    the buffer exactly as constructed. Layout mismatches (plane kind, dp,
+    process/shard ownership) raise TopologyMismatch, which the Trainer's
+    --reshard path catches to regather the slabs (replay/reshard.py);
+    content mismatches (capacity, obs shape, hidden dim) stay plain
+    ValueErrors. Returns the `extra` dict the snapshot was saved with
+    (empty for plain snapshots), fully materialized."""
     from r2d2_tpu.replay.multihost_store import MultiHostShardedReplay
     from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
 
     with np.load(path, allow_pickle=False) as npz:
         d = _Bf16NpzView(npz)
         kind = str(d["kind"])
+        saved_topo = _topology_from(d)
         # materialize extras before the NpzFile closes
         extras = {
             k[len(_EXTRA_PREFIX):]: np.asarray(d[k])
@@ -231,14 +375,15 @@ def restore_replay(replay, path: str) -> Dict[str, np.ndarray]:
             if k.startswith(_EXTRA_PREFIX)
         }
         if isinstance(replay, MultiHostShardedReplay):
-            _check_kind(kind, "multihost")
+            _check_kind(kind, "multihost", replay, saved_topo)
             with replay.lock:
                 saved_ids = [int(x) for x in d["local_ids"]]
                 if saved_ids != list(replay.local_ids):
-                    raise ValueError(
+                    raise TopologyMismatch(
+                        saved_topo or {"plane": kind, "local_ids": saved_ids},
+                        _plain(snapshot_topology(replay)),
                         f"snapshot owns global shards {saved_ids}, this "
-                        f"process owns {list(replay.local_ids)} — restore "
-                        "with the same process/mesh layout"
+                        f"process owns {list(replay.local_ids)}",
                     )
                 # validate EVERY shard before mutating anything (the
                 # validated arrays are reused below — one npz read each)
@@ -259,7 +404,20 @@ def restore_replay(replay, path: str) -> Dict[str, np.ndarray]:
                             for k, v in vals_by_shard[g].items()
                         }
         elif isinstance(replay, ShardedDeviceReplay):
-            _check_kind(kind, "sharded")
+            _check_kind(kind, "sharded", replay, saved_topo)
+            saved_dp = (
+                saved_topo["dp"] if saved_topo
+                else sum(
+                    1 for k in d.files
+                    if k.startswith("shard") and k.endswith("_block_ptr")
+                )
+            )
+            if saved_dp != replay.dp:
+                raise TopologyMismatch(
+                    saved_topo or {"plane": kind, "dp": saved_dp},
+                    _plain(snapshot_topology(replay)),
+                    f"snapshot holds {saved_dp} dp shards, replay has {replay.dp}",
+                )
             with replay.lock:
                 vals = _validated_stores(d, replay.stores)
                 for i in range(len(replay.shards)):  # leaf-count pre-check
@@ -274,7 +432,7 @@ def restore_replay(replay, path: str) -> Dict[str, np.ndarray]:
                     for k, v in vals.items()
                 }
         elif isinstance(replay, DeviceReplayBuffer):
-            _check_kind(kind, "device")
+            _check_kind(kind, "device", replay, saved_topo)
             with replay.lock:
                 vals = _validated_stores(d, replay.stores)
                 if len(d["tree_leaves"]) != replay.tree.capacity:
@@ -282,7 +440,7 @@ def restore_replay(replay, path: str) -> Dict[str, np.ndarray]:
                 _restore_plane(replay, d)
                 replay.stores = {k: jax.device_put(v) for k, v in vals.items()}
         elif isinstance(replay, ReplayBuffer):
-            _check_kind(kind, "host")
+            _check_kind(kind, "host", replay, saved_topo)
             with replay.lock:
                 current = {k: getattr(replay, k + "_store") for k in STORE_FIELDS}
                 vals = _validated_stores(d, current)

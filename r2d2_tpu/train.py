@@ -661,9 +661,6 @@ class Trainer:
         self.plane = _PLANES[cfg.replay_plane](self)
         self.replay = self.plane.replay
         if self._resumed and cfg.snapshot_replay:
-            from r2d2_tpu.replay.snapshot import restore_replay
-
-            snap = self._replay_snapshot_path()
             # restored env steps are part of the run total already counted
             # by env_steps_offset from the learner checkpoint; rebase so
             # the sum isn't double-counted. The offset is a GLOBAL total,
@@ -674,13 +671,12 @@ class Trainer:
             # failed restore is agreed across hosts — because a collective
             # guarded by per-host file checks deadlocks the others.
             restored, failed = 0, 0
-            if os.path.exists(snap):
-                try:
-                    self._resume_carry = restore_replay(self.replay, snap)
+            try:
+                if self._restore_replay_snapshot():
                     restored = self.replay.env_steps
-                except Exception as e:  # noqa: BLE001 — agreed below
-                    failed = 1
-                    restore_err = e
+            except Exception as e:  # noqa: BLE001 — agreed below
+                failed = 1
+                restore_err = e
             if jax.process_count() > 1:
                 from jax.experimental import multihost_utils
 
@@ -972,16 +968,63 @@ class Trainer:
             )
         return os.path.join(self.cfg.checkpoint_dir, "replay_snapshot.npz")
 
+    def _restore_replay_snapshot(self) -> bool:
+        """Resume-time replay restore, topology-aware. Tries the exact
+        same-layout restore of this process's own snapshot first; a
+        TopologyMismatch (or a missing per-process file while OTHER
+        snapshot files exist — a changed process layout renames them)
+        falls through to the reshard path when cfg.reshard_on_resume is
+        set, which regathers EVERY snapshot file the old run left and
+        re-splits the slabs across the current layout
+        (replay/reshard.py). Returns True if replay state was restored."""
+        from r2d2_tpu.replay.reshard import reshard_replay, snapshot_paths
+        from r2d2_tpu.replay.snapshot import TopologyMismatch, restore_replay
+
+        snap = self._replay_snapshot_path()
+        if os.path.exists(snap):
+            try:
+                self._resume_carry = restore_replay(self.replay, snap)
+                return True
+            except TopologyMismatch:
+                if not self.cfg.reshard_on_resume:
+                    raise
+        else:
+            others = snapshot_paths(self.cfg.checkpoint_dir)
+            if not others:
+                return False  # no snapshot at all: refill from scratch
+            if not self.cfg.reshard_on_resume:
+                from r2d2_tpu.replay.snapshot import (
+                    _plain, read_manifest, snapshot_topology,
+                )
+
+                raise TopologyMismatch(
+                    read_manifest(others[0]) or {},
+                    _plain(snapshot_topology(self.replay, tp=self.cfg.tp_size)),
+                    f"no snapshot named {os.path.basename(snap)} for this "
+                    f"process, but {len(others)} snapshot file(s) exist — "
+                    "a changed process layout",
+                )
+        self._resume_carry = reshard_replay(
+            self.replay, snapshot_paths(self.cfg.checkpoint_dir)
+        )
+        return True
+
     def save_replay_snapshot(self, extra: Optional[dict] = None) -> str:
         """Persist full replay contents (replay/snapshot.py); returns the
         path. Run modes call this on exit when cfg.snapshot_replay is set.
         `extra` rides in the same atomic write (preemption carry: RNG,
-        published params, deferred write-backs, actor/env streams)."""
-        from r2d2_tpu.replay.snapshot import save_replay
+        published params, deferred write-backs, actor/env streams). The
+        embedded topology manifest carries the mesh's tp (the replay
+        object alone cannot know it), keeping the snapshot portable
+        across layouts (replay/reshard.py)."""
+        from r2d2_tpu.replay.snapshot import save_replay, snapshot_topology
 
         os.makedirs(self.cfg.checkpoint_dir, exist_ok=True)
         path = self._replay_snapshot_path()
-        save_replay(self.replay, path, extra=extra)
+        save_replay(
+            self.replay, path, extra=extra,
+            topology=snapshot_topology(self.replay, tp=self.cfg.tp_size),
+        )
         return path
 
     def _snapshot_async(self) -> None:
@@ -1424,6 +1467,12 @@ def main(argv=None):
     p.add_argument("--tp", type=int, default=None,
                    help="tensor-parallel mesh size (overrides preset tp_size)")
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--reshard", action="store_true",
+                   help="on --resume, a replay snapshot saved under a "
+                        "different (dp, tp, process_count) topology is "
+                        "regathered and re-split across the current layout "
+                        "(replay/reshard.py) instead of aborting with "
+                        "TopologyMismatch")
     p.add_argument("--snapshot-replay", action="store_true",
                    help="save full replay contents at end of run and restore "
                         "them on --resume (replay/snapshot.py)")
@@ -1462,6 +1511,8 @@ def main(argv=None):
             overrides["replay_plane"] = "device"
     if args.snapshot_replay:
         overrides["snapshot_replay"] = True
+    if args.reshard:
+        overrides["reshard_on_resume"] = True
     if args.dp is not None:
         overrides["dp_size"] = args.dp
     if args.tp is not None:

@@ -32,6 +32,8 @@ Registered sites (KNOWN_SITES below):
 - checkpoint.restore  — orbax read (utils/checkpoint.py)
 - snapshot.write      — replay snapshot npz write (replay/snapshot.py)
 - serve.reload        — serve-plane checkpoint hot-reload (serve/server.py)
+- reshard.gather      — elastic-resume slab regather (replay/reshard.py)
+- reshard.scatter     — elastic-resume re-deal/scatter (replay/reshard.py)
 """
 
 from __future__ import annotations
@@ -59,6 +61,8 @@ KNOWN_SITES = (
     "checkpoint.restore",
     "snapshot.write",
     "serve.reload",
+    "reshard.gather",
+    "reshard.scatter",
 )
 
 

@@ -3,15 +3,37 @@
 # Historical chains (r3*/r4*/r5a-e) carry inlined copies from before this
 # file existed; they are provenance artifacts and are not rewritten.
 
+# Assert a checkpoint dir's replay-snapshot topology manifests before
+# trusting --resume (the replay-side twin of run_r5h2_chain.sh's
+# stale-ckpt guard): prints every manifest as json; fails on incoherent
+# shard coverage, pre-manifest snapshot files, or an expectation
+# mismatch. Usage: assert_snapshot_topology CKPT_DIR [DP [TP [NPROC]]]
+assert_snapshot_topology() {
+  local dir=$1 dp=$2 tp=$3 nproc=$4
+  local args=("$dir")
+  [ -n "$dp" ] && args+=(--expect-dp "$dp")
+  [ -n "$tp" ] && args+=(--expect-tp "$tp")
+  [ -n "$nproc" ] && args+=(--expect-process-count "$nproc")
+  python -m r2d2_tpu.replay.reshard "${args[@]}"
+}
+
 # Retry a training command on the watchdog's stall exit code (86 =
 # STALL_EXIT_CODE, r2d2_tpu/utils/supervision.py) by appending --resume,
-# up to 3 resumes.
+# up to 3 resumes. Set RETRY_CKPT_DIR (plus optional RETRY_EXPECT, e.g.
+# "1 1 1" for dp/tp/nproc) to assert the replay snapshots' topology
+# manifests before every resume attempt — a stale snapshot from an
+# earlier layout aborts the chain instead of being silently regathered.
 run_with_retry() {
   local tries=0
   "$@"
   local rc=$?
   while [ $rc -eq 86 ] && [ $tries -lt 3 ]; do
     tries=$((tries+1)); echo "=== stall 86; resume (try $tries) ==="
+    if [ -n "$RETRY_CKPT_DIR" ] && \
+       ! assert_snapshot_topology "$RETRY_CKPT_DIR" $RETRY_EXPECT; then
+      echo "=== ABORT resume: snapshot topology assert failed for $RETRY_CKPT_DIR ==="
+      return 2
+    fi
     "$@" --resume; rc=$?
   done
   return $rc

@@ -29,6 +29,18 @@ if [ -n "$stale" ]; then
   echo R5H2_CHAIN_ALL_DONE
   exit 1
 fi
+# The replay-side twin of the stale-ckpt guard: an aborted attempt under a
+# different device/host layout would leave replay snapshots whose slabs
+# --resume would regather wrong. Assert the manifests match this chain's
+# single-host dp=1 tp=1 layout (no snapshot at all is fine — --resume
+# refills replay from scratch).
+if ! assert_snapshot_topology runs/procmaze16_warm2/ckpt 1 1 1; then
+  echo "=== ABORT: replay snapshot topology mismatch in procmaze16_warm2/ckpt ==="
+  echo "=== resume there with --reshard, or clear the stale snapshots ==="
+  echo R5H2_CHAIN_ALL_DONE
+  exit 1
+fi
+RETRY_CKPT_DIR=runs/procmaze16_warm2/ckpt RETRY_EXPECT="1 1 1" \
 run_with_retry python -m r2d2_tpu.train --preset procgen_impala --env procmaze_shaped:16 \
   --mode fused --steps 60000 --updates-per-dispatch 16 --resume \
   --set checkpoint_dir=runs/procmaze16_warm2/ckpt \

@@ -88,6 +88,74 @@ def build_and_run(mesh):
     return losses, checksum
 
 
+def build_elastic(mesh, shared_dir, phase):
+    """Elastic-resume driver, both sides of a topology change.
+
+    phase="save": seed the replay, run 3 collective steps, drain the
+    deferred priorities, snapshot (per-process file + topology manifest +
+    the replicated train state as layout-free carry extras), then run 3
+    MORE steps and return their losses — the uninterrupted run's
+    continuation, the reference a resumed run must reproduce.
+
+    phase="resume": fresh replay on THIS mesh (any process layout),
+    reshard_replay over whatever snapshot files the old layout left,
+    rebuild the train state from the carry extras, run 3 steps. Because
+    the logical shard set (dp=4) is unchanged and draw streams are keyed
+    by (seed, GLOBAL shard id, epoch), the losses must be bit-identical
+    to the save phase's continuation — across 2proc->1proc, 1proc->2proc,
+    or any other regrouping of the same shards."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.learner import init_train_state, make_sharded_fused_train_step
+    from r2d2_tpu.parallel.mesh import replicated_sharding
+    from r2d2_tpu.replay.multihost_store import MultiHostShardedReplay
+    from r2d2_tpu.replay.reshard import reshard_replay, snapshot_paths
+    from r2d2_tpu.replay.snapshot import save_replay
+
+    cfg = tiny_test().replace(batch_size=8)
+    replay = MultiHostShardedReplay(cfg, mesh, seed=5)
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    treedef = jax.tree.structure(state)
+    state = jax.device_put(state, replicated_sharding(mesh))
+    step_fn = make_sharded_fused_train_step(
+        cfg, net, mesh, donate=False, is_from_priorities=True
+    )
+
+    if phase == "save":
+        _seed_replay(replay, cfg)
+        for _ in range(3):
+            state, _ = replay.run_step(step_fn, state)
+        replay.drain_pending()  # snapshot post-drain: no pending write-backs lost
+        extra = {
+            f"st_{j}": np.asarray(v) for j, v in enumerate(jax.tree.leaves(state))
+        }
+        path = os.path.join(
+            shared_dir, f"replay_snapshot_p{jax.process_index()}.npz"
+        )
+        save_replay(replay, path, extra=extra)
+    else:
+        extras = reshard_replay(replay, snapshot_paths(shared_dir))
+        n_leaves = sum(1 for k in extras if k.startswith("st_"))
+        state = jax.tree.unflatten(treedef, [extras[f"st_{j}"] for j in range(n_leaves)])
+        state = jax.device_put(state, replicated_sharding(mesh))
+
+    losses = []
+    for _ in range(3):
+        state, metrics = replay.run_step(step_fn, state)
+        losses.append(float(metrics["loss"]))
+    checksum = float(
+        sum(np.abs(np.asarray(x)).sum() for x in jax.tree.leaves(state.params))
+    )
+    checksum += _allgather_sum(
+        sum(replay.shards[g].tree.total for g in replay.local_ids)
+    )
+    return losses, checksum
+
+
 def fused_cfg():
     from r2d2_tpu.config import tiny_test
 
@@ -184,6 +252,12 @@ def main():
         losses, checksum, steps = build_and_run_fused(mesh)
         payload = {"pid": pid, "losses": losses, "checksum": checksum,
                    "env_steps": steps}
+    elif mode in ("elastic_save", "elastic_resume"):
+        shared_dir = sys.argv[5]
+        losses, checksum = build_elastic(
+            mesh, shared_dir, "save" if mode == "elastic_save" else "resume"
+        )
+        payload = {"pid": pid, "losses": losses, "checksum": checksum}
     else:
         losses, checksum = build_and_run(mesh)
         payload = {"pid": pid, "losses": losses, "checksum": checksum}

@@ -244,6 +244,26 @@ def test_dynamic_fault_site_fires():
     assert rules_of(findings) == ["dynamic-fault-site"]
 
 
+def test_snapshot_missing_topology_fires_and_clean():
+    src = """
+    from r2d2_tpu.replay.snapshot import save_replay
+    def f(replay, path):
+        save_replay(replay, path)
+    """
+    findings, _ = lint(src, path="train.py")
+    assert rules_of(findings) == ["snapshot-missing-topology"]
+    assert "reshard" in findings[0].message
+
+    clean = """
+    from r2d2_tpu.replay.snapshot import save_replay, snapshot_topology
+    def f(replay, path, kw):
+        save_replay(replay, path, topology=snapshot_topology(replay))
+        save_replay(replay, path, **kw)  # splat: statically unverifiable
+    """
+    findings, _ = lint(clean, path="train.py")
+    assert findings == []
+
+
 # ------------------------------------------------------------ lock discipline
 
 

@@ -289,6 +289,69 @@ def test_serve_watcher_backs_off_on_transient_reload_failure(tmp_path):
     assert "io_retries" in srv.stats()
 
 
+def test_mid_reshard_crash_second_resume_converges(tmp_path):
+    """Elastic-resume chaos: a sharded dp=2 run is preempted, then resumed
+    onto a CHANGED topology (device plane, dp=1) with reshard_on_resume.
+    Killing the first resume attempt mid-scatter must be recoverable —
+    the reshard phases are read-only on the snapshot files, so a second
+    resume converges to exactly the state an uninterrupted reshard-resume
+    reaches."""
+    import shutil
+
+    from r2d2_tpu.replay.snapshot import TopologyMismatch
+    from r2d2_tpu.utils.faults import InjectedFault
+
+    cfg1 = _cfg(
+        tmp_path, "elastic", "host",
+        replay_plane="sharded", dp_size=2, batch_size=8,
+    )
+    faults.install(FaultPlane(schedule={"trainer.update": {6: "sigterm"}}))
+    try:
+        t1 = Trainer(cfg1)
+        t1.run_inline(env_steps_per_update=4)
+    finally:
+        faults.uninstall()
+    assert t1.preempted
+    cut = t1._step
+    assert latest_checkpoint_step(cfg1.checkpoint_dir) == cut
+
+    def _resume_cfg(tag, **over):
+        dst = str(tmp_path / tag / "ckpt")
+        shutil.copytree(cfg1.checkpoint_dir, dst)
+        return cfg1.replace(
+            replay_plane="device", dp_size=1,
+            checkpoint_dir=dst,
+            metrics_path=str(tmp_path / tag / "metrics.jsonl"),
+            **over,
+        )
+
+    # without --reshard the layout change is a structured, fatal mismatch
+    with pytest.raises(TopologyMismatch, match="--reshard"):
+        Trainer(_resume_cfg("noflag"), resume=True)
+
+    # control: uninterrupted reshard-resume, trained to completion
+    control_cfg = _resume_cfg("control", reshard_on_resume=True)
+    control = Trainer(control_cfg, resume=True)
+    assert control._initial_step == cut
+    control.run_inline(env_steps_per_update=4)
+    assert control._step == STEPS
+    fp_control = _fingerprint(control, tmp_path, "control")
+
+    # faulted: the first resume attempt dies mid-reshard...
+    faulted_cfg = _resume_cfg("faulted", reshard_on_resume=True)
+    faults.install(FaultPlane(schedule={"reshard.scatter": {1: "error"}}))
+    try:
+        with pytest.raises(InjectedFault):
+            Trainer(faulted_cfg, resume=True)
+    finally:
+        faults.uninstall()
+    # ...and the second attempt lands the identical learner + replay state
+    retry = Trainer(faulted_cfg, resume=True)
+    assert retry._initial_step == cut
+    retry.run_inline(env_steps_per_update=4)
+    _assert_identical(fp_control, _fingerprint(retry, tmp_path, "retry"))
+
+
 def test_cli_preempt_exit_code_and_resume(tmp_path):
     """The full operator loop as subprocesses: R2D2_FAULTS delivers a real
     SIGTERM mid-run, the CLI exits with PREEMPT_EXIT_CODE (distinct from

@@ -77,7 +77,7 @@ def test_multihost_store_single_process():
     assert np.isfinite(checksum)
 
 
-def _run_two_process_children(mode: str, timeout: int = 600):
+def _run_two_process_children(mode: str, timeout: int = 600, extra_args=()):
     """Spawn 2 real jax.distributed CPU children running multihost_child
     in `mode` and harvest their CHILD_RESULT payloads. Children are
     killed on any failure path: a hung collective (the SPMD-deadlock
@@ -99,7 +99,8 @@ def _run_two_process_children(mode: str, timeout: int = 600):
     script = os.path.join(os.path.dirname(__file__), "multihost_child.py")
     procs = [
         subprocess.Popen(
-            [_sys.executable, script, str(pid), "2", str(port), mode],
+            [_sys.executable, script, str(pid), "2", str(port), mode,
+             *map(str, extra_args)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
         for pid in range(2)
@@ -108,6 +109,14 @@ def _run_two_process_children(mode: str, timeout: int = 600):
     try:
         for p in procs:
             out, err = p.communicate(timeout=timeout)
+            if p.returncode != 0 and (
+                "Multiprocess computations aren't implemented" in err
+            ):
+                pytest.skip(
+                    "this jax build's CPU backend cannot run cross-process "
+                    "collectives — real 2-process coverage needs a newer jax "
+                    "or a TPU platform"
+                )
             assert p.returncode == 0, f"child failed:\n{out}\n{err[-2000:]}"
             for line in out.splitlines():
                 if line.startswith("CHILD_RESULT "):
@@ -464,6 +473,59 @@ def test_two_process_fused_runner_matches_single_process():
         np.testing.assert_allclose(r["losses"], ref_losses, atol=1e-4)
         np.testing.assert_allclose(r["checksum"], ref_checksum, rtol=1e-5)
         assert r["env_steps"] == ref_steps
+
+
+def test_elastic_resume_same_layout_bit_identical(tmp_path):
+    """The elastic-resume acceptance bar, in-process: snapshot a multihost
+    run mid-training, resume via reshard_replay (fresh replay + carried
+    train state + restored draw epoch), and the resumed losses must be
+    BIT-identical to the uninterrupted run's continuation — the exact
+    path, same logical shard set."""
+    from multihost_child import build_elastic
+    from r2d2_tpu.parallel.multihost import make_global_mesh
+
+    mesh = make_global_mesh(dp=4, tp=1, devices=jax.devices()[:4])
+    ref_losses, ref_checksum = build_elastic(mesh, str(tmp_path), "save")
+    losses, checksum = build_elastic(mesh, str(tmp_path), "resume")
+    assert losses == ref_losses  # bit-identical, not just close
+    assert checksum == ref_checksum
+
+
+def test_elastic_resume_two_to_one_process(tmp_path):
+    """Elastic topology, shrink direction: a 2-process run snapshots
+    (per-process files + topology manifests), then a SINGLE process with
+    all 4 devices resumes via reshard_replay. Same logical shard set =>
+    the resumed losses and params must be bit-identical (to collective-
+    reduction tolerance) to the 2-process run's own continuation."""
+    from multihost_child import build_elastic
+    from r2d2_tpu.parallel.multihost import make_global_mesh
+
+    shared = str(tmp_path)
+    save_results = _run_two_process_children("elastic_save", extra_args=[shared])
+
+    mesh = make_global_mesh(dp=4, tp=1, devices=jax.devices()[:4])
+    losses, checksum = build_elastic(mesh, shared, "resume")
+    for r in save_results.values():
+        np.testing.assert_allclose(losses, r["losses"], atol=1e-4)
+        np.testing.assert_allclose(checksum, r["checksum"], rtol=1e-5)
+
+
+def test_elastic_resume_one_to_two_process(tmp_path):
+    """Elastic topology, grow direction: a single-process 4-device run
+    snapshots one file owning all 4 shards; 2 real jax.distributed
+    processes resume from it, each regathering only its local shards.
+    Continuation losses must match the uninterrupted single-process run."""
+    from multihost_child import build_elastic
+    from r2d2_tpu.parallel.multihost import make_global_mesh
+
+    shared = str(tmp_path)
+    mesh = make_global_mesh(dp=4, tp=1, devices=jax.devices()[:4])
+    ref_losses, ref_checksum = build_elastic(mesh, shared, "save")
+    assert all(np.isfinite(l) for l in ref_losses)
+
+    for r in _run_two_process_children("elastic_resume", extra_args=[shared]).values():
+        np.testing.assert_allclose(r["losses"], ref_losses, atol=1e-4)
+        np.testing.assert_allclose(r["checksum"], ref_checksum, rtol=1e-5)
 
 
 def test_trainer_multihost_fused_megastep(tmp_path):
