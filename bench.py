@@ -38,6 +38,10 @@ from r2d2_tpu.replay.block import Block
 from r2d2_tpu.replay.device_store import DeviceReplayBuffer
 
 BASELINE_FRAMES_PER_SEC = 58368.0  # BASELINE.md implied learner throughput
+# Round-5 learner headline (BENCH_r05.json): the last pre-kernel-pass
+# measurement — `vs_r05` turns the flat headline into a trajectory and is
+# the fused-sequence pass's own before/after denominator.
+R05_FRAMES_PER_SEC = 1_004_177.5
 
 
 def synth_block(cfg, rng: np.random.Generator) -> Block:
@@ -383,6 +387,7 @@ def main(
     batch: int = 0,
     emit: bool = True,
     precision: str = "bf16",
+    fused: bool = True,
 ):
     """frame_multiplier: env frames per env step — 4 for Atari (frameskip,
     reference test.py:28,36), 1 for envs without frameskip. baseline: the
@@ -392,14 +397,17 @@ def main(
     so cross-batch rows compare updates/s x batch, not the headline).
     precision selects the mixed-precision arm (_precision_overrides;
     ignored when an explicit cfg is passed — the row reports
-    cfg.precision either way). Returns the result row; emit=False
-    suppresses the JSON print so matrix drivers (learner_matrix_main)
-    keep exactly one line on stdout."""
+    cfg.precision either way). fused=False runs the per-step Pallas path
+    (config.fused_sequence off) — the fused_seq row's denominator arm.
+    Returns the result row; emit=False suppresses the JSON print so
+    matrix drivers (learner_matrix_main) keep exactly one line on
+    stdout."""
     cfg = cfg or default_atari().replace(
         buffer_capacity=100_000,  # 250 block slots ~= 0.77 GB HBM obs store
         **_precision_overrides(precision),
         **_core_overrides(core, lru_chunk),
     )
+    cfg = cfg.replace(fused_sequence=fused)
     if batch:
         cfg = cfg.replace(batch_size=batch)
     rng = np.random.default_rng(0)
@@ -534,6 +542,7 @@ def main(
         "vs_baseline": round(frames_per_sec / baseline, 3),
         "core": cfg.recurrent_core + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
         "precision": cfg.precision,
+        "fused_sequence": cfg.fused_sequence,
         "batch": cfg.batch_size,
         "updates_per_sec": round(updates_per_sec, 2),
     }
@@ -553,7 +562,14 @@ def learner_matrix_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0,
 
     The headline always carries `vs_fp32`: under bf16 a silent fp32
     reference runs at the winning batch so the speedup is measured at the
-    same shape; --precision both additionally attaches the fp32 row."""
+    same shape; --precision both additionally attaches the fp32 row.
+
+    Round 7 adds two trajectory columns: `vs_r05` (the headline against
+    the round-5 pre-kernel-pass value, so the BENCH series reads as a
+    trend instead of a flat number) and, for the LSTM core, a `fused_seq`
+    sub-row — the per-step Pallas path (fused_sequence=False) re-run at
+    the winning batch, so the fused sequence kernel's contribution is
+    measured at the same shape instead of inferred across rounds."""
     arm = "bf16" if precision == "both" else precision
     batches = (batch,) if batch else (64, 128)
     rows = [
@@ -579,7 +595,29 @@ def learner_matrix_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0,
         **best,
         "metric": "learner_env_frames_per_sec_per_chip",
         "vs_fp32": round(vs_fp32, 3),
+        "vs_r05": round(best["value"] / R05_FRAMES_PER_SEC, 3),
     }
+    if core == "lstm":
+        # fused_seq row: the per-step Pallas path at the winning shape.
+        # (The LRU core has no per-step/fused split — its unroll is one
+        # associative scan either way — so the row is LSTM-only.)
+        per_step = main(
+            core=core, lru_chunk=lru_chunk, batch=best["batch"],
+            emit=False, precision=arm, fused=False,
+        )
+        speedup = best["value"] / per_step["value"]
+        print(
+            f"[fused_seq] fused {best['value']:.0f} vs per-step "
+            f"{per_step['value']:.0f} env-frames/s = {speedup:.2f}x "
+            f"at batch {best['batch']}",
+            file=sys.stderr,
+        )
+        out["fused_seq"] = {
+            "batch": best["batch"],
+            "per_step_value": per_step["value"],
+            "per_step_updates_per_sec": per_step["updates_per_sec"],
+            "speedup_vs_per_step": round(speedup, 3),
+        }
     if not batch:
         out["matrix"] = [
             {
@@ -724,12 +762,14 @@ def tiered_main(
     )
 
 
-def _serve_load(cfg, sessions: int, seconds: float) -> dict:
+def _serve_load(cfg, sessions: int, seconds: float, label: str = "") -> dict:
     """One serving-plane load arm: `sessions` concurrent CatchHostEnv
     session threads drive the full-size network through r2d2_tpu.serve's
     LocalClient for `seconds`, with a checkpoint hot-reload fired
     mid-window to prove reloads don't dent the latency tail. Returns the
-    measured numbers; serve_main decides which arm is the headline."""
+    measured numbers; serve_main decides which arm is the headline.
+    `label` names the arm in stderr progress lines (the int8 arm runs at
+    cfg.precision bf16, so precision alone is ambiguous)."""
     import os
     import shutil
     import tempfile
@@ -744,6 +784,7 @@ def _serve_load(cfg, sessions: int, seconds: float) -> dict:
         cache_capacity=max(2 * sessions, 64),
         poll_interval_s=0.2,
     )
+    label = label or cfg.precision
     tmp = tempfile.mkdtemp(prefix="serve_bench_")
     ckpt_dir = os.path.join(tmp, "ckpt")
     try:
@@ -752,7 +793,7 @@ def _serve_load(cfg, sessions: int, seconds: float) -> dict:
         t0 = time.time()
         server.warmup()
         print(
-            f"[serve:{cfg.precision}] warmup (all buckets) in "
+            f"[serve:{label}] warmup (all buckets) in "
             f"{time.time() - t0:.1f}s",
             file=sys.stderr,
         )
@@ -804,7 +845,7 @@ def _serve_load(cfg, sessions: int, seconds: float) -> dict:
             float(np.percentile(all_lat, p) * 1e3) for p in (50, 95, 99)
         )
         print(
-            f"[serve:{cfg.precision}] {n} requests over {sessions} sessions "
+            f"[serve:{label}] {n} requests over {sessions} sessions "
             f"in {elapsed:.1f}s (reloads={stats['reloads']}, occupancy="
             f"{stats['mean_batch_occupancy']:.1f})",
             file=sys.stderr,
@@ -826,6 +867,41 @@ def _serve_load(cfg, sessions: int, seconds: float) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _int8_q_drift(cfg, steps: int = 8, batch: int = 8) -> float:
+    """The serve_int8 row's drift column: max |q_int8 - q_fp| / max |q_fp|
+    over a short recurrent act stream — both arms fed IDENTICAL inputs
+    (including the fp arm's greedy actions) so the only difference is the
+    int8 weight round-trip, compounding through the carry exactly as it
+    does in a served session. Deterministic; independent of load traffic."""
+    import jax.numpy as jnp
+
+    from r2d2_tpu.ops.quantize import dequantize_tree, quantize_tree
+
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    params = state.params
+    deq = dequantize_tree(quantize_tree(params)[0])
+    act = jax.jit(
+        lambda p, o, la, lr, c: net.apply(p, o, la, lr, c, method=net.act)
+    )
+    rng = np.random.default_rng(0)
+    H = cfg.hidden_dim
+    carry_fp = (jnp.zeros((batch, H), jnp.float32), jnp.zeros((batch, H), jnp.float32))
+    carry_q = (jnp.zeros((batch, H), jnp.float32), jnp.zeros((batch, H), jnp.float32))
+    la = jnp.zeros((batch,), jnp.int32)
+    drift = scale = 0.0
+    for _ in range(steps):
+        obs = jnp.asarray(
+            rng.integers(0, 255, (batch, *cfg.obs_shape), dtype=np.uint8)
+        )
+        lr = jnp.asarray(rng.normal(size=batch).astype(np.float32))
+        q_fp, carry_fp = act(params, obs, la, lr, carry_fp)
+        q_q, carry_q = act(deq, obs, la, lr, carry_q)
+        drift = max(drift, float(jnp.max(jnp.abs(q_q - q_fp))))
+        scale = max(scale, float(jnp.max(jnp.abs(q_fp))))
+        la = jnp.argmax(q_fp, axis=-1).astype(jnp.int32)
+    return drift / max(scale, 1e-9)
+
+
 def serve_main(
     core: str = "lstm",
     lru_chunk: int = 0,
@@ -841,12 +917,30 @@ def serve_main(
     reload count, and the carry-cache precision footprint.
 
     No baseline row exists yet for serving — vs_baseline is null until a
-    BENCH_*.json round records the first trajectory point."""
+    BENCH_*.json round records the first trajectory point.
+
+    --precision both runs a THIRD arm, serve_int8: the bf16 serve config
+    with serve_quantization="int8" (weight-only per-channel int8 on the
+    encoder/head kernels, ops/quantize.py). Its sub-row carries vs_fp32
+    on requests/s plus `q_drift_vs_fp32` — the bounded-parity drift
+    column, measured by a deterministic recurrent probe (_int8_q_drift)
+    rather than inferred from the load arms' divergent action streams."""
     head_arm = "bf16" if precision in ("bf16", "both") else "fp32"
+    if head_arm == "fp32":
+        arm_names = ["fp32"]
+    elif precision == "both":
+        arm_names = ["fp32", "bf16", "int8"]
+    else:
+        arm_names = ["fp32", "bf16"]
     arms = {}
-    for arm in (["fp32"] if head_arm == "fp32" else ["fp32", "bf16"]):
-        cfg = _system_cfg(core=core, lru_chunk=lru_chunk, precision=arm)
-        arms[arm] = _serve_load(cfg, sessions, seconds)
+    for arm in arm_names:
+        cfg = _system_cfg(
+            core=core, lru_chunk=lru_chunk,
+            precision="bf16" if arm == "int8" else arm,
+        )
+        if arm == "int8":
+            cfg = cfg.replace(serve_quantization="int8")
+        arms[arm] = _serve_load(cfg, sessions, seconds, label=arm)
     head = arms[head_arm]
     vs_fp32 = head["value"] / arms["fp32"]["value"]
     if head_arm != "fp32":
@@ -870,6 +964,21 @@ def serve_main(
     }
     if precision == "both":
         row["fp32"] = arms["fp32"]
+    if "int8" in arms:
+        drift = _int8_q_drift(
+            _system_cfg(core=core, lru_chunk=lru_chunk, precision="bf16")
+        )
+        print(
+            f"[serve_int8] {arms['int8']['value']:.0f} requests/s "
+            f"({arms['int8']['value'] / arms['fp32']['value']:.2f}x fp32), "
+            f"q drift {drift:.2e} of fp32 Q scale",
+            file=sys.stderr,
+        )
+        row["serve_int8"] = {
+            **arms["int8"],
+            "vs_fp32": round(arms["int8"]["value"] / arms["fp32"]["value"], 3),
+            "q_drift_vs_fp32": round(drift, 6),
+        }
     print(json.dumps(row))
 
 
@@ -919,6 +1028,147 @@ def long_context_main(core: str = "lstm", lru_chunk: int = 0,
     )
 
 
+def breakdown_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0,
+                   precision: str = "bf16"):
+    """Per-phase learner step breakdown: the denominator map for kernel
+    work. Times the train step's constituent programs as SEPARATELY
+    jitted pieces on one synthetic DeviceBatch —
+
+      unroll    forward unroll, online params (encoder + recurrent core +
+                both dueling head evaluations; the fused-sequence kernel
+                lives here)
+      head      the dueling head alone on (B, L, H) features
+      loss_grad value_and_grad over the full loss (learner.make_loss_fn):
+                both unrolls + TD/priority math + backward
+      optimizer the optax update + target-net sync at fixed gradients
+
+    — each wrapped in a utils/profiling span (jax.profiler annotation),
+    so an xprof capture of this process groups device activity by phase.
+    Fractions are each phase's time over the full jitted train step's.
+    They are a MAP, not a partition: the pieces re-run shared work
+    (loss_grad contains both unrolls) and XLA fuses the monolith
+    differently, so fractions need not sum to 1."""
+    import optax
+
+    from r2d2_tpu.learner import (
+        DeviceBatch,
+        make_batch_train_step,
+        make_loss_fn,
+        make_optimizer,
+    )
+    from r2d2_tpu.utils.profiling import span
+
+    arm = "bf16" if precision == "both" else precision
+    cfg = default_atari().replace(
+        **_precision_overrides(arm),
+        **_core_overrides(core, lru_chunk),
+    )
+    if batch:
+        cfg = cfg.replace(batch_size=batch)
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})", file=sys.stderr)
+
+    import jax.numpy as jnp
+
+    B = cfg.batch_size
+    Bn, L, F = cfg.burn_in_steps, cfg.learning_steps, cfg.forward_steps
+    T = Bn + L + F
+    rng = np.random.default_rng(0)
+    b = DeviceBatch(
+        obs=jnp.asarray(rng.integers(0, 255, (B, T, *cfg.obs_shape), dtype=np.uint8)),
+        last_action=jnp.asarray(rng.integers(0, cfg.action_dim, (B, T)), jnp.int32),
+        last_reward=jnp.asarray(rng.normal(size=(B, T)).astype(np.float32)),
+        hidden=jnp.asarray((rng.normal(size=(B, 2, cfg.hidden_dim)) * 0.1).astype(np.float32)),
+        action=jnp.asarray(rng.integers(0, cfg.action_dim, (B, L)), jnp.int32),
+        n_step_reward=jnp.asarray(rng.normal(size=(B, L)).astype(np.float32)),
+        gamma=jnp.full((B, L), cfg.gamma**F, jnp.float32),
+        burn_in_steps=jnp.full((B,), Bn, jnp.int32),
+        learning_steps=jnp.full((B,), L, jnp.int32),
+        forward_steps=jnp.full((B,), F, jnp.int32),
+        is_weights=jnp.ones((B,), jnp.float32),
+    )
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    denom = jnp.asarray(float(B * L), jnp.float32)
+    feats = jnp.asarray(
+        rng.normal(size=(B, L, cfg.hidden_dim)).astype(np.float32)
+    ).astype(jnp.dtype(cfg.resolved_compute_dtype))
+    grads = jax.tree.map(lambda x: jnp.full_like(x, 1e-3), state.params)
+    loss_fn = make_loss_fn(cfg, net)
+    optimizer = make_optimizer(cfg)
+
+    def opt_only(state, grads):
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        sync = ((state.step + 1) % cfg.target_net_update_interval) == 0
+        target = jax.tree.map(
+            lambda t, p: jnp.where(sync, p, t), state.target_params, params
+        )
+        return params, target, opt_state
+
+    full_step = make_batch_train_step(cfg, net, donate=False)
+    programs = {
+        "unroll": (
+            jax.jit(lambda s, b: net.apply(
+                s.params, b.obs, b.last_action, b.last_reward, b.hidden,
+                b.burn_in_steps, b.learning_steps, b.forward_steps,
+            )),
+            lambda: (state, b),
+        ),
+        "head": (
+            jax.jit(lambda s, h: net.apply(
+                s.params, h, method=lambda mdl, h: mdl._dueling(h)
+            )),
+            lambda: (state, feats),
+        ),
+        "loss_grad": (
+            jax.jit(lambda s, b, d: jax.value_and_grad(loss_fn, has_aux=True)(
+                s.params, s.target_params, b, d
+            )),
+            lambda: (state, b, denom),
+        ),
+        "optimizer": (jax.jit(opt_only), lambda: (state, grads)),
+        "train_step": (full_step, lambda: (state, b)),
+    }
+
+    def time_program(name, fn, args_fn, iters=20):
+        jax.block_until_ready(fn(*args_fn()))  # compile outside the window
+        with span(f"breakdown/{name}"):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(*args_fn())
+            jax.block_until_ready(out)
+            ms = (time.perf_counter() - t0) / iters * 1e3
+        print(f"[breakdown] {name}: {ms:.3f} ms", file=sys.stderr)
+        return ms
+
+    times = {
+        name: time_program(name, fn, args_fn)
+        for name, (fn, args_fn) in programs.items()
+    }
+    step_ms = times.pop("train_step")
+    print(
+        json.dumps(
+            {
+                "metric": "learner_step_breakdown",
+                "value": round(step_ms, 3),
+                "unit": "ms/update",
+                "batch": B,
+                "core": cfg.recurrent_core
+                + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
+                "precision": cfg.precision,
+                "fused_sequence": cfg.fused_sequence,
+                "phases": {
+                    name: {
+                        "ms": round(ms, 3),
+                        "frac_of_step": round(ms / step_ms, 3),
+                    }
+                    for name, ms in times.items()
+                },
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -935,7 +1185,8 @@ if __name__ == "__main__":
     p = argparse.ArgumentParser(description="r2d2_tpu benchmarks")
     p.add_argument(
         "--mode", default="learner",
-        choices=["learner", "system", "fused", "long_context", "serve", "recovery"],
+        choices=["learner", "system", "fused", "long_context", "serve",
+                 "recovery", "breakdown"],
         help="learner: fused-update throughput on synthetic replay (the "
              "driver's default metric). system: concurrent on-device "
              "collection + learning via threads. fused: the same full "
@@ -945,7 +1196,9 @@ if __name__ == "__main__":
              "latency percentiles under concurrent stateful sessions with "
              "a mid-window checkpoint hot-reload. recovery: preempt a run "
              "with an injected SIGTERM and measure resume-to-first-update "
-             "wall time (utils/faults.py).",
+             "wall time (utils/faults.py). breakdown: per-phase learner "
+             "step timing (unroll / head / loss+grad / optimizer as "
+             "separately jitted programs under jax.profiler spans).",
     )
     p.add_argument(
         "--collect-every", type=int, default=6,
@@ -1001,6 +1254,8 @@ if __name__ == "__main__":
     )
     if args.mode == "recovery":
         recovery_main(precision)
+    elif args.mode == "breakdown":
+        breakdown_main(args.core, args.lru_chunk, args.batch, precision)
     elif args.mode == "serve":
         serve_main(args.core, args.lru_chunk, args.sessions,
                    args.serve_seconds, precision)

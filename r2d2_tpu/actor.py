@@ -160,9 +160,13 @@ class VectorizedActor:
         self.rng = np.random.default_rng(seed)
         self.action_dim = cfg.action_dim
 
+        # fused act tail (ops/act_tail.py): core step + dueling + ε-greedy
+        # select run as ONE jitted program; the ε coin and random draws are
+        # inputs so the host numpy RNG stream (and host-vs-device action
+        # parity) is unchanged.
         self._policy = jax.jit(
-            lambda params, obs, la, lr, carry: net.apply(
-                params, obs, la, lr, carry, method=net.act
+            lambda params, obs, la, lr, carry, explore, rand_a: net.apply(
+                params, obs, la, lr, carry, explore, rand_a, method=net.act_select
             )
         )
         self.params, self.param_version = param_store.latest()
@@ -208,12 +212,19 @@ class VectorizedActor:
         cfg = self.cfg
         E = self.env.num_envs
 
-        q, carry = self._policy(
+        # ε-greedy over the ladder vector (reference worker.py:703-706):
+        # coins drawn on host in the pre-fusion stream order, selection
+        # fused into the policy program (net.act_select).
+        explore = self.rng.random(E) < self.epsilons
+        random_a = self.rng.integers(0, self.action_dim, size=E)
+        q, device_actions, carry = self._policy(
             self.params,
             jnp.asarray(self.obs),
             jnp.asarray(self.last_action),
             jnp.asarray(self.last_reward),
             self.carry,
+            jnp.asarray(explore),
+            jnp.asarray(random_a.astype(np.int32)),
         )
         q_np = np.asarray(q, np.float32)
 
@@ -237,15 +248,11 @@ class VectorizedActor:
         self._pending_cut[:] = False
         self._pending_truncate[:] = False
 
-        # ε-greedy over the ladder vector (reference worker.py:703-706).
         # Fresh slots take a NOOP: their Q row was computed from the dead
         # episode's obs, so this tick is absorbed as one extra no-op at
         # episode start (same family as the noop-start wrapper) and not
         # recorded; the accumulator is seeded with the post-step obs below.
-        greedy = q_np.argmax(axis=1)
-        explore = self.rng.random(E) < self.epsilons
-        random_a = self.rng.integers(0, self.action_dim, size=E)
-        actions = np.where(explore, random_a, greedy).astype(np.int32)
+        actions = np.asarray(device_actions, np.int32).copy()
         actions[fresh] = 0
         term_obs, rewards, dones, next_obs = self.env.step(actions)
 

@@ -166,22 +166,112 @@ def act_jaxpr(precision: str, num_envs: int = 4) -> str:
 
 
 @functools.lru_cache(maxsize=None)
-def _serve_server(precision: str):
-    from r2d2_tpu.serve.server import PolicyServer, ServeConfig
+def _pallas_net_and_state(precision: str):
+    """Net + state with the Pallas backend forced (the TPU learner path).
+
+    CPU tracing is fine: make_jaxpr only abstracts the pallas_call (the
+    init's one interpret-mode forward at tiny shapes is cheap)."""
+    import jax
+
+    from r2d2_tpu.learner import init_train_state
+
+    cfg = _cfg(precision).replace(lstm_backend="pallas")
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    return net, state
+
+
+@functools.lru_cache(maxsize=None)
+def fused_unroll_jaxpr(precision: str) -> str:
+    """Jaxpr text of the forward sequence unroll on the Pallas backend —
+    the fused-sequence kernel's canonical entry (ops/pallas_lstm.py
+    lstm_seq_unroll via models/lstm.py)."""
+    import jax
 
     cfg = _cfg(precision)
+    net, state = _pallas_net_and_state(precision)
+    B, T = cfg.batch_size, cfg.seq_len
+    sds = jax.ShapeDtypeStruct
+
+    def unroll(params, obs, la, lr, hid, bi, ls, fs):
+        return net.apply(params, obs, la, lr, hid, bi, ls, fs)
+
+    return str(
+        jax.make_jaxpr(unroll)(
+            state.params,
+            sds((B, T, *cfg.obs_shape), np.uint8),
+            sds((B, T), np.int32),
+            sds((B, T), np.float32),
+            sds((B, 2, cfg.hidden_dim), cfg.state_dtype),
+            sds((B,), np.int32),
+            sds((B,), np.int32),
+            sds((B,), np.int32),
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def fused_train_step_jaxpr(precision: str) -> str:
+    """Jaxpr text of the stacked train step on the Pallas backend: the
+    program the TPU learner actually runs, traced so the kernel-launch
+    budget (2 forward + 1 backward sequence kernels per update) is gated
+    statically."""
+    import jax
+
+    from r2d2_tpu.learner import make_stacked_batch_train_step
+
+    cfg = _cfg(precision).replace(lstm_backend="pallas")
+    net, state = _pallas_net_and_state(precision)
+    step = make_stacked_batch_train_step(cfg, net, _NUM_STEPS, donate=False)
+    return str(jax.make_jaxpr(step)(state, _stacked_batch_struct(precision, _NUM_STEPS)))
+
+
+@functools.lru_cache(maxsize=None)
+def act_select_jaxpr(precision: str, num_envs: int = 4) -> str:
+    """Jaxpr text of the fused act tail (net.act_select: core step +
+    dueling combine + ε-greedy select as one program — the body shared by
+    actor.py, collect.py, and the serve step)."""
+    import jax
+
+    cfg = _cfg(precision)
+    net, state = _net_and_state(precision)
+    sds = jax.ShapeDtypeStruct
+    E, H = num_envs, cfg.hidden_dim
+
+    def policy(params, obs, la, lr, carry, explore, rand_a):
+        return net.apply(
+            params, obs, la, lr, carry, explore, rand_a, method=net.act_select
+        )
+
+    return str(
+        jax.make_jaxpr(policy)(
+            state.params,
+            sds((E, *cfg.obs_shape), np.uint8),
+            sds((E,), np.int32),
+            sds((E,), np.float32),
+            (sds((E, H), np.float32), sds((E, H), np.float32)),
+            sds((E,), bool),
+            sds((E,), np.int32),
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _serve_server(precision: str, quantization: str = "none"):
+    from r2d2_tpu.serve.server import PolicyServer, ServeConfig
+
+    cfg = _cfg(precision).replace(serve_quantization=quantization)
     # smallest legal serve plane: one bucket, cache == bucket; never started
     return PolicyServer(cfg, ServeConfig(buckets=(2,), cache_capacity=2))
 
 
 @functools.lru_cache(maxsize=None)
-def serve_step_jaxpr(precision: str) -> str:
+def serve_step_jaxpr(precision: str, quantization: str = "none") -> str:
     """Jaxpr text of the serve step (PolicyServer._build_step's jitted
     body) at the smallest bucket."""
     import jax
 
     cfg = _cfg(precision)
-    server = _serve_server(precision)
+    server = _serve_server(precision, quantization)
     bucket = server.batcher.buckets[0]
     h, c, la, lr = server.cache.arrays()
     sds = jax.ShapeDtypeStruct
@@ -252,6 +342,49 @@ def check_fp32_island(jaxpr_text: str, label: str) -> List[Finding]:
                 "(Q-target/value-rescale/TD/loss math) have been narrowed",
                 hint="learner.loss_fn must cast target/TD math to float32 "
                 "regardless of compute dtype",
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------- kernel-launch checker
+
+
+def check_kernel_launch_count(jaxpr_text: str, label: str, expected: int,
+                              what: str) -> List[Finding]:
+    """The fused-sequence contract: the whole T-step unroll is ONE
+    pallas_call (and a train step is exactly 2 forward + 1 backward
+    launches). A count above `expected` means the sequence got split back
+    into per-step or per-segment launches; 0 means the Pallas backend
+    silently fell off the traced path."""
+    n = jaxpr_text.count("pallas_call")
+    if n != expected:
+        return [
+            _finding(
+                "jaxpr-kernel-launch-count", label,
+                f"{what}: expected exactly {expected} pallas_call "
+                f"launch(es) in the traced program, found {n}",
+                hint="the sequence kernel must stay fused — one launch per "
+                "unroll (ops/pallas_lstm.py), never per timestep/segment",
+            )
+        ]
+    return []
+
+
+def check_int8_weights(jaxpr_text: str, label: str) -> List[Finding]:
+    """The int8 serve arm must actually carry int8 weight arrays into the
+    step (else the quantization knob is dead) and must dequantize to the
+    compute dtype, never widening to f64."""
+    out: List[Finding] = []
+    if "i8[" not in jaxpr_text:
+        out.append(
+            _finding(
+                "jaxpr-no-int8-under-int8", label,
+                "serve_quantization='int8' traced a step with no int8 "
+                "arrays: the quantized publish path is not reaching the "
+                "jitted step",
+                hint="PolicyServer._prepare_params must run at every "
+                "publish point (init and reload_now)",
             )
         )
     return out
@@ -488,6 +621,87 @@ def scan_act(precision: str) -> List[Finding]:
     return out
 
 
+def scan_fused_unroll(precision: str) -> List[Finding]:
+    """The fused-sequence kernel entry: dtype contracts plus the one-
+    launch-per-unroll budget (and 3 per train step — 2 forwards for
+    online/target nets, 1 backward walking the seam-masked reverse
+    grid)."""
+    label = f"fused_unroll[{precision}]"
+    text = fused_unroll_jaxpr(precision)
+    out = check_no_float64(text, label)
+    out += check_kernel_launch_count(
+        text, label, 1, "forward sequence unroll"
+    )
+    ts_label = f"fused_train_step[{precision}]"
+    ts_text = fused_train_step_jaxpr(precision)
+    out += check_no_float64(ts_text, ts_label)
+    if precision == "fp32":
+        out += check_no_bf16(ts_text, ts_label)
+    else:
+        out += check_fp32_island(ts_text, ts_label)
+    out += check_kernel_launch_count(
+        ts_text, ts_label, 3,
+        "train step (online fwd + target fwd + backward sequence kernels)",
+    )
+    return out
+
+
+def scan_act_select(precision: str) -> List[Finding]:
+    """The fused act tail (dueling + ε-mask + argmax with the core
+    step)."""
+    import jax
+
+    label = f"act_select[{precision}]"
+    text = act_select_jaxpr(precision)
+    out = check_no_float64(text, label)
+    if precision == "fp32":
+        out += check_no_bf16(text, label)
+    else:
+        out += [
+            f for f in check_fp32_island(text, label)
+            if f.rule == "jaxpr-no-bf16-under-bf16"
+        ]
+    # the selected actions must leave as int32 (host/device parity: every
+    # caller stores them into int32 slabs)
+    cfg = _cfg(precision)
+    net, state = _net_and_state(precision)
+    sds = jax.ShapeDtypeStruct
+    E, H = 4, cfg.hidden_dim
+    _, action, _ = jax.eval_shape(
+        lambda p, o, la, lr, cy, ex, ra: net.apply(
+            p, o, la, lr, cy, ex, ra, method=net.act_select
+        ),
+        state.params,
+        sds((E, *cfg.obs_shape), np.uint8),
+        sds((E,), np.int32),
+        sds((E,), np.float32),
+        (sds((E, H), np.float32), sds((E, H), np.float32)),
+        sds((E,), bool),
+        sds((E,), np.int32),
+    )
+    if str(action.dtype) != "int32":
+        out.append(
+            _finding(
+                "jaxpr-output-dtype", label,
+                f"fused act tail emits {action.dtype} actions, expected "
+                "int32 (ops/act_tail.py contract)",
+            )
+        )
+    return out
+
+
+def scan_serve_step_int8(precision: str = "fp32") -> List[Finding]:
+    """The int8 serve arm: int8 weights actually present, dequant lands on
+    the compute dtype (no f64 widening, fp32 arm stays bf16-free)."""
+    label = f"serve_step[int8,{precision}]"
+    text = serve_step_jaxpr(precision, "int8")
+    out = check_no_float64(text, label)
+    out += check_int8_weights(text, label)
+    if precision == "fp32":
+        out += check_no_bf16(text, label)
+    return out
+
+
 def scan_serve_step(precision: str) -> List[Finding]:
     import jax
 
@@ -551,7 +765,12 @@ def scan_entry_points(
         out += scan_train_step(p)
         out += scan_resharded_train_step(p)
         out += scan_act(p)
+        out += scan_act_select(p)
+        out += scan_fused_unroll(p)
         out += scan_serve_step(p)
         out += scan_donation(p)
+    # the quantized arm composes with precision the same way everywhere;
+    # one trace on the golden path keeps the gate's runtime bounded
+    out += scan_serve_step_int8("fp32")
     out.sort(key=Finding.sort_key)
     return out

@@ -273,15 +273,18 @@ def make_collect_core(
         def body(carry, key_t):
             env_state, h, c, la, lr, active = carry
             obs = vrender(env_state)
-            q, (h2, c2) = net.apply(params, obs, la, lr, (h, c), method=net.act)
+            ke, ka = jax.random.split(key_t)
+            explore = jax.random.uniform(ke, (E,)) < epsilons
+            rand_a = jax.random.randint(ka, (E,), 0, A)
+            # fused act tail (ops/act_tail.py): same math as the former
+            # argmax/where pair, selection fused with the core step
+            q, act, (h2, c2) = net.apply(
+                params, obs, la, lr, (h, c), explore, rand_a, method=net.act_select
+            )
             # scan carry stays f32 regardless of compute dtype (bf16->f32
             # is exact, and act re-casts on use — same values as the host
             # actor's bf16 carry)
             h2, c2 = h2.astype(jnp.float32), c2.astype(jnp.float32)
-            ke, ka = jax.random.split(key_t)
-            explore = jax.random.uniform(ke, (E,)) < epsilons
-            rand_a = jax.random.randint(ka, (E,), 0, A)
-            act = jnp.where(explore, rand_a, jnp.argmax(q, axis=-1)).astype(jnp.int32)
             new_env, reward, done = vstep(env_state, act)
             # freeze slots whose episode already ended: their remaining
             # steps are padding (and step `size` renders the terminal obs)

@@ -126,6 +126,37 @@ class R2D2Config:
     # (learner.py loss_fn, models/r2d2.py _dueling).
     precision: str = "fp32"  # "fp32" | "bf16"
 
+    # Serve-plane weight quantization (serve/server.py). "none" serves the
+    # checkpoint params as-is (bit-exact golden path). "int8" quantizes the
+    # encoder/head matmul kernels to per-output-channel symmetric int8 at
+    # publish time (checkpoint hot-reload / initial publish) and
+    # dequantizes in-jit inside the serve step: weights ship to the device
+    # at a quarter (vs fp32) of the bytes and the jitted step carries an
+    # i8 -> compute-dtype convert instead of an HBM-resident f32 kernel.
+    # The recurrent core (wi/wh/b) and all biases stay full precision —
+    # the sequential carry is the drift amplifier, so only the wide
+    # feed-forward matmuls take the quantization error. Bounded-parity
+    # class, like precision="bf16": actions may differ from the fp32 arm
+    # only where Q-gaps are within the quantization error (tests pin the
+    # Q-value drift bound); NOT bit-exact. Train/learner paths never see
+    # this knob. Default off.
+    serve_quantization: str = "none"  # "none" | "int8"
+
+    # Fused-sequence training semantics for the LSTM core: the T-step
+    # unroll treats each row's burn-in prefix as state-refresh only — a
+    # stop-gradient seam at burn_in[b] cuts the backward pass so burn-in
+    # steps contribute exactly zero to dWh/dWi and the initial carry grads
+    # vanish (the R2D2 paper's stored-state + burn-in semantics). Applies
+    # to BOTH backends identically: the Pallas sequence kernel
+    # (ops/pallas_lstm.py lstm_seq_unroll) masks inside its backward
+    # kernel, the lax.scan fallback applies the operator-equivalent
+    # where/stop_gradient masks, so CPU and TPU train the same function.
+    # Forward values are bit-identical either way (the seam only gates
+    # gradients). False restores the pre-seam behavior of backpropagating
+    # through burn-in. The LRU core ignores this knob (its associative
+    # scan has no per-row seam kernel; documented in ARCHITECTURE.md).
+    fused_sequence: bool = True
+
     # --- parallelism ------------------------------------------------------
     # Data-parallel learner shards the batch over the "dp" mesh axis;
     # "tp" shards wide layers (impala encoder / LSTM kernels) when > 1.
@@ -305,6 +336,13 @@ class R2D2Config:
             )
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
+        if self.serve_quantization not in ("none", "int8"):
+            raise ValueError(
+                f"unknown serve_quantization {self.serve_quantization!r}; "
+                "'none' serves checkpoint params as-is, 'int8' enables "
+                "publish-time per-channel weight quantization on the serve "
+                "plane (ops/quantize.py)"
+            )
         if self.lstm_backend not in ("auto", "scan", "pallas"):
             raise ValueError(f"unknown lstm_backend {self.lstm_backend!r}")
         if self.recurrent_core not in ("lstm", "lru"):

@@ -112,17 +112,11 @@ def init_train_state(cfg: R2D2Config, rng: jax.Array) -> Tuple[R2D2Network, Trai
     )
 
 
-def _raw_train_step(cfg: R2D2Config, net: R2D2Network, axis_name: Optional[str] = None):
-    """The un-jitted (state, batch) -> (state, metrics, priorities) body,
-    shared by the host-batch and device-store (fused) entry points.
-
-    axis_name=None: pure single-program body — under plain jit with the
-    batch sharded over a mesh, XLA inserts the gradient all-reduce itself.
-    axis_name="dp": the body runs per-shard under shard_map and all-reduces
-    gradients/metrics with an explicit lax.psum over the named axis (exact
-    because the loss denominator is psum'd globally first; the collective
-    rides ICI on a real slice)."""
-    optimizer = make_optimizer(cfg)
+def make_loss_fn(cfg: R2D2Config, net: R2D2Network):
+    """The per-batch loss closure (params, target_params, batch, denom) ->
+    (loss, (priorities, aux)), shared by every train-step builder and by
+    the bench's per-phase breakdown (which times it as its own jitted
+    program to isolate loss+grad cost from the optimizer)."""
     eps = cfg.value_rescale_eps
 
     def loss_fn(params, target_params, b: DeviceBatch, denom):
@@ -170,6 +164,22 @@ def _raw_train_step(cfg: R2D2Config, net: R2D2Network, axis_name: Optional[str] 
             "td_abs_mean": jnp.sum(abs_td) / denom,
         }
         return loss, (priorities, aux)
+
+    return loss_fn
+
+
+def _raw_train_step(cfg: R2D2Config, net: R2D2Network, axis_name: Optional[str] = None):
+    """The un-jitted (state, batch) -> (state, metrics, priorities) body,
+    shared by the host-batch and device-store (fused) entry points.
+
+    axis_name=None: pure single-program body — under plain jit with the
+    batch sharded over a mesh, XLA inserts the gradient all-reduce itself.
+    axis_name="dp": the body runs per-shard under shard_map and all-reduces
+    gradients/metrics with an explicit lax.psum over the named axis (exact
+    because the loss denominator is psum'd globally first; the collective
+    rides ICI on a real slice)."""
+    optimizer = make_optimizer(cfg)
+    loss_fn = make_loss_fn(cfg, net)
 
     def train_step(state: TrainState, b: DeviceBatch):
         if cfg.zero_state_replay:

@@ -70,8 +70,23 @@ class LSTM(nn.Module):
         h_new = o * jnp.tanh(c_new)
         return h_new, c_new
 
-    def __call__(self, xs: jnp.ndarray, carry: Carry) -> Tuple[jnp.ndarray, Carry]:
-        """Unroll over (B, T, D) inputs from carry; returns (B, T, H) + carry."""
+    def __call__(
+        self,
+        xs: jnp.ndarray,
+        carry: Carry,
+        burn_in: Optional[jnp.ndarray] = None,
+    ) -> Tuple[jnp.ndarray, Carry]:
+        """Unroll over (B, T, D) inputs from carry; returns (B, T, H) + carry.
+
+        `burn_in` (B,) int32, when given, places a per-row stop-gradient
+        seam at step burn_in[b]: forward values are unchanged, but the
+        backward pass treats steps t < burn_in[b] as state-refresh only
+        (zero grads into the weights and into the initial carry). Both
+        backends implement the same seam — the Pallas path inside its
+        backward kernel (ops/pallas_lstm.py lstm_seq_unroll), the scan
+        path via the operator-equivalent where/stop_gradient masks below —
+        so the trained function is backend-independent.
+        """
         B, T, D = xs.shape
         wi, wh, b = self._params()
         xs = xs.astype(self.dtype)
@@ -87,32 +102,64 @@ class LSTM(nn.Module):
             self.backend == "auto" and jax.default_backend() == "tpu"
         )
         if use_pallas:
-            from r2d2_tpu.ops.pallas_lstm import lstm_unroll
+            from r2d2_tpu.ops.pallas_lstm import lstm_seq_unroll, lstm_unroll
 
-            outs_t, (hT, cT) = lstm_unroll(proj_t, wh, h, c)
+            if burn_in is None:
+                outs_t, (hT, cT) = lstm_unroll(proj_t, wh, h, c)
+            else:
+                outs_t, (hT, cT) = lstm_seq_unroll(
+                    proj_t, wh, h, c, burn_in.astype(jnp.int32)
+                )
             return (
                 jnp.swapaxes(outs_t, 0, 1),
                 (hT.astype(self.dtype), cT.astype(self.dtype)),
             )
 
-        def step(carry, p):
-            h, c = carry
-            h, c = self._gates(p, h, wh, c)
-            return (h, c), h
+        if burn_in is None:
+
+            def step(carry, p):
+                h, c = carry
+                h, c = self._gates(p, h, wh, c)
+                return (h, c), h
+
+            xs_scan = proj_t
+        else:
+            bi = burn_in.astype(jnp.int32)
+
+            def step(carry, inp):
+                t, p = inp
+                h, c = carry
+                # seam: the carry entering step burn_in[b] is state-refresh
+                # only — identical values, no gradient across the boundary
+                cut = (t == bi)[:, None]
+                h = jnp.where(cut, jax.lax.stop_gradient(h), h)
+                c = jnp.where(cut, jax.lax.stop_gradient(c), c)
+                h, c = self._gates(p, h, wh, c)
+                # burn-in outputs carry no cotangent into the weights
+                keep = (t >= bi)[:, None]
+                out = jnp.where(keep, h, jax.lax.stop_gradient(h))
+                return (h, c), out
+
+            xs_scan = (jnp.arange(T, dtype=jnp.int32), proj_t)
 
         if self.scan_chunk is None or T <= self.scan_chunk:
-            (h, c), outs = jax.lax.scan(step, (h, c), proj_t)
+            (h, c), outs = jax.lax.scan(step, (h, c), xs_scan)
         else:
             chunk = self.scan_chunk
             if T % chunk != 0:
                 raise ValueError(f"seq len {T} not divisible by scan_chunk {chunk}")
 
             @jax.checkpoint
-            def run_chunk(carry, p_chunk):
-                return jax.lax.scan(step, carry, p_chunk)
+            def run_chunk(carry, chunk_xs):
+                return jax.lax.scan(step, carry, chunk_xs)
 
             p_chunks = proj_t.reshape(T // chunk, chunk, B, 4 * self.hidden_dim)
-            (h, c), outs = jax.lax.scan(run_chunk, (h, c), p_chunks)
+            if burn_in is None:
+                chunk_xs = p_chunks
+            else:
+                ts = jnp.arange(T, dtype=jnp.int32).reshape(T // chunk, chunk)
+                chunk_xs = (ts, p_chunks)
+            (h, c), outs = jax.lax.scan(run_chunk, (h, c), chunk_xs)
             outs = outs.reshape(T, B, self.hidden_dim)
 
         return jnp.swapaxes(outs, 0, 1), (h, c)

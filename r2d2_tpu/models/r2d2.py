@@ -59,6 +59,10 @@ class R2D2Network(nn.Module):
     lru_chunk: int = 0  # lru unroll formulation, see config.lru_chunk
     lru_r_min: float = 0.9   # lru eigenvalue ring, see config.lru_r_min
     lru_r_max: float = 0.999
+    # stop-gradient seam at each row's burn-in boundary during unroll
+    # (config.fused_sequence). LSTM core only; the LRU's associative-scan
+    # unroll keeps full backprop regardless (documented in ARCHITECTURE.md).
+    fused_sequence: bool = True
 
     @classmethod
     def from_config(cls, cfg: R2D2Config) -> "R2D2Network":
@@ -84,6 +88,7 @@ class R2D2Network(nn.Module):
             lru_chunk=cfg.lru_chunk,
             lru_r_min=cfg.lru_r_min,
             lru_r_max=cfg.lru_r_max,
+            fused_sequence=cfg.fused_sequence,
         )
 
     def setup(self):
@@ -143,6 +148,26 @@ class R2D2Network(nn.Module):
         h, carry = self.core.step(x, carry)
         return self._dueling(h), carry
 
+    def act_select(
+        self,
+        obs: jnp.ndarray,             # (B, *obs_shape) uint8
+        last_action: jnp.ndarray,     # (B,) int32
+        last_reward: jnp.ndarray,     # (B,) float32
+        carry: Carry,                 # ((B, H), (B, H))
+        explore: jnp.ndarray,         # (B,) bool ε-coin per row
+        random_actions: jnp.ndarray,  # (B,) int random draws in [0, A)
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, Carry]:
+        """Fused act tail: core step + dueling + ε-greedy select in one op.
+
+        Returns (q (B, A) f32, action (B,) int32, carry). The ε coin and
+        the uniform random actions are inputs (not a key) so host-loop
+        callers keep their numpy RNG stream — see ops/act_tail.py.
+        """
+        from r2d2_tpu.ops.act_tail import epsilon_greedy_actions
+
+        q, carry = self.act(obs, last_action, last_reward, carry)
+        return q, epsilon_greedy_actions(q, explore, random_actions), carry
+
     # --------------------------------------------------------------- unroll
 
     def unroll(
@@ -166,7 +191,12 @@ class R2D2Network(nn.Module):
         ).reshape(B, T, -1)
 
         carry = (hidden[:, 0], hidden[:, 1])
-        outs, _ = self.core(x, carry)  # (B, T, H)
+        if self.recurrent_core == "lstm" and self.fused_sequence:
+            # fused-sequence semantics: burn-in steps refresh state only;
+            # the stop-gradient seam lives inside the core's backward pass
+            outs, _ = self.core(x, carry, burn_in=burn_in)  # (B, T, H)
+        else:
+            outs, _ = self.core(x, carry)  # (B, T, H)
 
         t = jnp.arange(L, dtype=jnp.int32)
         learn_idx = jnp.clip(burn_in[:, None] + t[None, :], 0, T - 1)

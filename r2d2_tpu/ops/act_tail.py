@@ -1,0 +1,34 @@
+"""Fused act tail: ε-greedy action selection after the dueling head.
+
+Every acting surface — the host-loop actor (actor.py), the device
+collector scan body (collect.py, and megastep.py through it), and the
+serve step (serve/server.py) — used to finish with the same three
+small-tensor ops on (B, A) Q-values: argmax, explore-mask select, int32
+cast. Done as separate jitted-graph tail ops these are pure HBM bounces
+(a few KB each) after the core's matmuls; fused here (and composed with
+the dueling combine in R2D2Network.act_select) the whole tail stays in
+registers inside the one jitted program.
+
+Randomness policy: the op takes the explore mask and the random actions
+as INPUTS rather than a key. Host-loop callers (actor.py) draw both from
+their numpy Generator in the exact pre-existing stream order and pass
+them in, which keeps host-actor vs device-collector action parity
+bitwise; device callers split their own jax PRNG keys as before.
+
+Tie-breaking: `jnp.argmax` picks the first maximal action, same as
+`np.argmax` — the host and device tails agree exactly on equal Q rows.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def epsilon_greedy_actions(
+    q: jnp.ndarray,               # (B, A) float Q-values (any float dtype)
+    explore: jnp.ndarray,         # (B,) bool ε-coin per row
+    random_actions: jnp.ndarray,  # (B,) integer uniform draws in [0, A)
+) -> jnp.ndarray:
+    """Select argmax-Q actions with per-row ε-exploration; (B,) int32."""
+    greedy = jnp.argmax(q, axis=-1).astype(jnp.int32)
+    return jnp.where(explore, random_actions.astype(jnp.int32), greedy)

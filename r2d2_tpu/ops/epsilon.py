@@ -18,8 +18,16 @@ import numpy as np
 def epsilon_ladder(
     num_actors: int, base_eps: float = 0.4, alpha: float = 7.0
 ) -> np.ndarray:
-    if num_actors == 1:
-        return np.asarray([base_eps], dtype=np.float32)
+    """One vectorized expression for any N >= 1.
+
+    The N=1 rung falls out of the same formula (i=0 gives exponent 1, so
+    the sole actor gets base_eps exactly); the max() only guards the 0/0.
+    Exponentiation runs in float64 once and lands in float32 — the ladder
+    spans ~5 decades for the default alpha=7, and float32 pow would wobble
+    the smallest rungs' last bits across platforms.
+    """
+    if num_actors < 1:
+        raise ValueError(f"num_actors must be >= 1, got {num_actors}")
     i = np.arange(num_actors, dtype=np.float64)
-    exponent = 1.0 + i / (num_actors - 1) * alpha
-    return (base_eps**exponent).astype(np.float32)
+    exponent = 1.0 + i / max(num_actors - 1, 1) * alpha
+    return (float(base_eps) ** exponent).astype(np.float32)

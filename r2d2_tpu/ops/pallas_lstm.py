@@ -43,6 +43,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -248,3 +249,177 @@ def _vjp_bwd(res, grads):
 
 
 lstm_unroll.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# --------------------------------------------------------------------------
+# fused SEQUENCE op: burn-in + train segment in one launch, stop-gradient
+# seam handled inside the backward kernel
+# --------------------------------------------------------------------------
+#
+# R2D2 replays (burn-in ‖ learning ‖ forward) windows as ONE T-step sequence
+# and stops gradients at the burn-in/train seam: burn-in steps refresh the
+# recurrent state from stale-policy data but must not train the core.
+#
+# The seam position is PER ROW, not static: collect.py packs overlapping
+# windows where window 0 of a block gets burn_in=0 and later windows get the
+# full Bn, so a (B,) vector of seam indices rides along with every batch.
+# That rules out splitting the launch at the seam; instead the forward runs
+# the whole sequence as the one fused launch above (bit-identical to
+# lstm_unroll — stop_gradient is the identity on values) and the backward
+# kernel walks the full T-step reverse grid applying two per-row masks:
+#
+#   keep       = t >= burn   zeroes the pre-activation grad dz for burn-in
+#                            steps (their outputs carry no cotangent),
+#   carry_keep = t >  burn   cuts the (dh, dc) carry crossing the seam, so
+#                            nothing flows from the train segment into
+#                            burn-in steps.
+#
+# Rows below their seam therefore contribute exact zeros to dproj and to the
+# big dWh matmul outside the kernel, and d h0 / d c0 are STRUCTURALLY zero
+# for every row (the carry is cut at t == burn >= 0 before it can reach the
+# initial state), so the VJP returns zeros without reading kernel outputs.
+# Burn-in steps do no gate-recompute work that survives: their lanes are
+# masked to zero and the only residual read the seam needs is h/c at the
+# seam row itself (already part of the forward outputs; no extra residuals
+# are saved for the burn-in segment).
+
+
+def _seq_bwd_kernel(
+    dout_ref, proj_ref, hprev_ref, cprev_ref, cs_ref, wh_ref, dcT_ref, burn_ref,
+    dz_ref, dh_s, dc_s,
+):
+    H = dh_s.shape[-1]
+    t = pl.program_id(0)
+    # the grid streams blocks in reverse time order; recover the real index
+    t_real = pl.num_programs(0) - 1 - t
+
+    @pl.when(t == 0)
+    def _():
+        dh_s[:] = jnp.zeros_like(dh_s)
+        dc_s[:] = dcT_ref[:]
+
+    burn = burn_ref[:]  # (B, 1) int32 per-row seam
+    keep = t_real >= burn
+    carry_keep = t_real > burn
+
+    wh = wh_ref[:]
+    z = proj_ref[0].astype(jnp.float32) + jnp.dot(
+        hprev_ref[0].astype(wh.dtype), wh, preferred_element_type=jnp.float32
+    )
+    i, f, g, o = _split_gates(z, H)
+    tanh_c = jnp.tanh(cs_ref[0])
+
+    dh = jnp.where(keep, dout_ref[0].astype(jnp.float32), 0.0) + dh_s[:]
+    do = dh * tanh_c
+    dc = dh * o * (1.0 - tanh_c * tanh_c) + dc_s[:]
+    di = dc * g
+    df = dc * cprev_ref[0]
+    dg = dc * i
+    dz = jnp.concatenate(
+        [
+            di * i * (1.0 - i),
+            df * f * (1.0 - f),
+            dg * (1.0 - g * g),
+            do * o * (1.0 - o),
+        ],
+        axis=-1,
+    )
+    dz_ref[0] = dz
+    # carry to step t_real-1, cut at the seam (and already-zero below it)
+    dh_s[:] = jnp.where(
+        carry_keep,
+        jnp.dot(dz.astype(wh.dtype), wh.T, preferred_element_type=jnp.float32),
+        0.0,
+    )
+    dc_s[:] = jnp.where(carry_keep, dc * f, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _lstm_seq_bwd_call(dout, proj_t, hprev, cprev, cs, wh, dcT, burn, *, interpret: bool):
+    T, B, H = cs.shape
+    rev3 = lambda t: (T - 1 - t, 0, 0)
+    pinned = lambda t: (0, 0)
+    (dz,) = pl.pallas_call(
+        _seq_bwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, 4 * H), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, H), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, H), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, H), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((H, 4 * H), pinned, memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), pinned, memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, 1), pinned, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, 4 * H), rev3, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, 4 * H), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
+        ],
+        interpret=interpret,
+    )(dout, proj_t, hprev, cprev, cs, wh, dcT, burn)
+    return dz
+
+
+@jax.custom_vjp
+def lstm_seq_unroll(
+    proj_t: jnp.ndarray,   # (T, B, 4H) time-major input projections x@Wi+b
+    wh: jnp.ndarray,       # (H, 4H) recurrent weights
+    h0: jnp.ndarray,       # (B, H)
+    c0: jnp.ndarray,       # (B, H)
+    burn_in: jnp.ndarray,  # (B,) int32 per-row stop-gradient seam position
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Fused burn-in + train sequence unroll with a stop-gradient seam.
+
+    Forward values are bit-identical to :func:`lstm_unroll` (one launch,
+    carry pinned in VMEM scratch for all T steps). The VJP implements the
+    R2D2 seam: gradients do not flow into steps t < burn_in[b] of row b,
+    and d h0 / d c0 are exact zeros.
+
+    Contract: 0 <= burn_in[b] < T. The replay pipeline guarantees this
+    (burn_in + learning + forward == T with learning >= 1); a seam at or
+    past T would mean "no train segment", which the masks above do not
+    define (every collect/learner caller satisfies the contract by
+    construction).
+    """
+    outs, cs = _lstm_fwd_call(proj_t, wh, h0, c0, interpret=_interpret())
+    return outs, (outs[-1].astype(jnp.float32), cs[-1])
+
+
+def _seq_vjp_fwd(proj_t, wh, h0, c0, burn_in):
+    outs, cs = _lstm_fwd_call(proj_t, wh, h0, c0, interpret=_interpret())
+    out = (outs, (outs[-1].astype(jnp.float32), cs[-1]))
+    return out, (proj_t, wh, h0, c0, burn_in, outs, cs)
+
+
+def _seq_vjp_bwd(res, grads):
+    proj_t, wh, h0, c0, burn_in, outs, cs = res
+    douts, (dhT, dcT) = grads
+    T, B, H = cs.shape
+    douts = douts.astype(jnp.float32).at[-1].add(dhT.astype(jnp.float32))
+    hprev = jnp.concatenate([h0.astype(outs.dtype)[None], outs[:-1]], axis=0)
+    cprev = jnp.concatenate([c0.astype(jnp.float32)[None], cs[:-1]], axis=0)
+    burn = burn_in.astype(jnp.int32).reshape(B, 1)
+    dz = _lstm_seq_bwd_call(
+        douts, proj_t, hprev, cprev, cs, wh, dcT.astype(jnp.float32), burn,
+        interpret=_interpret(),
+    )
+    dproj = dz.astype(proj_t.dtype)
+    # dz is exactly zero for burn-in steps, so they drop out of dWh too
+    dwh = jnp.dot(
+        hprev.reshape(T * B, H).astype(jnp.float32).T, dz.reshape(T * B, 4 * H),
+        preferred_element_type=jnp.float32,
+    ).astype(wh.dtype)
+    # the seam cut makes initial-state grads structurally zero; the int32
+    # seam vector is non-differentiable (float0 cotangent)
+    dburn = np.zeros(burn_in.shape, dtype=jax.dtypes.float0)
+    return dproj, dwh, jnp.zeros_like(h0), jnp.zeros_like(c0), dburn
+
+
+lstm_seq_unroll.defvjp(_seq_vjp_fwd, _seq_vjp_bwd)

@@ -20,8 +20,15 @@ def mixed_td_priorities(
 ) -> jnp.ndarray:
     """abs_td: (B, L) |delta|; mask: (B, L) 1.0 on valid learning steps.
 
-    Returns (B,) priorities. Rows with an empty mask produce 0.
+    Returns (B,) float32 priorities. Rows with an empty mask produce 0.
+
+    Accepts any float dtype for abs_td/mask (the bf16 compute plane hands
+    in half-width TD errors): ONE explicit upcast to float32 up front,
+    reductions in float32, float32 out — no silent bf16 reductions and no
+    upcast-then-downcast churn per op.
     """
+    abs_td = abs_td.astype(jnp.float32)
+    mask = mask.astype(jnp.float32)
     masked = abs_td * mask
     max_td = jnp.max(masked, axis=1)
     count = jnp.maximum(jnp.sum(mask, axis=1), 1.0)
@@ -32,7 +39,13 @@ def mixed_td_priorities(
 def mixed_td_priorities_np(
     abs_td: np.ndarray, mask: np.ndarray, eta: float = 0.9
 ) -> np.ndarray:
-    """numpy twin for host-side (actor initial-priority) use."""
+    """numpy twin for host-side (actor initial-priority) use.
+
+    Same dtype contract as the jax op: one upcast, float32 math/out (the
+    host side may hand in ml_dtypes.bfloat16 slabs from a bf16 store).
+    """
+    abs_td = np.asarray(abs_td, np.float32)
+    mask = np.asarray(mask, np.float32)
     masked = abs_td * mask
     max_td = masked.max(axis=1)
     count = np.maximum(mask.sum(axis=1), 1.0)

@@ -1,0 +1,112 @@
+"""Per-channel symmetric int8 weight quantization for the serve plane.
+
+Weight-only quantization of the WIDE feed-forward matmuls (encoder convs
+/ dense layers, dueling-head kernels): each selected kernel leaf is
+replaced by an int8 tensor plus a float32 per-output-channel scale
+
+    scale_j = max_i |w_ij| / 127        (symmetric, zero-point free)
+    q_ij    = round(w_ij / scale_j)     clipped to [-127, 127]
+
+and dequantized in-jit (`q.astype(f32) * scale`) right before the matmul
+— XLA fuses the convert+multiply into the weight fetch, so the kernel
+ships to the device at a quarter of the fp32 bytes and nothing else in
+the program changes.
+
+What is NOT quantized, deliberately:
+
+- the recurrent core subtree (wi/wh/b): the T-step sequential carry is
+  the drift amplifier — per-step error compounds through the gates — and
+  its (H, 4H) kernels are a small fraction of total weight bytes anyway;
+- biases and every other rank-<2 leaf (norm scales, LRU ring params):
+  negligible bytes, disproportionate drift.
+
+This module is pytree surgery on host at PUBLISH time (checkpoint
+hot-reload in serve/server.py), never in the train/learner path. The
+quantized tree keeps the exact container structure of the input with
+selected leaves swapped for {"q8", "scale"} dicts, so it threads through
+jit boundaries as an ordinary pytree; `dequantize_tree` restores the
+original structure (values within quantization error).
+
+Bounded-parity class, like precision="bf16" (ARCHITECTURE.md): Q-values
+drift by a bounded amount vs the fp32 arm; actions may flip only where
+Q-gaps are inside that bound. Tests pin the drift (tests/test_serve.py),
+BENCH serve rows report it (`q_drift_vs_fp32`).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# container keys whose whole subtree stays full precision
+_SKIP_SUBTREES = ("core",)
+_Q8_KEYS = frozenset(("q8", "scale"))
+
+
+def _is_qleaf(node) -> bool:
+    return isinstance(node, Mapping) and set(node.keys()) == _Q8_KEYS
+
+
+def _quantize_leaf(w: jnp.ndarray):
+    w32 = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=tuple(range(w32.ndim - 1)), keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return {"q8": q, "scale": scale}
+
+
+def quantize_tree(params) -> Tuple[dict, int]:
+    """Quantize eligible kernels in a flax param tree.
+
+    Returns (quantized tree, number of leaves quantized). Eligible:
+    float leaves with ndim >= 2 outside the `core` subtree. Everything
+    else passes through untouched.
+    """
+    count = 0
+
+    def rec(node, skip):
+        nonlocal count
+        if isinstance(node, Mapping):
+            return {k: rec(v, skip or k in _SKIP_SUBTREES) for k, v in node.items()}
+        if (
+            not skip
+            and hasattr(node, "ndim")
+            and node.ndim >= 2
+            and jnp.issubdtype(node.dtype, jnp.floating)
+        ):
+            count += 1
+            return _quantize_leaf(node)
+        return node
+
+    return rec(params, False), count
+
+
+def dequantize_tree(params, dtype=jnp.float32):
+    """Inverse of quantize_tree (values within quantization error).
+
+    Safe to call inside jit — it is a handful of convert+mul ops that XLA
+    fuses into the consuming matmuls. A tree with no quantized leaves
+    passes through unchanged.
+    """
+
+    def rec(node):
+        if _is_qleaf(node):
+            return (node["q8"].astype(dtype) * node["scale"].astype(dtype)).astype(dtype)
+        if isinstance(node, Mapping):
+            return {k: rec(v) for k, v in node.items()}
+        return node
+
+    return rec(params)
+
+
+def quantized_bytes_saved(params) -> int:
+    """HBM bytes saved by the int8 leaves of a quantized tree."""
+    saved = 0
+    for leaf in jax.tree.leaves(
+        params, is_leaf=_is_qleaf
+    ):
+        if _is_qleaf(leaf):
+            saved += 3 * leaf["q8"].size  # f32 (4B) -> i8 (1B)
+    return saved

@@ -26,11 +26,21 @@ def n_step_returns(rewards: np.ndarray, gamma: float, n: int) -> np.ndarray:
 
     rewards: (T,) raw per-step rewards of one (partial) episode chunk.
     Returns (T,) float32: sum_{k<n} gamma^k r_{t+k} with zero padding.
+
+    Dtype policy: float32/float64 rewards keep the float64 convolution
+    accumulator (deliberate — it pins host-vs-device parity of the
+    accumulated returns and is what the golden tests were built against).
+    Half-width inputs (bfloat16 slabs off the bf16 compute plane, fp16)
+    take ONE explicit upcast and accumulate in float32: the input only
+    has 8 bits of mantissa, so a float64 round trip is pure
+    upcast-then-downcast churn. Either way the result is float32.
     """
-    rewards = np.asarray(rewards, dtype=np.float64)
-    padded = np.concatenate([rewards, np.zeros(n - 1, dtype=np.float64)])
+    rewards = np.asarray(rewards)
+    acc = np.float32 if rewards.dtype.itemsize <= 2 else np.float64
+    rewards = rewards.astype(acc)
+    padded = np.concatenate([rewards, np.zeros(n - 1, dtype=acc)])
     # kernel ordered so 'valid' convolution aligns gamma^k with r_{t+k}
-    kernel = np.array([gamma ** (n - 1 - i) for i in range(n)], dtype=np.float64)
+    kernel = np.array([gamma ** (n - 1 - i) for i in range(n)], dtype=acc)
     return np.convolve(padded, kernel, "valid").astype(np.float32)
 
 

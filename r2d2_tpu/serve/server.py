@@ -146,10 +146,18 @@ class PolicyServer:
                 params, ckpt_step = state.params, int(state.step)
             else:
                 params = self._template.params  # fresh init (smoke serving)
+        # serve_quantization="int8": per-channel symmetric weight-only
+        # quantization of the encoder/head kernels (ops/quantize.py),
+        # applied ONCE per publish (here and at every hot reload) so the
+        # jitted step dequantizes int8 weights in-jit instead of fetching
+        # f32 kernels from HBM. Default "none" publishes params as-is.
+        self.quantized_leaves = 0
         # the atomic hot-reload cell: ONE attribute holding ONE tuple, read
         # once per batch — Python attribute reads are atomic, so a batch
         # sees exactly one (params, step, version) triple, never a mix
-        self._published: Tuple[object, int, int] = (params, ckpt_step, 0)
+        self._published: Tuple[object, int, int] = (
+            self._prepare_params(params), ckpt_step, 0
+        )
 
         if serve_cfg.cache_capacity < max(serve_cfg.buckets):
             # a batch's own admissions must never evict a co-batched
@@ -189,13 +197,28 @@ class PolicyServer:
 
     # ------------------------------------------------------------ jit step
 
+    def _prepare_params(self, params):
+        """Publish-time param transform: int8 quantization when enabled."""
+        if self.cfg.serve_quantization == "int8":
+            from r2d2_tpu.ops.quantize import quantize_tree
+
+            params, self.quantized_leaves = quantize_tree(params)
+        return params
+
     def _build_step(self):
         net = self.net
+        quantized = self.cfg.serve_quantization == "int8"
 
         def step(params, h_store, c_store, la_store, lr_store,
                  obs, rewards, slots, reset_mask, explore_mask, random_actions):
             # runs once per TRACE (new bucket shape), not per call
             self.trace_count += 1
+            if quantized:
+                # in-jit dequant: XLA fuses the i8->f32 convert + scale
+                # multiply into the consuming matmuls (ops/quantize.py)
+                from r2d2_tpu.ops.quantize import dequantize_tree
+
+                params = dequantize_tree(params)
             h = h_store[slots]
             c = c_store[slots]
             la = la_store[slots]
@@ -204,9 +227,12 @@ class PolicyServer:
             c = jnp.where(zero, 0.0, c)
             la = jnp.where(reset_mask, 0, la)
             lr = jnp.where(reset_mask, 0.0, rewards)
-            q, (h_new, c_new) = net.apply(params, obs, la, lr, (h, c), method=net.act)
-            action = jnp.where(explore_mask, random_actions, jnp.argmax(q, axis=1))
-            action = action.astype(jnp.int32)
+            # fused act tail: dueling combine + ε-mask + argmax in one op
+            # with the core step (models/r2d2.py act_select)
+            q, action, (h_new, c_new) = net.apply(
+                params, obs, la, lr, (h, c), explore_mask, random_actions,
+                method=net.act_select,
+            )
             # scatter back: pad rows all target the scratch slot (their
             # writes collide there harmlessly; real slots are unique by the
             # batcher's one-session-per-batch rule)
@@ -352,7 +378,9 @@ class PolicyServer:
             return False
         state, _, _ = restore_checkpoint(self.checkpoint_dir, self._template, step)
         _, _, version = self._published
-        self._published = (state.params, int(state.step), version + 1)
+        self._published = (
+            self._prepare_params(state.params), int(state.step), version + 1
+        )
         self.reloads += 1
         return True
 
@@ -422,6 +450,8 @@ class PolicyServer:
             "trace_count": self.trace_count,
             "ckpt_step": self._published[1],
             "params_version": self._published[2],
+            "serve_quantization": self.cfg.serve_quantization,
+            "quantized_leaves": self.quantized_leaves,
         }
         out.update(self.batcher.stats())
         out.update(self.cache.stats())

@@ -71,6 +71,27 @@ class TestNStepReturns:
         g = n_step_gammas(2, 0.5, 5, done=True)
         np.testing.assert_allclose(g, [0.0, 0.0])
 
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16", "float16"])
+    def test_returns_dtype_contract(self, dtype):
+        """Half-width reward inputs accumulate in float32 (one upcast, no
+        f64 round trip); float32 keeps the float64 accumulator (golden
+        parity). Output is float32 either way and matches an f32
+        brute-force on the dtype-rounded values."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(4)
+        r32 = rng.normal(size=17).astype(np.float32)
+        r = np.asarray(jnp.asarray(r32).astype(dtype))
+        got = n_step_returns(r, 0.997, 5)
+        assert got.dtype == np.float32
+        rf = np.asarray(r, np.float32)
+        want = np.zeros(17, np.float32)
+        for t in range(17):
+            for k in range(5):
+                if t + k < 17:
+                    want[t] += (0.997**k) * rf[t + k]
+        np.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-6)
+
 
 class TestEpsilonLadder:
     def test_reference_values(self):
@@ -81,6 +102,24 @@ class TestEpsilonLadder:
 
     def test_single_actor(self):
         np.testing.assert_allclose(epsilon_ladder(1, 0.4, 7.0), [0.4])
+
+    @pytest.mark.parametrize("num_actors", [1, 2, 3, 8, 32, 100, 256])
+    @pytest.mark.parametrize("base_eps,alpha", [(0.4, 7.0), (0.3, 3.0), (0.5, 1.0)])
+    def test_matches_paper_formula(self, num_actors, base_eps, alpha):
+        """Property test across actor counts: the vectorized ladder equals
+        eps_i = eps^(1 + i/(N-1) * alpha) elementwise (Ape-X eq. 1)."""
+        got = epsilon_ladder(num_actors, base_eps, alpha)
+        assert got.shape == (num_actors,) and got.dtype == np.float32
+        for i in range(num_actors):
+            exp = 1.0 if num_actors == 1 else 1.0 + i / (num_actors - 1) * alpha
+            np.testing.assert_allclose(got[i], base_eps**exp, rtol=1e-6)
+        # the ladder is a ladder: first rung is the base, rungs decrease
+        np.testing.assert_allclose(got[0], base_eps, rtol=1e-6)
+        assert np.all(np.diff(got) <= 0)
+
+    def test_rejects_zero_actors(self):
+        with pytest.raises(ValueError):
+            epsilon_ladder(0)
 
 
 class TestMixedTDPriorities:
@@ -101,6 +140,63 @@ class TestMixedTDPriorities:
         np.testing.assert_allclose(
             np.asarray(mixed_td_priorities(td, mask)), mixed_td_priorities_np(td, mask), rtol=1e-5
         )
+
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16", "float16"])
+    def test_dtype_contract(self, dtype):
+        """bf16 TD inputs (the bf16 compute plane) take ONE upcast: the
+        result is float32 in both twins and matches the f32 reference to
+        the input dtype's own resolution — no silent half-width
+        reductions."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(3)
+        td32 = np.abs(rng.normal(size=(6, 12))).astype(np.float32)
+        mask = (np.arange(12)[None, :] < np.array([[12], [4], [1], [9], [6], [12]])).astype(np.float32)
+        td = jnp.asarray(td32).astype(dtype)
+
+        got_j = mixed_td_priorities(td, jnp.asarray(mask).astype(dtype))
+        got_n = mixed_td_priorities_np(np.asarray(td), np.asarray(mask, np.float32))
+        assert str(got_j.dtype) == "float32"
+        assert got_n.dtype == np.float32
+        # reference on the dtype-rounded values (the upcast is exact)
+        ref = mixed_td_priorities_np(np.asarray(td, np.float32), mask)
+        np.testing.assert_allclose(np.asarray(got_j), ref, rtol=1e-6)
+        np.testing.assert_allclose(got_n, ref, rtol=1e-6)
+
+
+class TestActTail:
+    """ops/act_tail.py — the fused ε-greedy tail shared by actor/collect/
+    serve. Must agree bitwise with the pre-fusion numpy tail."""
+
+    def test_matches_numpy_tail(self):
+        import jax.numpy as jnp
+
+        from r2d2_tpu.ops.act_tail import epsilon_greedy_actions
+
+        rng = np.random.default_rng(5)
+        q = rng.normal(size=(64, 6)).astype(np.float32)
+        explore = rng.random(64) < 0.3
+        rand_a = rng.integers(0, 6, size=64)
+        got = np.asarray(
+            epsilon_greedy_actions(jnp.asarray(q), jnp.asarray(explore), jnp.asarray(rand_a.astype(np.int32)))
+        )
+        want = np.where(explore, rand_a, q.argmax(axis=1)).astype(np.int32)
+        assert got.dtype == np.int32
+        np.testing.assert_array_equal(got, want)
+
+    def test_tie_break_first_max(self):
+        import jax.numpy as jnp
+
+        from r2d2_tpu.ops.act_tail import epsilon_greedy_actions
+
+        q = np.array([[1.0, 1.0, 0.5], [0.2, 0.7, 0.7]], np.float32)
+        got = np.asarray(
+            epsilon_greedy_actions(
+                jnp.asarray(q), jnp.zeros(2, bool), jnp.zeros(2, jnp.int32)
+            )
+        )
+        # first maximal action wins, matching np.argmax on the host path
+        np.testing.assert_array_equal(got, q.argmax(axis=1))
 
 
 class TestConfigOverrides:

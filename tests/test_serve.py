@@ -396,3 +396,91 @@ def test_tcp_roundtrip(base_server):
     finally:
         tcp.shutdown()
         tcp.server_close()
+
+# -------------------------------------------------------- int8 serve arm
+
+
+class TestServeInt8:
+    """serve_quantization="int8" is a bounded-parity serving arm (same
+    contract class as bf16): per-channel symmetric weight-only int8 on the
+    encoder/head kernels, quantized once per publish, dequantized in-jit.
+    The served path must be BITWISE the direct reference on the
+    dequantized params — all drift comes from the quantize round-trip
+    itself, which these tests bound against the fp32 arm."""
+
+    def test_default_off(self, base_server):
+        assert tiny_test().serve_quantization == "none"
+        assert base_server.quantized_leaves == 0
+        assert base_server.stats()["serve_quantization"] == "none"
+
+    def test_bounded_parity_and_self_consistency(self):
+        from r2d2_tpu.ops.quantize import dequantize_tree, quantize_tree
+
+        scfg = ServeConfig(buckets=(2, 4), max_wait_ms=2.0, cache_capacity=16)
+        srv_fp = PolicyServer(CFG, scfg)
+        srv_q = PolicyServer(CFG.replace(serve_quantization="int8"), scfg)
+        assert srv_q.quantized_leaves > 0
+        assert srv_q.stats()["quantized_leaves"] == srv_q.quantized_leaves
+        # same serve seed -> identical init params on both servers
+        deq = dequantize_tree(quantize_tree(srv_fp._published[0])[0])
+        srv_fp.warmup(); srv_fp.start()
+        srv_q.warmup(); srv_q.start()
+        cl_fp, cl_q = LocalClient(srv_fp), LocalClient(srv_q)
+        ref = SessionReference(srv_q.net, CFG.hidden_dim)
+        try:
+            rng = np.random.default_rng(0)
+            max_drift = max_q = 0.0
+            for t in range(12):
+                obs = rng.integers(0, 255, CFG.obs_shape, dtype=np.uint8)
+                reset = t == 0
+                r = 0.0 if reset else float(rng.random())
+                res_fp = cl_fp.act("s", obs, reward=r, reset=reset)
+                res_q = cl_q.act("s", obs, reward=r, reset=reset)
+                # self-consistency: the int8 arm IS the direct path on the
+                # dequantized params, bit for bit (no extra serving drift)
+                q_ref, a_ref = ref.step(deq, obs, r, reset)
+                np.testing.assert_array_equal(q_ref, np.asarray(res_q.q))
+                assert a_ref == res_q.action
+                max_drift = max(max_drift, float(np.max(np.abs(
+                    np.asarray(res_q.q) - np.asarray(res_fp.q)))))
+                max_q = max(max_q, float(np.max(np.abs(np.asarray(res_fp.q)))))
+        finally:
+            srv_fp.stop()
+            srv_q.stop()
+        # bounded parity: int8 round-trip drift stays a small fraction of
+        # the fp32 Q scale (observed ~2% at tiny_test shapes)
+        assert max_drift / max_q < 0.05, (max_drift, max_q)
+
+    def test_reload_requantizes(self, tmp_path):
+        ckpt_dir = str(tmp_path / "ckpt")
+        srv = PolicyServer(
+            CFG.replace(serve_quantization="int8"),
+            ServeConfig(buckets=(2, 4), max_wait_ms=2.0, cache_capacity=16),
+            checkpoint_dir=ckpt_dir,
+        )
+        state = _bump_params(srv._template, 2.0).replace(
+            step=jnp.asarray(1, jnp.int32))
+        save_checkpoint(ckpt_dir, state, 0, 0.0)
+
+        def leaf_scales(tree):
+            out = []
+            def walk(t):
+                if isinstance(t, dict) and set(t) == {"q8", "scale"}:
+                    out.append(np.asarray(t["scale"]))
+                elif isinstance(t, dict):
+                    for v in t.values():
+                        walk(v)
+            walk(jax.tree_util.tree_map(
+                lambda x: x, srv._published[0] if tree is None else tree,
+                is_leaf=lambda t: isinstance(t, dict) and set(t) == {"q8", "scale"}))
+            return out
+
+        before = leaf_scales(None)
+        assert before and all(s.dtype == np.float32 for s in before)
+        assert srv.reload_now()
+        after = leaf_scales(None)
+        assert srv._published[1] == 1
+        assert srv.quantized_leaves == len(after) > 0
+        # params doubled -> per-channel absmax scales double exactly
+        for b, a in zip(before, after):
+            np.testing.assert_allclose(a, b * 2.0, rtol=1e-6)
