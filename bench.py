@@ -762,45 +762,93 @@ def tiered_main(
     )
 
 
-def _serve_load(cfg, sessions: int, seconds: float, label: str = "") -> dict:
-    """One serving-plane load arm: `sessions` concurrent CatchHostEnv
-    session threads drive the full-size network through r2d2_tpu.serve's
-    LocalClient for `seconds`, with a checkpoint hot-reload fired
-    mid-window to prove reloads don't dent the latency tail. Returns the
-    measured numbers; serve_main decides which arm is the headline.
-    `label` names the arm in stderr progress lines (the int8 arm runs at
-    cfg.precision bf16, so precision alone is ambiguous)."""
+def _serve_load(cfg, sessions: int, seconds: float, label: str = "",
+                arrival_rate: float = 0.0, slo_ms: float = 50.0,
+                devices: int = 1) -> dict:
+    """One serving-plane load arm against the full-size network through
+    r2d2_tpu.serve, with a checkpoint hot-reload fired mid-window to prove
+    reloads don't dent the latency tail.
+
+    Two load shapes:
+
+    - `arrival_rate > 0` — OPEN-LOOP (the honest overload measurement,
+      and the default): a Poisson arrival process at `arrival_rate`
+      requests/s over a session population sized ≫ the cache capacity
+      (capacity = sessions/8, spill slab = 2x sessions), so the LRU tier
+      churns and spill/promote round trips run under live traffic. Open
+      loop means arrivals do NOT slow down when the server does — queueing
+      delay lands in the latency numbers instead of silently throttling
+      the offered load (closed-loop coordination omission). Rejected
+      requests (full queue) count as SLO misses, not as absent samples.
+    - `arrival_rate == 0` — the legacy CLOSED-LOOP arm: `sessions`
+      CatchHostEnv threads each submit-then-wait in lockstep with their
+      episode stream (cache sized 2x sessions, no spill churn).
+
+    Either way the first `min(2s, 20% of window)` of requests is a
+    WARM-UP window discarded from percentiles/SLO/requests-per-sec (its
+    request count rides in the row as `warmup_requests`), so stragglers
+    of first-batch compilation and cache fill don't pollute the tail.
+
+    `devices > 1` serves through MultiDeviceServer replicas with
+    session-affinity routing instead of a single PolicyServer.
+
+    Returns the measured numbers; serve_main decides which arm is the
+    headline. `label` names the arm in stderr progress lines (the int8
+    arm runs at cfg.precision bf16, so precision alone is ambiguous)."""
     import os
     import shutil
     import tempfile
 
     from r2d2_tpu.envs.catch import CatchHostEnv
-    from r2d2_tpu.serve import LocalClient, PolicyServer, ServeConfig
+    from r2d2_tpu.serve import (
+        LocalClient,
+        MultiDeviceServer,
+        PolicyServer,
+        ServeConfig,
+    )
     from r2d2_tpu.utils.checkpoint import save_checkpoint
 
+    open_loop = arrival_rate > 0.0
+    if open_loop:
+        # sessions ≫ capacity: the HBM hot set holds a fraction of the
+        # population, the rest live in (and return from) the host slab
+        cache_capacity = max(32, sessions // 8)
+        cfg = cfg.replace(
+            serve_spill=max(cfg.serve_spill, 2 * sessions)
+        ).validate()
+    else:
+        cache_capacity = max(2 * sessions, 64)
+    if devices > 1:
+        cfg = cfg.replace(serve_devices=devices).validate()
     serve_cfg = ServeConfig(
         buckets=(2, 4, 8, 16, 32),
         max_wait_ms=2.0,
-        cache_capacity=max(2 * sessions, 64),
+        cache_capacity=cache_capacity,
         poll_interval_s=0.2,
     )
     label = label or cfg.precision
     tmp = tempfile.mkdtemp(prefix="serve_bench_")
     ckpt_dir = os.path.join(tmp, "ckpt")
     try:
-        server = PolicyServer(cfg, serve_cfg, checkpoint_dir=ckpt_dir)
+        if devices > 1:
+            server = MultiDeviceServer(cfg, serve_cfg, checkpoint_dir=ckpt_dir)
+        else:
+            server = PolicyServer(cfg, serve_cfg, checkpoint_dir=ckpt_dir)
         save_checkpoint(ckpt_dir, server._template, 0, 0.0)  # step-0 series
-        t0 = time.time()
+        t0 = time.perf_counter()
         server.warmup()
         print(
-            f"[serve:{label}] warmup (all buckets) in "
-            f"{time.time() - t0:.1f}s",
+            f"[serve:{label}] warmup (all buckets x {devices} devices) in "
+            f"{time.perf_counter() - t0:.1f}s",
             file=sys.stderr,
         )
         server.start()
         client = LocalClient(server)
         stop = threading.Event()
-        lats: list = [[] for _ in range(sessions)]
+        # (submit time rel. to window start, latency seconds | None=error);
+        # appends are GIL-atomic, done-callbacks run on the serve loop
+        records: list = []
+        bench_t0 = time.perf_counter()
 
         def session_loop(i: int) -> None:
             env = CatchHostEnv(seed=i)
@@ -809,17 +857,50 @@ def _serve_load(cfg, sessions: int, seconds: float, label: str = "") -> dict:
             while not stop.is_set():
                 t = time.perf_counter()
                 res = client.act(sid, obs, reward=reward, reset=reset)
-                lats[i].append(time.perf_counter() - t)
+                records.append((t - bench_t0, time.perf_counter() - t))
                 obs, reward, done, _ = env.step(res.action)
                 reset = done
                 if done:
                     obs, reward = env.reset(), 0.0
 
-        threads = [
-            threading.Thread(target=session_loop, args=(i,), daemon=True)
-            for i in range(sessions)
-        ]
-        bench_t0 = time.time()
+        def arrival_loop() -> None:
+            # Poisson process: exponential inter-arrival gaps at the target
+            # rate; each arrival picks a uniform session and fires one
+            # non-blocking submit, latency captured by the done callback
+            rng = np.random.default_rng(1234)
+            session_obs: dict = {}
+            seen: set = set()
+            next_t = time.perf_counter()
+            while not stop.is_set():
+                next_t += rng.exponential(1.0 / arrival_rate)
+                delay = next_t - time.perf_counter()
+                if delay > 0 and stop.wait(delay):
+                    break
+                i = int(rng.integers(0, sessions))
+                obs = session_obs.get(i)
+                if obs is None:
+                    obs = rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8)
+                    session_obs[i] = obs
+                sid = f"bench-{i}"
+                reset = sid not in seen
+                seen.add(sid)
+                t_sub = time.perf_counter()
+                fut = server.submit(sid, obs, reward=0.0, reset=reset)
+
+                def _done(f, t_sub=t_sub):
+                    lat = None if f.exception() is not None \
+                        else time.perf_counter() - t_sub
+                    records.append((t_sub - bench_t0, lat))
+
+                fut.add_done_callback(_done)
+
+        if open_loop:
+            threads = [threading.Thread(target=arrival_loop, daemon=True)]
+        else:
+            threads = [
+                threading.Thread(target=session_loop, args=(i,), daemon=True)
+                for i in range(sessions)
+            ]
         for t in threads:
             t.start()
         # mid-window: publish a new checkpoint so the watcher hot-reloads
@@ -833,21 +914,36 @@ def _serve_load(cfg, sessions: int, seconds: float, label: str = "") -> dict:
         stop.set()
         for t in threads:
             t.join(timeout=10.0)
-        elapsed = time.time() - bench_t0
+        time.sleep(0.5)  # let in-flight open-loop futures resolve
+        elapsed = time.perf_counter() - bench_t0
         server.check()
         stats = server.stats()
         server.stop()
 
-        all_lat = np.sort(np.concatenate([np.asarray(l) for l in lats if l]))
-        n = all_lat.size
-        rps = n / elapsed
-        p50, p95, p99 = (
-            float(np.percentile(all_lat, p) * 1e3) for p in (50, 95, 99)
-        )
+        warmup_s = min(2.0, 0.2 * seconds)
+        warmup_requests = sum(1 for t_sub, _ in records if t_sub < warmup_s)
+        measured = [(t_sub, lat) for t_sub, lat in records if t_sub >= warmup_s]
+        ok = np.sort(np.asarray([lat for _, lat in measured if lat is not None]))
+        errors = len(measured) - ok.size
+        rps = ok.size / max(elapsed - warmup_s, 1e-9)
+        if ok.size:
+            p50, p95, p99 = (
+                float(np.percentile(ok, p) * 1e3) for p in (50, 95, 99)
+            )
+        else:
+            p50 = p95 = p99 = float("nan")
+        # SLO attainment over everything offered post-warmup: a rejected
+        # or failed request is a miss, not a dropped sample
+        attained = int(np.count_nonzero(ok <= slo_ms / 1e3))
+        slo_attainment = attained / max(len(measured), 1)
         print(
-            f"[serve:{label}] {n} requests over {sessions} sessions "
-            f"in {elapsed:.1f}s (reloads={stats['reloads']}, occupancy="
-            f"{stats['mean_batch_occupancy']:.1f})",
+            f"[serve:{label}] {ok.size} requests over {sessions} sessions "
+            f"in {elapsed:.1f}s ({'open' if open_loop else 'closed'}-loop, "
+            f"warmup={warmup_requests}, errors={errors}, "
+            f"reloads={stats['reloads']}, occupancy="
+            f"{stats['mean_batch_occupancy']:.1f}, "
+            f"spills={stats['cache_spills']}, "
+            f"promotes={stats['cache_promotes']})",
             file=sys.stderr,
         )
         return {
@@ -855,11 +951,27 @@ def _serve_load(cfg, sessions: int, seconds: float, label: str = "") -> dict:
             "p50_latency_ms": round(p50, 2),
             "p95_latency_ms": round(p95, 2),
             "p99_latency_ms": round(p99, 2),
+            "load_mode": "open" if open_loop else "closed",
+            "arrival_rate": arrival_rate,
+            "slo_ms": slo_ms,
+            "slo_attainment": round(slo_attainment, 4),
+            "warmup_requests": warmup_requests,
+            "errors": errors,
+            "rejected": stats["rejected"],
+            "serve_devices": devices,
             "mean_batch_occupancy": round(stats["mean_batch_occupancy"], 2),
             "bucket_fill": round(stats["bucket_fill"], 3),
             "reloads": stats["reloads"],
             "trace_count": stats["trace_count"],
-            # carry-cache precision footprint (serve/state_cache.py stats)
+            # session-tier traffic (serve/state_cache.py stats)
+            "cache_capacity": stats["cache_capacity"],
+            "cache_hit_rate": round(stats["cache_hit_rate"], 4),
+            "cache_spills": stats["cache_spills"],
+            "cache_promotes": stats["cache_promotes"],
+            "cache_readmits": stats["cache_readmits"],
+            "cache_spill_evictions": stats["cache_spill_evictions"],
+            "spill_sessions": stats["spill_sessions"],
+            # carry-cache precision footprint
             "cache_dtype": stats["cache_dtype"],
             "session_carry_bytes": stats["session_carry_bytes"],
         }
@@ -905,16 +1017,25 @@ def _int8_q_drift(cfg, steps: int = 8, batch: int = 8) -> float:
 def serve_main(
     core: str = "lstm",
     lru_chunk: int = 0,
-    sessions: int = 32,
+    sessions: int = 0,
     seconds: float = 30.0,
     precision: str = "bf16",
+    arrival_rate: float = 200.0,
+    slo_ms: float = 50.0,
+    devices: int = 1,
 ):
     """Serving-plane load test driver. Under --precision bf16/both an fp32
     reference arm runs first, so the headline row carries `vs_fp32` on
     requests/s measured at the identical session load; `both` also
     attaches the fp32 arm's numbers. Reports sustained requests/s plus
-    p50/p95/p99 request latency (submit -> action), batch occupancy,
-    reload count, and the carry-cache precision footprint.
+    p50/p95/p99 request latency (submit -> action), SLO attainment at
+    --slo-ms, batch occupancy, reload count, session-tier spill/promote
+    traffic, and the carry-cache precision footprint.
+
+    The default load is OPEN-LOOP (--arrival-rate > 0, Poisson arrivals,
+    sessions ≫ cache capacity — see _serve_load); --arrival-rate 0
+    restores the closed-loop session-thread arm. `sessions` 0 = auto:
+    256 open-loop (8x the derived cache capacity), 32 closed-loop.
 
     No baseline row exists yet for serving — vs_baseline is null until a
     BENCH_*.json round records the first trajectory point.
@@ -925,6 +1046,7 @@ def serve_main(
     on requests/s plus `q_drift_vs_fp32` — the bounded-parity drift
     column, measured by a deterministic recurrent probe (_int8_q_drift)
     rather than inferred from the load arms' divergent action streams."""
+    sessions = sessions or (256 if arrival_rate > 0 else 32)
     head_arm = "bf16" if precision in ("bf16", "both") else "fp32"
     if head_arm == "fp32":
         arm_names = ["fp32"]
@@ -940,7 +1062,9 @@ def serve_main(
         )
         if arm == "int8":
             cfg = cfg.replace(serve_quantization="int8")
-        arms[arm] = _serve_load(cfg, sessions, seconds, label=arm)
+        arms[arm] = _serve_load(cfg, sessions, seconds, label=arm,
+                                arrival_rate=arrival_rate, slo_ms=slo_ms,
+                                devices=devices)
     head = arms[head_arm]
     vs_fp32 = head["value"] / arms["fp32"]["value"]
     if head_arm != "fp32":
@@ -1241,12 +1365,31 @@ if __name__ == "__main__":
         help="tiered plane: replay capacity in transitions (host RAM)",
     )
     p.add_argument(
-        "--sessions", type=int, default=32,
-        help="serve mode: concurrent stateful client sessions",
+        "--sessions", type=int, default=0,
+        help="serve mode: stateful client session population (0 = auto: "
+             "256 open-loop so sessions ≫ cache capacity, 32 closed-loop)",
     )
     p.add_argument(
         "--serve-seconds", type=float, default=30.0,
         help="serve mode: measurement window (a hot reload fires halfway)",
+    )
+    p.add_argument(
+        "--arrival-rate", type=float, default=200.0,
+        help="serve mode: open-loop Poisson arrival rate in requests/s — "
+             "offered load does not throttle when the server queues, so "
+             "tail latency under overload is measured honestly. 0 = the "
+             "legacy closed-loop session threads",
+    )
+    p.add_argument(
+        "--slo-ms", type=float, default=50.0,
+        help="serve mode: latency SLO for the slo_attainment row "
+             "(fraction of post-warmup requests answered within this; "
+             "rejected/errored requests count as misses)",
+    )
+    p.add_argument(
+        "--serve-devices", type=int, default=1,
+        help="serve mode: replicate the serve stack over N local devices "
+             "with session-affinity routing (serve/multi.py)",
     )
     args = p.parse_args()
     precision = args.precision or (
@@ -1258,7 +1401,9 @@ if __name__ == "__main__":
         breakdown_main(args.core, args.lru_chunk, args.batch, precision)
     elif args.mode == "serve":
         serve_main(args.core, args.lru_chunk, args.sessions,
-                   args.serve_seconds, precision)
+                   args.serve_seconds, precision,
+                   arrival_rate=args.arrival_rate, slo_ms=args.slo_ms,
+                   devices=args.serve_devices)
     elif args.mode == "system":
         system_main(args.core, args.lru_chunk, precision)
     elif args.mode == "fused":

@@ -256,6 +256,49 @@ def act_select_jaxpr(precision: str, num_envs: int = 4) -> str:
 
 
 @functools.lru_cache(maxsize=None)
+def _multi_serve_server(precision: str, quantization: str = "none",
+                        dp: int = 2):
+    from r2d2_tpu.serve.multi import MultiDeviceServer
+    from r2d2_tpu.serve.server import ServeConfig
+
+    cfg = _cfg(precision).replace(
+        serve_quantization=quantization, serve_devices=dp, serve_spill=4,
+    )
+    # smallest legal multi-serve plane: one bucket per replica, spill tier
+    # on (so the traced step is the one the spilling server runs); never
+    # started
+    return MultiDeviceServer(cfg, ServeConfig(buckets=(2,), cache_capacity=2))
+
+
+@functools.lru_cache(maxsize=None)
+def multi_serve_step_jaxpr(precision: str, quantization: str = "none",
+                           dp: int = 2, replica: int = 0) -> str:
+    """Jaxpr text of one replica's serve step in the multi-device server
+    (serve/multi.py) at the smallest bucket. Call once per replica: the
+    texts must agree (tracing is placement-independent; a difference means
+    a replica's step closed over device-dependent state)."""
+    import jax
+
+    cfg = _cfg(precision)
+    server = _multi_serve_server(precision, quantization, dp)
+    rep = server.replicas[replica]
+    bucket = rep.batcher.buckets[0]
+    h, c, la, lr = rep.cache.arrays()
+    sds = jax.ShapeDtypeStruct
+    return str(
+        jax.make_jaxpr(rep._step)(
+            rep._published[0], h, c, la, lr,
+            sds((bucket, *cfg.obs_shape), np.uint8),
+            sds((bucket,), np.float32),
+            sds((bucket,), np.int32),
+            sds((bucket,), bool),
+            sds((bucket,), bool),
+            sds((bucket,), np.int32),
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
 def _serve_server(precision: str, quantization: str = "none"):
     from r2d2_tpu.serve.server import PolicyServer, ServeConfig
 
@@ -316,6 +359,29 @@ def check_no_bf16(jaxpr_text: str, label: str) -> List[Finding]:
                 "contract (precision='fp32') is broken",
                 hint="a cast to cfg.resolved_compute_dtype is leaking; the "
                 "golden path must stay float32 end to end",
+            )
+        ]
+    return []
+
+
+def check_no_host_callback(jaxpr_text: str, label: str) -> List[Finding]:
+    """No host callbacks inside a hot compiled step: a pure_callback /
+    io_callback / debug_callback primitive means every execution round-
+    trips to Python on the host — a per-batch sync that serializes the
+    device against the GIL (the serve step must stay device-only between
+    the batch's H2D lift and the result's D2H readback)."""
+    hits = [
+        name for name in ("pure_callback", "io_callback", "debug_callback")
+        if name in jaxpr_text
+    ]
+    if hits:
+        return [
+            _finding(
+                "jaxpr-host-callback", label,
+                f"traced program contains host callback primitive(s) "
+                f"{hits}: every execution blocks on a Python round trip",
+                hint="move the host-side work outside the jitted step "
+                "(batch formation / commit), or precompute it as an input",
             )
         ]
     return []
@@ -702,12 +768,60 @@ def scan_serve_step_int8(precision: str = "fp32") -> List[Finding]:
     return out
 
 
+def scan_multi_serve_step(precision: str, quantization: str = "none",
+                          dp: int = 2) -> List[Finding]:
+    """The multi-device serve step (serve/multi.py): every replica's
+    jitted step must keep the single-device contracts — no f64, no host
+    sync (callback primitives) inside the per-device step, int8 weights
+    present under the quantized arm — AND all replicas must trace to the
+    IDENTICAL program, which is what makes per-session results replica-
+    independent (bit-parity with the single-device act path is then a
+    placement property, pinned dynamically by tests/test_serve.py).
+    No-op when the platform has fewer than dp devices."""
+    import jax
+
+    if len(jax.local_devices()) < dp:
+        return []
+    out: List[Finding] = []
+    texts = []
+    for i in range(dp):
+        label = f"multi_serve_step[d{i}/{dp},{quantization},{precision}]"
+        text = multi_serve_step_jaxpr(precision, quantization, dp, i)
+        texts.append(text)
+        out += check_no_float64(text, label)
+        out += check_no_host_callback(text, label)
+        if quantization == "int8":
+            out += check_int8_weights(text, label)
+        if precision == "fp32":
+            out += check_no_bf16(text, label)
+    # object reprs inside the text (custom_jvp thunks) carry memory
+    # addresses that differ per trace; strip them before comparing
+    import re
+
+    normalized = {re.sub(r"0x[0-9a-f]+", "0x", t) for t in texts}
+    if len(normalized) > 1:
+        out.append(
+            _finding(
+                "jaxpr-replica-divergence",
+                f"multi_serve_step[{quantization},{precision}]",
+                f"the {dp} serve replicas traced to different programs: "
+                "a replica's step closed over device- or index-dependent "
+                "state, so per-session results depend on placement",
+                hint="the step must be a pure function of (params, stores, "
+                "batch inputs); placement belongs to the buffers, not the "
+                "program",
+            )
+        )
+    return out
+
+
 def scan_serve_step(precision: str) -> List[Finding]:
     import jax
 
     label = f"serve_step[{precision}]"
     text = serve_step_jaxpr(precision)
     out = check_no_float64(text, label)
+    out += check_no_host_callback(text, label)
     if precision == "fp32":
         out += check_no_bf16(text, label)
     # q must come back f32 for the host-side argpartition/audit path
@@ -768,9 +882,11 @@ def scan_entry_points(
         out += scan_act_select(p)
         out += scan_fused_unroll(p)
         out += scan_serve_step(p)
+        out += scan_multi_serve_step(p)
         out += scan_donation(p)
     # the quantized arm composes with precision the same way everywhere;
     # one trace on the golden path keeps the gate's runtime bounded
     out += scan_serve_step_int8("fp32")
+    out += scan_multi_serve_step("fp32", "int8")
     out.sort(key=Finding.sort_key)
     return out

@@ -142,6 +142,29 @@ class R2D2Config:
     # this knob. Default off.
     serve_quantization: str = "none"  # "none" | "int8"
 
+    # Serve-plane session spill tier (serve/state_cache.py). The HBM
+    # session cache is fixed-capacity; without a spill tier an LRU-evicted
+    # session restarts from zero carry when it returns — exactly the
+    # burn-in state the R2D2 policy needs (the paper's stored-state
+    # argument applies to serving too). serve_spill > 0 preallocates a
+    # host-RAM slab of that many sessions (np.zeros is lazy on Linux, so
+    # a multi-million-session slab costs physical pages only as it
+    # fills): eviction DEMOTES (h, c, last_action, last_reward) into the
+    # slab, a returning session PROMOTES it back bit-exactly (dtype
+    # preserved, fp32 and bf16 alike), and only never-seen (or
+    # spill-evicted) sessions start fresh. Addressable sessions become
+    # host-memory-bound instead of HBM-bound. 0 keeps PR-2 semantics:
+    # evicted sessions readmit fresh.
+    serve_spill: int = 0
+    # Serve-plane replication (serve/multi.py). > 1 runs one full serve
+    # stack (session cache + micro-batcher + supervised serve loop) per
+    # local device with session-affinity routing in front: a session's
+    # carry lives on exactly ONE device, new sessions hash to the
+    # least-loaded replica, and checkpoint hot-reload publishes to all
+    # replicas in one pass (int8 re-quantization included). Each replica
+    # keeps the compile-once-per-bucket property independently.
+    serve_devices: int = 1
+
     # Fused-sequence training semantics for the LSTM core: the T-step
     # unroll treats each row's burn-in prefix as state-refresh only — a
     # stop-gradient seam at burn_in[b] cuts the backward pass so burn-in
@@ -342,6 +365,16 @@ class R2D2Config:
                 "'none' serves checkpoint params as-is, 'int8' enables "
                 "publish-time per-channel weight quantization on the serve "
                 "plane (ops/quantize.py)"
+            )
+        if self.serve_spill < 0:
+            raise ValueError(
+                "serve_spill is the host-RAM session spill capacity in "
+                "sessions; it must be >= 0 (0 disables the spill tier)"
+            )
+        if self.serve_devices < 1:
+            raise ValueError(
+                "serve_devices must be >= 1 (replicas of the serve stack "
+                "over local devices, serve/multi.py)"
             )
         if self.lstm_backend not in ("auto", "scan", "pallas"):
             raise ValueError(f"unknown lstm_backend {self.lstm_backend!r}")

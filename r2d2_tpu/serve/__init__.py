@@ -9,6 +9,7 @@ turning a trained R2D2 checkpoint into a low-latency policy service.
 
 from r2d2_tpu.serve.batcher import MicroBatcher, QueueFullError, ServeRequest
 from r2d2_tpu.serve.client import LocalClient, PolicyClient
+from r2d2_tpu.serve.multi import MultiDeviceServer, SessionRouter
 from r2d2_tpu.serve.server import (
     PolicyServer,
     ServeConfig,
@@ -20,6 +21,7 @@ from r2d2_tpu.serve.state_cache import RecurrentStateCache
 __all__ = [
     "LocalClient",
     "MicroBatcher",
+    "MultiDeviceServer",
     "PolicyClient",
     "PolicyServer",
     "QueueFullError",
@@ -27,5 +29,6 @@ __all__ = [
     "ServeConfig",
     "ServeRequest",
     "ServeResult",
+    "SessionRouter",
     "reference_act",
 ]

@@ -23,6 +23,7 @@ import time
 
 from r2d2_tpu.config import PRESETS, parse_overrides
 from r2d2_tpu.serve.client import serve_tcp
+from r2d2_tpu.serve.multi import MultiDeviceServer
 from r2d2_tpu.serve.server import PolicyServer, ServeConfig
 from r2d2_tpu.utils.metrics import MetricsLogger
 
@@ -52,11 +53,27 @@ def main(argv=None) -> int:
                    help="checkpoint watcher poll cadence (seconds)")
     p.add_argument("--epsilon", type=float, default=0.0)
     p.add_argument("--metrics", default=None, help="jsonl metrics path")
+    p.add_argument("--devices", type=int, default=None,
+                   help="serve replicas over local devices with session-"
+                        "affinity routing (serve/multi.py); default "
+                        "cfg.serve_devices (1 = single-device server)")
+    p.add_argument("--spill", type=int, default=None,
+                   help="host-RAM spill slab capacity in sessions "
+                        "(default cfg.serve_spill; 0 disables — evicted "
+                        "sessions restart fresh)")
+    p.add_argument("--dryrun", type=int, default=0, metavar="N",
+                   help="serve N synthetic requests in-process (no TCP) "
+                        "and exit 0 — the multi-device smoke path")
     args = p.parse_args(argv)
 
     cfg = PRESETS[args.preset]()
     if args.set:
-        cfg = cfg.replace(**parse_overrides(args.set)).validate()
+        cfg = cfg.replace(**parse_overrides(args.set))
+    if args.devices is not None:
+        cfg = cfg.replace(serve_devices=args.devices)
+    if args.spill is not None:
+        cfg = cfg.replace(serve_spill=args.spill)
+    cfg = cfg.validate()
     serve_cfg = ServeConfig(
         buckets=tuple(args.buckets),
         max_wait_ms=args.max_wait_ms,
@@ -66,10 +83,39 @@ def main(argv=None) -> int:
         epsilon=args.epsilon,
     )
     metrics = MetricsLogger(args.metrics) if args.metrics else None
-    server = PolicyServer(cfg, serve_cfg, checkpoint_dir=args.ckpt, metrics=metrics)
+    if cfg.serve_devices > 1:
+        server = MultiDeviceServer(cfg, serve_cfg, checkpoint_dir=args.ckpt,
+                                   metrics=metrics)
+        print(f"[serve] {cfg.serve_devices} replicas: "
+              f"{[str(d) for d in server.devices]}", file=sys.stderr)
+    else:
+        server = PolicyServer(cfg, serve_cfg, checkpoint_dir=args.ckpt,
+                              metrics=metrics)
     print(f"[serve] warming up {len(serve_cfg.buckets)} bucket shapes", file=sys.stderr)
     server.warmup()
     server.start()
+    if args.dryrun:
+        import numpy as np
+
+        from r2d2_tpu.serve.client import LocalClient
+
+        try:
+            client = LocalClient(server)
+            rng = np.random.default_rng(0)
+            for i in range(args.dryrun):
+                sid = f"dry-{i % max(args.dryrun // 2, 1)}"
+                obs = rng.integers(0, 255, cfg.obs_shape, np.uint8)
+                client.act(sid, obs, reward=0.0, reset=False)
+            server.check()
+            st = server.stats()
+            print(f"[serve] dryrun ok: {args.dryrun} requests, "
+                  f"ckpt_step={st['ckpt_step']} "
+                  f"devices={st.get('serve_devices', 1)}", file=sys.stderr)
+            return 0
+        finally:
+            server.stop()
+            if metrics is not None:
+                metrics.close()
     tcp, _ = serve_tcp(server, host=args.host, port=args.port)
     host, port = tcp.server_address[:2]
     print(

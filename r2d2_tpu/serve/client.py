@@ -28,11 +28,16 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from r2d2_tpu.serve.server import PolicyServer, ServeResult
+from r2d2_tpu.serve.batcher import QueueFullError
+from r2d2_tpu.serve.server import ServeResult
+from r2d2_tpu.utils.faults import TRANSIENT_ERRORS, fault_point, with_retries
 
 
 class LocalClient:
-    def __init__(self, server: PolicyServer, timeout: float = 30.0):
+    """Works against a PolicyServer or a MultiDeviceServer — both expose
+    the same submit/reset_session/evict surface."""
+
+    def __init__(self, server, timeout: float = 30.0):
         self.server = server
         self.timeout = timeout
 
@@ -48,12 +53,12 @@ class LocalClient:
         self.server.reset_session(session_id)
 
     def evict(self, session_id: str) -> None:
-        self.server.cache.evict(session_id)
+        self.server.evict(session_id)
 
 
 class _RequestHandler(socketserver.StreamRequestHandler):
     def handle(self) -> None:
-        server: PolicyServer = self.server.policy_server  # type: ignore[attr-defined]
+        server = self.server.policy_server  # type: ignore[attr-defined]
         for line in self.rfile:
             line = line.strip()
             if not line:
@@ -61,7 +66,7 @@ class _RequestHandler(socketserver.StreamRequestHandler):
             try:
                 req = json.loads(line)
                 if req.get("cmd") == "evict":
-                    server.cache.evict(str(req["session"]))
+                    server.evict(str(req["session"]))
                     resp = {"ok": True}
                 else:
                     # host-side JSON decode, no device values in sight
@@ -91,7 +96,7 @@ class _TCPServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
-def serve_tcp(server: PolicyServer, host: str = "127.0.0.1",
+def serve_tcp(server, host: str = "127.0.0.1",
               port: int = 0) -> Tuple[_TCPServer, threading.Thread]:
     """Start the JSON-lines frontend on (host, port); port 0 picks a free
     one (read it back from ``tcp.server_address``). Returns the live
@@ -106,22 +111,80 @@ def serve_tcp(server: PolicyServer, host: str = "127.0.0.1",
 
 class PolicyClient:
     """Blocking JSON-lines TCP client; one socket, one session stream at a
-    time per instance (open one client per concurrent session)."""
+    time per instance (open one client per concurrent session).
+
+    Transient trouble is retried in the client, not surfaced: a full serve
+    queue (`QueueFullError` answered in-band) and socket-level errors
+    (reset/refused/closed connections — reconnected between attempts) go
+    through the shared `utils/faults.with_retries` backoff policy under
+    the `serve.client` fault site, so each retry shows up in
+    `retry_stats()` like every other retried boundary. The final
+    attempt's error propagates — retries bound tail latency, they do not
+    hide a down server. `retries=1` restores fail-fast behavior."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 timeout: float = 30.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+                 timeout: float = 30.0, retries: int = 3):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.retries = max(int(retries), 1)
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        )
         self._rfile = self._sock.makefile("rb")
 
-    def _round_trip(self, payload: dict) -> dict:
-        self._sock.sendall((json.dumps(payload) + "\n").encode())
-        line = self._rfile.readline()
+    def _disconnect(self) -> None:
+        try:
+            if self._rfile is not None:
+                self._rfile.close()
+        except OSError:
+            pass
+        finally:
+            self._rfile = None
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        finally:
+            self._sock = None
+
+    def _attempt(self, payload: dict) -> dict:
+        fault_point("serve.client")
+        if self._sock is None:
+            self._connect()
+        try:
+            self._sock.sendall((json.dumps(payload) + "\n").encode())
+            line = self._rfile.readline()
+        except OSError:
+            # dead socket: drop it so the next attempt reconnects
+            self._disconnect()
+            raise
         if not line:
+            self._disconnect()
             raise ConnectionError("server closed the connection")
         resp = json.loads(line)
-        if "error" in resp:
-            raise RuntimeError(resp["error"])
+        err = resp.get("error")
+        if err is not None:
+            # errors travel in-band; re-raise overload as the typed error
+            # so the retry policy can tell it from a permanent failure
+            if err.startswith("QueueFullError"):
+                raise QueueFullError(err)
+            raise RuntimeError(err)
         return resp
+
+    def _round_trip(self, payload: dict) -> dict:
+        return with_retries(
+            lambda: self._attempt(payload),
+            "serve.client",
+            attempts=self.retries,
+            retry_on=TRANSIENT_ERRORS + (QueueFullError,),
+        )
 
     def act(self, session_id: str, obs, reward: float = 0.0,
             reset: bool = False, want_q: bool = False) -> dict:
@@ -139,10 +202,7 @@ class PolicyClient:
         self._round_trip({"session": session_id, "cmd": "evict"})
 
     def close(self) -> None:
-        try:
-            self._rfile.close()
-        finally:
-            self._sock.close()
+        self._disconnect()
 
     def __enter__(self) -> "PolicyClient":
         return self

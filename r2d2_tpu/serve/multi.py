@@ -1,0 +1,347 @@
+"""Multi-chip serving: replicated serve stacks with session affinity.
+
+The SEED RL shape (Espeholt et al. 2020) at the chip level: one
+`PolicyServer` per local device — each with its OWN micro-batcher, session
+cache (plus host spill tier), jitted step, and supervised serve loop — and
+a `SessionRouter` in front that pins every session to exactly one replica.
+A session's recurrent carry lives on exactly one device, so routing a
+request anywhere else would silently restart the session from zero state;
+affinity is therefore correctness, not just locality.
+
+Routing rules (documented in ARCHITECTURE.md):
+
+- a session already mapped goes to its mapped replica, always;
+- a NEW session goes to the least-loaded replica (by tracked session
+  count), tie-broken by a stable hash (crc32 of the session id) so equal
+  loads still spread deterministically;
+- the affinity map is itself LRU-bounded to the total session capacity of
+  the fleet (HBM rows + spill rows per replica): a session old enough to
+  fall out of the map has necessarily also aged out of its replica's cache
+  AND slab, so re-hashing it elsewhere loses nothing.
+
+Each replica keeps the compile-once-per-bucket property independently (its
+jitted step is specialized to its own device; `trace_count` per replica
+stays <= len(buckets)). Hot reload is published to ALL replicas under one
+shared version number inside one critical section: the checkpoint is
+restored ONCE on host, then `PolicyServer.publish` runs per replica
+(re-quantizing per replica under serve_quantization="int8" and placing
+params on that replica's device) — each replica's swap is a single atomic
+attribute write, and no two reloads interleave, so replicas can never end
+up on different versions once a reload returns.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from collections import OrderedDict
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.learner import init_train_state
+from r2d2_tpu.serve.server import PolicyServer, ServeConfig
+from r2d2_tpu.utils.checkpoint import latest_checkpoint_step, restore_checkpoint
+from r2d2_tpu.utils.faults import Backoff, InjectedFault, fault_point
+from r2d2_tpu.utils.metrics import MetricsLogger
+from r2d2_tpu.utils.supervision import Supervisor
+
+
+class SessionRouter:
+    """Session -> replica affinity with least-loaded placement for new
+    sessions. Thread-safe: any client thread may route concurrently."""
+
+    def __init__(self, n_replicas: int, max_tracked: int = 0):
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.n_replicas = n_replicas
+        # 0 = unbounded; otherwise LRU-drop the stalest affinity once the
+        # map outgrows the fleet's total session capacity (see module doc)
+        self.max_tracked = max_tracked
+        self._map: "OrderedDict[str, int]" = OrderedDict()
+        self._counts = [0] * n_replicas
+        self._lock = threading.Lock()
+        self.routed = 0      # total route() calls
+        self.new_routes = 0  # sessions placed for the first time
+        self.dropped = 0     # affinities LRU-dropped from the map
+
+    def route(self, session_id: str) -> int:
+        """The replica index this session's requests must go to."""
+        with self._lock:
+            replica = self._map.get(session_id)
+            if replica is None:
+                self.new_routes += 1
+                lo = min(self._counts)
+                ties = [i for i, c in enumerate(self._counts) if c == lo]
+                replica = ties[zlib.crc32(session_id.encode()) % len(ties)]
+                self._counts[replica] += 1
+                self._map[session_id] = replica
+                if self.max_tracked and len(self._map) > self.max_tracked:
+                    _, old_replica = self._map.popitem(last=False)
+                    self._counts[old_replica] -= 1
+                    self.dropped += 1
+            self._map.move_to_end(session_id)
+            self.routed += 1
+            return replica
+
+    def peek(self, session_id: str) -> Optional[int]:
+        """The mapped replica, or None — never creates an affinity."""
+        with self._lock:
+            return self._map.get(session_id)
+
+    def forget(self, session_id: str) -> Optional[int]:
+        """Drop a session's affinity (disconnect); returns the replica it
+        was on, or None."""
+        with self._lock:
+            replica = self._map.pop(session_id, None)
+            if replica is not None:
+                self._counts[replica] -= 1
+            return replica
+
+    def counts(self) -> List[int]:
+        with self._lock:
+            return list(self._counts)
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "router_sessions": len(self._map),
+                "router_counts": list(self._counts),
+                "router_routed": self.routed,
+                "router_new_routes": self.new_routes,
+                "router_dropped": self.dropped,
+            }
+
+
+class MultiDeviceServer:
+    """N PolicyServer replicas (one per device) behind a SessionRouter.
+
+    Mirrors the single-server lifecycle — construct, `warmup()`,
+    `start()`, `submit()`/client wrappers, `check()`, `stop()` — so
+    bench.py and the CLI treat either interchangeably. The checkpoint
+    watcher lives HERE (replicas start with watch_checkpoints=False): one
+    restore per new step, one shared version, published to every replica.
+    """
+
+    def __init__(
+        self,
+        cfg: R2D2Config,
+        serve_cfg: ServeConfig = ServeConfig(),
+        params=None,
+        checkpoint_dir: Optional[str] = None,
+        metrics: Optional[MetricsLogger] = None,
+        devices: Optional[Sequence] = None,
+    ):
+        if devices is None:
+            local = jax.local_devices()
+            if cfg.serve_devices > len(local):
+                raise ValueError(
+                    f"serve_devices={cfg.serve_devices} but only "
+                    f"{len(local)} local devices are visible"
+                )
+            devices = local[: cfg.serve_devices]
+        if len(devices) < 1:
+            raise ValueError("need at least one device")
+        self.cfg = cfg
+        self.serve_cfg = serve_cfg
+        self.checkpoint_dir = checkpoint_dir
+        self.metrics = metrics
+        self.devices = tuple(devices)
+
+        # restore ONCE for the whole fleet (replicas are handed raw host
+        # params; each publish places/quantizes per device)
+        self.net, self._template = init_train_state(
+            cfg, jax.random.PRNGKey(serve_cfg.seed)
+        )
+        ckpt_step = -1
+        if params is None:
+            if checkpoint_dir is not None and \
+                    latest_checkpoint_step(checkpoint_dir) is not None:
+                state, _, _ = restore_checkpoint(checkpoint_dir, self._template)
+                params, ckpt_step = state.params, int(state.step)
+            else:
+                params = self._template.params  # fresh init (smoke serving)
+        self._params_host = params  # raw (unquantized) host-side params
+
+        self.replicas: List[PolicyServer] = [
+            PolicyServer(
+                cfg, serve_cfg, params=params, metrics=metrics,
+                device=d, name=f"d{i}",
+            )
+            for i, d in enumerate(self.devices)
+        ]
+        # replicas published version 0 at ckpt_step -1 in their own
+        # __init__; re-publish with the restored step so provenance is
+        # right from the first batch (version stays 0 — same params)
+        self._reload_lock = threading.Lock()
+        self._version = 0
+        self._ckpt_step = ckpt_step
+        if ckpt_step >= 0:
+            for r in self.replicas:
+                r.publish(params, ckpt_step, version=0)
+
+        per_replica = serve_cfg.cache_capacity + cfg.serve_spill
+        self.router = SessionRouter(
+            len(self.replicas), max_tracked=per_replica * len(self.replicas)
+        )
+        self.reloads = 0
+        self.reload_errors = 0
+        self._watch_backoff = Backoff(
+            base=serve_cfg.poll_interval_s, factor=2.0,
+            max_delay=max(30.0, serve_cfg.poll_interval_s),
+        )
+        self.supervisor: Optional[Supervisor] = None
+
+    # ------------------------------------------------------------- serving
+
+    def submit(self, session_id: str, obs, reward: float = 0.0,
+               reset: bool = False) -> Future:
+        """Route to the session's replica (placing a new session on the
+        least-loaded one) and enqueue on that replica's batcher."""
+        replica = self.router.route(session_id)
+        return self.replicas[replica].submit(
+            session_id, obs, reward=reward, reset=reset
+        )
+
+    def replica_for(self, session_id: str) -> Optional[PolicyServer]:
+        """The replica currently owning this session (None if unrouted)."""
+        idx = self.router.peek(session_id)
+        return None if idx is None else self.replicas[idx]
+
+    def reset_session(self, session_id: str) -> None:
+        """Zero a session's carry on its owning replica. Affinity is kept:
+        reset means 'start the episode over', not 'disconnect'."""
+        idx = self.router.peek(session_id)
+        if idx is not None:
+            self.replicas[idx].reset_session(session_id)
+
+    def evict(self, session_id: str) -> None:
+        """Disconnect: free the session everywhere (HBM slot, spill row,
+        affinity entry)."""
+        idx = self.router.forget(session_id)
+        if idx is not None:
+            self.replicas[idx].cache.evict(session_id)
+
+    # ----------------------------------------------------------- hot reload
+
+    def reload_now(self) -> bool:
+        """One reload check for the whole fleet: restore the latest step
+        once, publish to every replica under one shared version inside one
+        critical section. Returns True if new params went live."""
+        fault_point("serve.reload")
+        step = latest_checkpoint_step(self.checkpoint_dir)
+        if step is None or step == self._ckpt_step:
+            return False
+        state, _, _ = restore_checkpoint(self.checkpoint_dir, self._template, step)
+        with self._reload_lock:
+            version = self._version + 1
+            for r in self.replicas:
+                r.publish(state.params, int(state.step), version=version)
+            self._params_host = state.params
+            self._version = version
+            self._ckpt_step = int(state.step)
+        self.reloads += 1
+        return True
+
+    def _watch_iteration(self) -> None:
+        # mirrors PolicyServer._watch_iteration: bounded work per call,
+        # exponential backoff on transient restore trouble
+        try:
+            self.reload_now()
+        except (OSError, InjectedFault):
+            self.reload_errors += 1
+            wait = self._watch_backoff.fail()
+        else:
+            self._watch_backoff.reset()
+            wait = self.serve_cfg.poll_interval_s
+        if self.supervisor is not None:
+            self.supervisor.stop.wait(wait)
+        else:
+            time.sleep(wait)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def warmup(self) -> None:
+        """Pre-trace every bucket on every replica (each device compiles
+        its own per-bucket step)."""
+        for r in self.replicas:
+            r.warmup()
+
+    def start(self, watch_checkpoints: Optional[bool] = None) -> None:
+        if self.supervisor is not None:
+            raise RuntimeError("server already started")
+        if watch_checkpoints is None:
+            watch_checkpoints = self.checkpoint_dir is not None
+        for r in self.replicas:
+            r.start(watch_checkpoints=False)
+        self.supervisor = Supervisor()
+        if watch_checkpoints:
+            self.supervisor.spawn(
+                "ckpt-watcher-multi",
+                lambda: self._watch_iteration(),
+                max_restarts=self.serve_cfg.max_restarts,
+            )
+
+    def check(self) -> Dict[str, int]:
+        out = {"worker_restarts": 0, "worker_stalls": 0}
+        for r in self.replicas:
+            c = r.check()
+            out["worker_restarts"] += c.get("worker_restarts", 0)
+            out["worker_stalls"] += c.get("worker_stalls", 0)
+        if self.supervisor is not None:
+            c = self.supervisor.check()
+            out["worker_restarts"] += c.get("worker_restarts", 0)
+            out["worker_stalls"] += c.get("worker_stalls", 0)
+        return out
+
+    def stop(self, timeout: float = 5.0) -> None:
+        if self.supervisor is not None:
+            self.supervisor.shutdown(timeout)
+            self.supervisor = None
+        for r in self.replicas:
+            r.stop(timeout)
+
+    # ------------------------------------------------------------- metrics
+
+    # counters summed across replicas in stats(); per-replica detail rides
+    # under "replicas" for anyone who needs the breakdown
+    _SUMMED = (
+        "cache_sessions", "cache_evictions", "cache_admissions",
+        "cache_hits", "cache_misses", "cache_readmits", "cache_spills",
+        "cache_promotes", "cache_spill_evictions", "spill_sessions",
+        "requests", "batches", "rejected", "deferrals", "queue_depth",
+        "trace_count", "quantized_leaves",
+    )
+
+    def stats(self) -> Dict[str, object]:
+        per_replica = [r.stats() for r in self.replicas]
+        out: Dict[str, object] = {
+            "serve_devices": len(self.replicas),
+            "ckpt_step": self._ckpt_step,
+            "params_version": self._version,
+            "reloads": self.reloads,
+            "reload_errors": self.reload_errors,
+            "serve_quantization": self.cfg.serve_quantization,
+        }
+        for key in self._SUMMED:
+            out[key] = sum(s.get(key, 0) for s in per_replica)
+        lookups = out["cache_hits"] + out["cache_misses"]
+        out["cache_hit_rate"] = out["cache_hits"] / lookups if lookups else 0.0
+        # fleet-level batch shape economics from the raw batcher sums (the
+        # per-replica means can't be averaged without their weights)
+        batches = sum(r.batcher.batches for r in self.replicas)
+        occ = sum(r.batcher.occupancy_sum for r in self.replicas)
+        padded = sum(r.batcher.padded_sum for r in self.replicas)
+        out["mean_batch_occupancy"] = occ / max(batches, 1)
+        out["bucket_fill"] = occ / max(padded, 1)
+        cache0 = self.replicas[0].cache
+        out["cache_dtype"] = cache0.dtype.name
+        out["session_carry_bytes"] = cache0.session_carry_bytes
+        out["cache_capacity"] = cache0.capacity * len(self.replicas)
+        out["spill_capacity"] = cache0.spill_capacity * len(self.replicas)
+        out.update(self.router.stats())
+        out["replicas"] = per_replica
+        return out

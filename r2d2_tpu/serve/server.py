@@ -132,11 +132,18 @@ class PolicyServer:
         params=None,
         checkpoint_dir: Optional[str] = None,
         metrics: Optional[MetricsLogger] = None,
+        device=None,
+        name: str = "",
     ):
         self.cfg = cfg
         self.serve_cfg = serve_cfg
         self.checkpoint_dir = checkpoint_dir
         self.metrics = metrics
+        # replica placement (serve/multi.py): params + session rows live on
+        # exactly this device; None keeps jax's default (single-device)
+        self.device = device
+        # worker-name suffix so multi-device supervisors tell replicas apart
+        self.name = name
 
         self.net, self._template = init_train_state(cfg, jax.random.PRNGKey(serve_cfg.seed))
         ckpt_step = -1
@@ -155,9 +162,8 @@ class PolicyServer:
         # the atomic hot-reload cell: ONE attribute holding ONE tuple, read
         # once per batch — Python attribute reads are atomic, so a batch
         # sees exactly one (params, step, version) triple, never a mix
-        self._published: Tuple[object, int, int] = (
-            self._prepare_params(params), ckpt_step, 0
-        )
+        self._published: Tuple[object, int, int] = (None, ckpt_step, -1)
+        self.publish(params, ckpt_step, version=0)
 
         if serve_cfg.cache_capacity < max(serve_cfg.buckets):
             # a batch's own admissions must never evict a co-batched
@@ -168,9 +174,12 @@ class PolicyServer:
                 f"largest batch bucket ({max(serve_cfg.buckets)})"
             )
         # carries cache at cfg.state_dtype (bf16 under precision="bf16"):
-        # half the per-session HBM and gather/scatter bytes per batch
+        # half the per-session HBM and gather/scatter bytes per batch.
+        # cfg.serve_spill > 0 adds the host spill tier: evicted sessions
+        # demote to a host-RAM slab and promote back carry-intact.
         self.cache = RecurrentStateCache(
-            serve_cfg.cache_capacity, cfg.hidden_dim, dtype=cfg.state_dtype
+            serve_cfg.cache_capacity, cfg.hidden_dim, dtype=cfg.state_dtype,
+            spill_capacity=cfg.serve_spill, device=device,
         )
         self.batcher = MicroBatcher(
             buckets=serve_cfg.buckets,
@@ -204,6 +213,19 @@ class PolicyServer:
 
             params, self.quantized_leaves = quantize_tree(params)
         return params
+
+    def publish(self, params, ckpt_step: int, version: Optional[int] = None) -> None:
+        """Atomically publish a param set to this server/replica: prepare
+        (int8 re-quantization when enabled), place on this replica's
+        device, then swap the publish cell in ONE attribute write. The
+        multi-device server calls this per replica with an explicit shared
+        version so all replicas advance in lockstep."""
+        prepared = self._prepare_params(params)
+        if self.device is not None:
+            prepared = jax.device_put(prepared, self.device)
+        if version is None:
+            version = self._published[2] + 1
+        self._published = (prepared, int(ckpt_step), version)
 
     def _build_step(self):
         net = self.net
@@ -257,6 +279,12 @@ class PolicyServer:
 
     def reset_session(self, session_id: str) -> None:
         self.cache.reset(session_id)
+
+    def evict(self, session_id: str) -> None:
+        """Disconnect: free the session's HBM slot and any spill row.
+        Same surface as MultiDeviceServer.evict so clients (LocalClient,
+        the TCP handler) work against either server unchanged."""
+        self.cache.evict(session_id)
 
     def _run_batch(self, batch: List[ServeRequest]) -> None:
         self._inflight = batch
@@ -377,10 +405,7 @@ class PolicyServer:
         if step is None or step == self._published[1]:
             return False
         state, _, _ = restore_checkpoint(self.checkpoint_dir, self._template, step)
-        _, _, version = self._published
-        self._published = (
-            self._prepare_params(state.params), int(state.step), version + 1
-        )
+        self.publish(state.params, int(state.step))
         self.reloads += 1
         return True
 
@@ -413,15 +438,16 @@ class PolicyServer:
         self.supervisor = Supervisor()
         # lambda indirection so tests can monkeypatch _serve_iteration and
         # exercise the restart path on the live worker
+        suffix = f"-{self.name}" if self.name else ""
         self._serve_worker = self.supervisor.spawn(
-            "serve-loop",
+            "serve-loop" + suffix,
             lambda: self._serve_iteration(),
             max_restarts=self.serve_cfg.max_restarts,
             on_restart=self._serve_recover,
         )
         if watch_checkpoints:
             self._watch_worker = self.supervisor.spawn(
-                "ckpt-watcher",
+                "ckpt-watcher" + suffix,
                 lambda: self._watch_iteration(),
                 max_restarts=self.serve_cfg.max_restarts,
             )

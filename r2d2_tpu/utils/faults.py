@@ -61,6 +61,7 @@ KNOWN_SITES = (
     "checkpoint.restore",
     "snapshot.write",
     "serve.reload",
+    "serve.client",
     "reshard.gather",
     "reshard.scatter",
 )

@@ -303,6 +303,55 @@ def test_lock_discipline_clean_when_guarded_everywhere():
     assert findings == []
 
 
+def test_lock_discipline_covers_spill_tier_shape():
+    """The session-tier threaded state (serve/state_cache.py): slab maps
+    and counters written under the cache lock must never be written bare —
+    the exact spill/promote bookkeeping shape, reduced."""
+    src = """
+    import threading
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._spill_slots = {}
+            self._spill_free = []
+            self.spills = 0
+        def demote(self, sid, row):
+            with self._lock:
+                self._spill_slots[sid] = row
+                self.spills += 1
+        def evict(self, sid):
+            row = self._spill_slots.pop(sid, None)  # read: not flagged
+            self.spills = 0  # bare write to guarded counter: flagged
+    """
+    findings, _ = lint(src, path="serve/state_cache.py")
+    assert rules_of(findings) == ["lock-discipline"]
+    assert "spills" in findings[0].message
+
+
+def test_lock_discipline_covers_affinity_router_shape():
+    """The session-affinity map (serve/multi.py SessionRouter): routing
+    writes the sid->replica map and per-replica counts under the router
+    lock from many client threads; a bare write races them."""
+    src = """
+    import threading
+    class Router:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._counts = [0, 0]
+            self.routed = 0
+        def route(self, sid):
+            with self._lock:
+                self.routed += 1
+                self._counts = list(self._counts)
+            return 0
+        def forget(self, sid):
+            self._counts = [0, 0]
+    """
+    findings, _ = lint(src, path="serve/multi.py")
+    assert rules_of(findings) == ["lock-discipline"]
+    assert "_counts" in findings[0].message
+
+
 # ---------------------------------------------------------------- suppression
 
 
@@ -372,6 +421,29 @@ def test_jaxpr_text_checkers_fire_on_synthetic_programs():
     assert j.check_fp32_island("a:bf16[3] b:f32[]", "t") == []
     assert rules_of(j.check_fp32_island("a:f32[3]", "t")) == ["jaxpr-no-bf16-under-bf16"]
     assert rules_of(j.check_fp32_island("a:bf16[3]", "t")) == ["jaxpr-missing-fp32-island"]
+    # host-callback checker: any callback primitive inside a hot step
+    assert j.check_no_host_callback("a:f32[2] = add b c", "t") == []
+    for prim in ("pure_callback", "io_callback", "debug_callback"):
+        assert rules_of(
+            j.check_no_host_callback(f"a:f32[2] = {prim}[...] b", "t")
+        ) == ["jaxpr-host-callback"]
+
+
+def test_multi_serve_step_gate():
+    """Every replica of the dp=2 serve fleet traces to an identical,
+    callback-free, f64-free program at both precisions (plus the int8
+    arm) — the static half of the multi-chip bit-parity story."""
+    import jax
+
+    from r2d2_tpu.analysis import jaxpr_rules as j
+
+    if len(jax.local_devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    for precision in ("fp32", "bf16"):
+        findings = j.scan_multi_serve_step(precision)
+        assert findings == [], render_text(findings)
+    findings = j.scan_multi_serve_step("fp32", "int8")
+    assert findings == [], render_text(findings)
 
 
 def test_donation_checker_fires_on_mismatch():

@@ -23,6 +23,7 @@ from r2d2_tpu.serve import (
     ServeConfig,
     reference_act,
 )
+from r2d2_tpu.serve.batcher import ServeRequest
 from r2d2_tpu.serve.client import serve_tcp
 from r2d2_tpu.serve.state_cache import RecurrentStateCache
 from r2d2_tpu.utils.checkpoint import save_checkpoint
@@ -198,6 +199,80 @@ def test_batcher_same_session_deferred():
     assert [r.session_id for r in second] == ["s"]
     assert b.deferrals == 1
     assert b.bucket_for(1) == 2 and b.bucket_for(3) == 4
+
+
+def _deferred_req(session_id: str) -> "ServeRequest":
+    from concurrent.futures import Future
+
+    return ServeRequest(
+        session_id=session_id, obs=np.zeros(1), reward=0.0, reset=False,
+        future=Future(), t_enqueue=time.monotonic(),
+    )
+
+
+def test_take_deferred_duplicate_sessions():
+    """_take_deferred drains at most ONE deferred request per session into
+    the batch (FIFO within a session), skips sessions already seen in this
+    batch, respects max_batch, and keeps everything else queued in order."""
+    b = MicroBatcher(buckets=(2, 4), max_wait_s=0.001)
+    for sid in ("a", "a", "b", "a", "c"):
+        b._deferred.append(_deferred_req(sid))
+    batch: list = []
+    seen: set = set()
+    b._take_deferred(batch, seen)
+    assert [r.session_id for r in batch] == ["a", "b", "c"]
+    assert [r.session_id for r in b._deferred] == ["a", "a"]  # FIFO kept
+    assert seen == {"a", "b", "c"}
+    # a session already in the forming batch stays deferred
+    batch2: list = []
+    b._take_deferred(batch2, {"a"})
+    assert batch2 == [] and len(b._deferred) == 2
+    # max_batch caps how many deferred requests one batch absorbs
+    b2 = MicroBatcher(buckets=(2,))
+    for sid in ("x", "y", "z"):
+        b2._deferred.append(_deferred_req(sid))
+    batch3: list = []
+    b2._take_deferred(batch3, set())
+    assert [r.session_id for r in batch3] == ["x", "y"]
+    assert [r.session_id for r in b2._deferred] == ["z"]
+
+
+def test_drain_under_concurrent_submits():
+    """drain() racing live submit() threads (the shutdown path) must
+    neither lose nor duplicate a request: every submitted future is
+    recovered exactly once — from a formed batch, a drain, or the
+    queue-full rejection — even with duplicate-session deferrals in
+    flight."""
+    b = MicroBatcher(buckets=(2, 4), max_wait_s=0.001, queue_depth=10_000)
+    n_threads, n_each = 4, 200
+    futures = [[] for _ in range(n_threads)]
+    start = threading.Barrier(n_threads + 1)
+
+    def spam(k: int) -> None:
+        start.wait()
+        for i in range(n_each):
+            # colliding session ids force same-session deferrals
+            futures[k].append(b.submit(f"s{(k * n_each + i) % 3}", np.zeros(1)))
+
+    threads = [threading.Thread(target=spam, args=(k,)) for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    start.wait()
+    recovered: list = []
+    for _ in range(20):  # interleave batch formation and mid-stream drains
+        recovered.extend(b.next_batch(timeout=0.001))
+        recovered.extend(b.drain())
+    for t in threads:
+        t.join(timeout=30.0)
+    recovered.extend(b.drain())  # the final shutdown sweep
+    all_futs = [f for per in futures for f in per]
+    rejected = [f for f in all_futs if f.done()]  # only rejections resolve
+    got = [r.future for r in recovered]
+    assert len(got) == len(set(got)), "a request was drained twice"
+    assert set(got) | set(rejected) == set(all_futs), "a request was lost"
+    assert not set(got) & set(rejected)
+    assert b.stats()["rejected"] == len(rejected) == 0
+    assert b.qsize() == 0
 
 
 def test_batcher_rejects_min_bucket_one():

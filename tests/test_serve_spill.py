@@ -1,0 +1,414 @@
+"""Session spill tier + multi-device serving tests (PR: million-session
+serving). Pins the acceptance criteria: the evict -> demote -> promote
+round trip is bit-exact in fp32 AND bf16 (a spilled-and-returned session
+is indistinguishable from one that never left HBM), a sessions = 8x
+capacity workload sustains carry continuity for EVERY session, the
+multi-device server keeps per-session bit-parity with the direct act path
+on each replica, and hot reload (incl. int8 re-quantize) lands atomically
+across replicas. All CPU tier-1 — conftest forces 8 virtual devices so
+dp=2 runs anywhere."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import tiny_test
+from r2d2_tpu.serve import (
+    LocalClient,
+    MultiDeviceServer,
+    PolicyServer,
+    ServeConfig,
+    SessionRouter,
+)
+from r2d2_tpu.serve.state_cache import RecurrentStateCache
+from r2d2_tpu.utils.checkpoint import save_checkpoint
+from tests.test_serve import SessionReference, _bump_params
+
+
+CFG = tiny_test()
+
+STATE_DTYPES = [
+    pytest.param(jnp.float32, np.uint32, id="fp32"),
+    pytest.param(jnp.bfloat16, np.uint16, id="bf16"),
+]
+
+
+def _bits(x, as_uint):
+    """Bitwise view for exactness asserts (works for fp32 and bf16)."""
+    return np.asarray(x).view(as_uint)
+
+
+# ---------------------------------------------------------- cache spill tier
+
+
+@pytest.mark.parametrize("dtype,as_uint", STATE_DTYPES)
+def test_cache_spill_round_trip_bit_exact(dtype, as_uint):
+    """Evict -> demote -> promote returns the EXACT bytes that left HBM:
+    the slab stores the cache dtype verbatim, so the carry survives the
+    tier crossing bit-for-bit in both precisions."""
+    cache = RecurrentStateCache(capacity=2, hidden_dim=4, dtype=dtype,
+                                spill_capacity=4)
+    (slot_a,), fresh = cache.assign(["a"])
+    assert fresh[0]
+    rng = np.random.default_rng(0)
+    h_a = jnp.asarray(rng.normal(size=(4,)).astype(np.float32)).astype(dtype)
+    c_a = jnp.asarray(rng.normal(size=(4,)).astype(np.float32)).astype(dtype)
+    cache.h = cache.h.at[slot_a].set(h_a)
+    cache.c = cache.c.at[slot_a].set(c_a)
+    cache.last_action = cache.last_action.at[slot_a].set(3)
+    cache.last_reward = cache.last_reward.at[slot_a].set(1.25)
+
+    cache.assign(["b"])
+    cache.assign(["x"])  # capacity 2: "a" is LRU -> demoted to the slab
+    assert "a" not in cache and cache.spilled("a")
+    assert cache.spills == 1
+
+    (slot_a2,), fresh2 = cache.assign(["a"])  # returns: promoted, NOT fresh
+    assert not fresh2[0]
+    assert not cache.spilled("a") and "a" in cache
+    np.testing.assert_array_equal(_bits(cache.h[slot_a2], as_uint), _bits(h_a, as_uint))
+    np.testing.assert_array_equal(_bits(cache.c[slot_a2], as_uint), _bits(c_a, as_uint))
+    assert int(cache.last_action[slot_a2]) == 3
+    assert float(cache.last_reward[slot_a2]) == 1.25
+    st = cache.stats()
+    assert st["cache_readmits"] == 1 and st["cache_promotes"] == 1
+    assert st["cache_spills"] == 2  # "a", then "b" (evicted by a's return)
+    assert st["cache_dtype"] == jnp.dtype(dtype).name
+
+
+def test_cache_promote_survives_same_batch_demote():
+    """The ordering hazard the implementation documents: one assign() that
+    BOTH promotes a returning session and demotes a victim must not hand
+    the promoted session's slab row to the victim before the promote reads
+    it. (Capacity 1 forces promote + demote in every single-miss batch.)"""
+    cache = RecurrentStateCache(capacity=1, hidden_dim=2, spill_capacity=1)
+    (slot,), _ = cache.assign(["a"])
+    h_a = jnp.asarray([[7.0, -7.0]], jnp.float32)
+    cache.h = cache.h.at[slot].set(h_a[0])
+    cache.assign(["b"])      # demotes a into the slab's only row
+    (slot2,), fresh = cache.assign(["a"])  # promotes a AND demotes b
+    assert not fresh[0]
+    np.testing.assert_array_equal(np.asarray(cache.h[slot2]), h_a[0])
+    # b took the freed row (slab has one): nobody was LRU-dropped
+    assert cache.spilled("b") and cache.spill_evictions == 0
+
+
+def test_cache_slab_lru_drop_starts_fresh():
+    cache = RecurrentStateCache(capacity=1, hidden_dim=2, spill_capacity=1)
+    cache.assign(["a"])
+    cache.assign(["b"])  # a -> slab
+    cache.assign(["x"])  # b -> slab, slab full: a dropped for good
+    assert cache.spill_evictions == 1 and not cache.spilled("a")
+    _, fresh = cache.assign(["a"])
+    assert fresh[0]  # the dropped session starts over
+
+
+def test_cache_reset_and_evict_drop_spilled_state():
+    cache = RecurrentStateCache(capacity=1, hidden_dim=2, spill_capacity=4)
+    cache.assign(["a"])
+    cache.assign(["b"])  # a spilled
+    cache.reset("a")     # explicit reset must not resurrect a stale carry
+    assert not cache.spilled("a")
+    _, fresh = cache.assign(["a"])
+    assert fresh[0]
+    cache.assign(["b"])  # a spilled again (b returns, a demoted)
+    assert cache.spilled("a")
+    assert cache.evict("a")  # disconnect frees the slab row too
+    assert not cache.spilled("a")
+    assert len(cache._spill_free) == 4
+
+
+# ----------------------------------------------------------- served round trip
+
+
+@pytest.mark.parametrize("precision", ["fp32", "bf16"])
+def test_served_spill_round_trip_matches_never_evicted(precision):
+    """The acceptance bit-exactness criterion through the SERVED path: a
+    session that is evicted to the host slab and promoted back between
+    every one of its requests answers bit-identically to the same session
+    on a server large enough to never evict it — in fp32 and bf16."""
+    cfg = tiny_test().replace(precision=precision)
+    srv_spill = PolicyServer(
+        cfg.replace(serve_spill=16),
+        ServeConfig(buckets=(2,), max_wait_ms=1.0, cache_capacity=2),
+    )
+    srv_big = PolicyServer(
+        cfg, ServeConfig(buckets=(2,), max_wait_ms=1.0, cache_capacity=64)
+    )  # same seed -> identical params; never evicts
+    for s in (srv_spill, srv_big):
+        s.warmup()
+        s.start()
+    cl_spill, cl_big = LocalClient(srv_spill), LocalClient(srv_big)
+    rng = np.random.default_rng(7)
+    try:
+        for t in range(8):
+            obs = rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8)
+            reward = float(rng.normal())
+            reset = t == 0
+            res_s = cl_spill.act("s", obs, reward=reward, reset=reset)
+            res_b = cl_big.act("s", obs, reward=reward, reset=reset)
+            np.testing.assert_array_equal(
+                np.asarray(res_s.q), np.asarray(res_b.q)
+            )
+            assert res_s.action == res_b.action
+            # push "s" out of the 2-slot cache before its next request
+            cl_spill.act(f"fill-{t}-0", obs, reset=True)
+            cl_spill.act(f"fill-{t}-1", obs, reset=True)
+    finally:
+        srv_spill.stop()
+        srv_big.stop()
+    st = srv_spill.stats()
+    # "s" really crossed the tier between steps — this wasn't a cache hit
+    assert st["cache_readmits"] >= 7 and st["cache_promotes"] >= 7
+    assert st["cache_spills"] >= 7
+    assert st["cache_dtype"] == ("bfloat16" if precision == "bf16" else "float32")
+
+
+def test_sessions_8x_capacity_carry_continuity():
+    """sessions = 8x cache capacity, several round-robin passes: every
+    request misses HBM (reuse distance >> capacity) so every session lives
+    mostly in the slab — yet every response must match the session's
+    uninterrupted direct-act reference exactly."""
+    n_sessions, rounds = 64, 3
+    cfg = tiny_test().replace(serve_spill=n_sessions * 2)
+    srv = PolicyServer(
+        cfg, ServeConfig(buckets=(2, 4, 8), max_wait_ms=1.0, cache_capacity=8)
+    )
+    srv.warmup()
+    srv.start()
+    client = LocalClient(srv)
+    params = srv._published[0]
+    rng = np.random.default_rng(11)
+    refs = [SessionReference(srv.net, cfg.hidden_dim) for _ in range(n_sessions)]
+    try:
+        for rnd in range(rounds):
+            for s in range(n_sessions):
+                obs = rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8)
+                reward = float(rng.normal())
+                reset = rnd == 0
+                res = client.act(f"pop-{s}", obs, reward=reward, reset=reset)
+                q_ref, a_ref = refs[s].step(params, obs, reward, reset)
+                np.testing.assert_array_equal(q_ref, np.asarray(res.q))
+                assert a_ref == res.action
+    finally:
+        srv.stop()
+    st = srv.stats()
+    # after round 1 every request found its state in the slab, never HBM
+    assert st["cache_readmits"] == n_sessions * (rounds - 1)
+    assert st["cache_hits"] == 0
+    assert st["spill_sessions"] <= cfg.serve_spill
+    assert st["cache_spill_evictions"] == 0  # slab sized for the population
+
+
+# ------------------------------------------------------------- session router
+
+
+def test_router_affinity_and_least_loaded():
+    r = SessionRouter(3)
+    first = {sid: r.route(sid) for sid in ("a", "b", "c")}
+    # least-loaded placement spreads 3 new sessions over 3 replicas
+    assert sorted(first.values()) == [0, 1, 2]
+    for sid, rep in first.items():  # affinity: repeat routes never move
+        for _ in range(3):
+            assert r.route(sid) == rep
+    assert r.counts() == [1, 1, 1]
+    assert r.peek("a") == first["a"] and r.peek("nope") is None
+    assert r.forget("a") == first["a"]
+    assert r.peek("a") is None
+    # the freed replica is now least-loaded: the next new session lands there
+    assert r.route("d") == first["a"]
+    st = r.stats()
+    assert st["router_new_routes"] == 4 and st["router_sessions"] == 3
+
+
+def test_router_lru_bound_drops_stalest():
+    r = SessionRouter(2, max_tracked=2)
+    r.route("a")
+    r.route("b")
+    r.route("a")  # touch: "b" is now stalest
+    r.route("c")  # over the bound -> "b" dropped
+    assert r.peek("b") is None and r.peek("a") is not None
+    assert r.dropped == 1
+    assert sum(r.counts()) == 2  # dropped affinity released its count
+
+
+# --------------------------------------------------------------- multi-device
+
+
+needs_dp2 = pytest.mark.skipif(
+    len(jax.local_devices()) < 2, reason="needs >= 2 local devices"
+)
+
+
+@needs_dp2
+def test_multi_device_parity_and_affinity():
+    """dp=2 serving: sessions spread over both replicas, every response is
+    bit-identical to the direct act reference, a session's replica never
+    changes, and each replica keeps the compile-once-per-bucket bound."""
+    cfg = tiny_test().replace(serve_devices=2, serve_spill=16)
+    srv = MultiDeviceServer(
+        cfg, ServeConfig(buckets=(2, 4), max_wait_ms=1.0, cache_capacity=8)
+    )
+    assert len(srv.replicas) == 2
+    srv.warmup()
+    srv.start()
+    client = LocalClient(srv)
+    rng = np.random.default_rng(3)
+    n_sessions, n_steps = 6, 6
+    refs = [SessionReference(srv.net, cfg.hidden_dim) for _ in range(n_sessions)]
+    owners = {}
+    try:
+        for t in range(n_steps):
+            for s in range(n_sessions):
+                sid = f"md-{s}"
+                obs = rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8)
+                reward = float(rng.normal())
+                res = client.act(sid, obs, reward=reward, reset=t == 0)
+                q_ref, a_ref = refs[s].step(srv._params_host, obs, reward, t == 0)
+                np.testing.assert_array_equal(q_ref, np.asarray(res.q))
+                assert a_ref == res.action
+                owner = srv.router.peek(sid)
+                assert owners.setdefault(sid, owner) == owner  # pinned
+    finally:
+        srv.stop()
+    assert srv.router.counts() == [3, 3]  # least-loaded spread
+    for rep in srv.replicas:
+        assert rep.trace_count <= len(rep.batcher.buckets)
+    st = srv.stats()
+    assert st["serve_devices"] == 2
+    assert st["requests"] == n_sessions * n_steps
+    assert st["router_new_routes"] == n_sessions
+    # per-session traffic is a cache hit on its OWN replica after admission
+    assert st["cache_hits"] == n_sessions * (n_steps - 1)
+
+
+@needs_dp2
+@pytest.mark.parametrize("quant", ["none", "int8"])
+def test_multi_device_reload_lockstep(tmp_path, quant):
+    """One reload_now() restores once and publishes to every replica under
+    ONE shared version: steps, versions, and the published params
+    themselves (including the int8 re-quantization) match across replicas
+    after every reload."""
+    cfg = tiny_test().replace(serve_devices=2, serve_quantization=quant)
+    ckpt_dir = str(tmp_path / "ckpt")
+    srv = MultiDeviceServer(
+        cfg, ServeConfig(buckets=(2,), max_wait_ms=1.0, cache_capacity=4),
+        checkpoint_dir=ckpt_dir,
+    )
+
+    def published():
+        return [(r._published[1], r._published[2]) for r in srv.replicas]
+
+    assert published() == [(-1, 0), (-1, 0)]  # fresh init, version lockstep
+    for step, scale in ((1, 1.5), (2, 3.0)):
+        state = _bump_params(srv._template, scale).replace(
+            step=jnp.asarray(step, jnp.int32)
+        )
+        save_checkpoint(ckpt_dir, state, 0, 0.0)
+        assert srv.reload_now()
+        assert published() == [(step, srv._version)] * 2
+        # the replicas hold the SAME prepared params (quantized under int8)
+        trees = [jax.tree.map(np.asarray, r._published[0]) for r in srv.replicas]
+        jax.tree.map(np.testing.assert_array_equal, trees[0], trees[1])
+        if quant == "int8":
+            assert all(r.quantized_leaves > 0 for r in srv.replicas)
+    assert not srv.reload_now()  # nothing new: no spurious version bump
+    assert srv.reloads == 2
+
+
+@needs_dp2
+def test_multi_device_reload_under_traffic(tmp_path):
+    """A checkpoint landing mid-traffic goes live on BOTH replicas through
+    the fleet watcher; every response carries a (version, params) pair
+    that really was published — no torn batches, and every session's
+    stream stays bit-exact under the params version that answered it."""
+    cfg = tiny_test().replace(serve_devices=2)
+    ckpt_dir = str(tmp_path / "ckpt")
+    srv = MultiDeviceServer(
+        cfg,
+        ServeConfig(buckets=(2, 4), max_wait_ms=1.0, cache_capacity=8,
+                    poll_interval_s=0.05),
+        checkpoint_dir=ckpt_dir,
+    )
+    params_by_version = {0: srv._params_host}
+    srv.warmup()
+    srv.start()  # fleet watcher (replicas themselves never watch)
+    client = LocalClient(srv)
+
+    n_sessions = 4
+    stop = threading.Event()
+    records = [[] for _ in range(n_sessions)]  # (obs, reward, reset, result)
+    errors: list = []
+
+    def run_session(i: int) -> None:
+        rng = np.random.default_rng(100 + i)
+        first = True
+        try:
+            while not stop.is_set():
+                obs = rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8)
+                reward = 0.0 if first else float(rng.normal())
+                res = client.act(f"rl-{i}", obs, reward=reward, reset=first)
+                records[i].append((obs, reward, first, res))
+                first = False
+        except Exception as e:  # pragma: no cover - failure detail for CI
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=run_session, args=(i,)) for i in range(n_sessions)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    state = _bump_params(srv._template, 1.25).replace(step=jnp.asarray(1, jnp.int32))
+    save_checkpoint(ckpt_dir, state, 0, 0.0)
+    deadline = time.monotonic() + 20.0
+    while srv._version != 1 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert srv._version == 1, "fleet watcher never picked up the checkpoint"
+    params_by_version[1] = state.params
+    # keep traffic flowing until every session answered under the new params
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        if all(any(r.params_version == 1 for (_, _, _, r) in rec)
+               for rec in records):
+            break
+        time.sleep(0.02)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    srv.check()
+    srv.stop()
+
+    assert not errors, errors
+    assert [r._published[2] for r in srv.replicas] == [1, 1]
+    for i in range(n_sessions):
+        assert any(r.params_version == 1 for (_, _, _, r) in records[i]), (
+            f"session {i} never served by the reloaded params"
+        )
+        ref = SessionReference(srv.net, cfg.hidden_dim)
+        for obs, reward, reset, res in records[i]:
+            assert res.params_version in params_by_version  # never torn
+            q_ref, a_ref = ref.step(
+                params_by_version[res.params_version], obs, reward, reset
+            )
+            np.testing.assert_array_equal(q_ref, np.asarray(res.q))
+            assert a_ref == res.action
+
+
+@needs_dp2
+def test_serve_cli_dryrun_dp2():
+    """The acceptance smoke: `python -m r2d2_tpu.serve --devices 2
+    --dryrun N` completes on CPU devices (exit 0)."""
+    from r2d2_tpu.serve.__main__ import main
+
+    assert main([
+        "--preset", "tiny_test", "--devices", "2", "--spill", "8",
+        "--dryrun", "6", "--buckets", "2", "4", "--cache-capacity", "8",
+        "--max-wait-ms", "1.0",
+    ]) == 0
