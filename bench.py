@@ -93,11 +93,17 @@ def _core_overrides(core: str, lru_chunk: int) -> dict:
 
 
 def _system_cfg(E: int = 256, core: str = "lstm", lru_chunk: int = 0,
-                precision: str = "bf16"):
+                precision: str = "bf16", priority_plane: str = "host",
+                superstep: int = 1):
     """Shared full-system benchmark config: catch at Atari resolution
     (84x84, device-rendered; this image has no ALE and one host core —
-    SURVEY.md section 2.4), full-size network."""
+    SURVEY.md section 2.4), full-size network. priority_plane/superstep
+    select the round-9 arm: "device" moves the sum tree to HBM and runs
+    sampling + priority write-back in-jit (megastep superstep, host
+    re-enters every superstep*updates_per_dispatch updates)."""
     return default_atari().replace(
+        priority_plane=priority_plane,
+        superstep_dispatches=superstep,
         env_name="catch",
         action_dim=3,
         num_actors=E,
@@ -307,7 +313,8 @@ def fused_system_main(collect_every: int = 6, core: str = "lstm",
     )
 
 
-def system_main(core: str = "lstm", lru_chunk: int = 0, precision: str = "bf16"):
+def system_main(core: str = "lstm", lru_chunk: int = 0, precision: str = "bf16",
+                priority_plane: str = "host", superstep: int = 1):
     """Full-system throughput: on-device collection (collect.py) and the
     K-update learner dispatch sharing ONE chip concurrently — the complete
     TPU-native R2D2 (actor + replay + learner) with no synthetic data.
@@ -315,11 +322,20 @@ def system_main(core: str = "lstm", lru_chunk: int = 0, precision: str = "bf16")
     Env: catch at Atari resolution (84x84, device-rendered; this image has
     no ALE and one host core — SURVEY.md section 2.4), full-size network.
     Prints one JSON line with learner env-frames/s (the BASELINE.md metric)
-    measured WHILE collection sustains its own rate on the same chip."""
+    measured WHILE collection sustains its own rate on the same chip.
+
+    priority_plane="device" is the round-9 A/B arm: sampling + priority
+    write-back run in-jit over the HBM sum tree and the host re-enters
+    every superstep*updates_per_dispatch updates, so the per-update host
+    fence (stratified numpy sample before, D2H read-back + tree scatter
+    after) leaves the loop. The row carries vs_r05 (the round-5 synthetic-
+    feed learner headline, BENCH_r05.json): the pre-registered read is the
+    full-system rate closing on — then passing — the fence-free headline."""
     from r2d2_tpu.train import Trainer
 
     cfg = _system_cfg(core=core, lru_chunk=lru_chunk,
-                      precision="bf16" if precision == "both" else precision)
+                      precision="bf16" if precision == "both" else precision,
+                      priority_plane=priority_plane, superstep=superstep)
     trainer = Trainer(cfg)
     print(f"warmup: filling {cfg.learning_starts} transitions...", file=sys.stderr)
     t0 = time.time()
@@ -368,9 +384,12 @@ def system_main(core: str = "lstm", lru_chunk: int = 0, precision: str = "bf16")
                 "value": round(learner_fps, 1),
                 "unit": "env_frames/s",
                 "vs_baseline": round(learner_fps / BASELINE_FRAMES_PER_SEC, 3),
+                "vs_r05": round(learner_fps / R05_FRAMES_PER_SEC, 3),
                 "concurrent_collection_env_frames_per_sec": round(collect_fps, 1),
                 "core": cfg.recurrent_core + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
                 "precision": cfg.precision,
+                "priority_plane": cfg.priority_plane,
+                "superstep_dispatches": cfg.superstep_dispatches,
             }
         )
     )
@@ -1270,6 +1289,7 @@ def breakdown_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0,
         for name, (fn, args_fn) in programs.items()
     }
     step_ms = times.pop("train_step")
+    host_ms = _priority_host_ms(cfg, B)
     print(
         json.dumps(
             {
@@ -1288,9 +1308,72 @@ def breakdown_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0,
                     }
                     for name, ms in times.items()
                 },
+                # host-thread occupancy of the PRIORITY plane per update,
+                # for both settings of config.priority_plane: "host" pays
+                # a numpy tree sample+update on the host critical path
+                # every update; "device" pays only the dispatch of the
+                # in-jit sample/IS/write-back program (the tree math rides
+                # the device stream)
+                "host_ms_per_update": host_ms,
             }
         )
     )
+
+
+def _priority_host_ms(cfg, B: int, iters: int = 200) -> dict:
+    """Host milliseconds per update spent on the priority plane, for
+    priority_plane=host (numpy sum-tree sample + write-back, synchronous
+    on the host critical path) vs =device (deriving the key and
+    dispatching the in-jit sample/IS-weight/write-back program; async —
+    the device executes off the host thread). Measured on a synthetic
+    full tree at the config's exponents."""
+    from functools import partial
+
+    import jax.numpy as jnp
+
+    from r2d2_tpu.replay import device_sum_tree as dst
+    from r2d2_tpu.replay.sum_tree import SumTree
+
+    cap = min(cfg.num_sequences, 1 << 16)
+    rng = np.random.default_rng(0)
+    prios = (rng.random(cap) + 0.1).astype(np.float32)
+
+    host_tree = SumTree(cap, cfg.prio_exponent, cfg.is_exponent)
+    host_tree.update(np.arange(cap), prios)
+    for _ in range(3):  # warm numpy paths
+        idxes, _ = host_tree.sample(B, rng)
+        host_tree.update(idxes, (rng.random(B) + 0.1).astype(np.float32))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        idxes, _ = host_tree.sample(B, rng)
+        host_tree.update(idxes, (rng.random(B) + 0.1).astype(np.float32))
+    host_ms = (time.perf_counter() - t0) / iters * 1e3
+
+    L = dst.tree_layers(cap)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def dev_update(tree, key):
+        ks, kp = jax.random.split(key)
+        leaf = dst.tree_sample(tree, L, B, ks)
+        _ = dst.is_weights(tree, L, leaf, cfg.is_exponent)
+        td = jax.random.uniform(kp, (B,), jnp.float32) + 0.1
+        return dst.tree_update(tree, L, leaf, td, cfg.prio_exponent)
+
+    dtree = dst.tree_from_leaves(prios, cap)
+    base = jax.random.PRNGKey(0)
+    dtree = jax.block_until_ready(dev_update(dtree, base))  # compile
+    t0 = time.perf_counter()
+    for i in range(iters):
+        dtree = dev_update(dtree, jax.random.fold_in(base, i))
+    dispatch_ms = (time.perf_counter() - t0) / iters * 1e3
+    jax.block_until_ready(dtree)
+    out = {
+        "priority_plane=host": round(host_ms, 4),
+        "priority_plane=device": round(dispatch_ms, 4),
+    }
+    for k, v in out.items():
+        print(f"[breakdown] priority host ms/update ({k}): {v}", file=sys.stderr)
+    return out
 
 
 if __name__ == "__main__":
@@ -1302,11 +1385,20 @@ if __name__ == "__main__":
     # (bench never enabled the cache before round 5). With the cache the
     # number is a stable few seconds after the first-ever run; set
     # R2D2_TPU_NO_COMPILE_CACHE=1 to measure true cold compiles.
-    from r2d2_tpu.utils.compilation_cache import enable_compilation_cache
-
-    enable_compilation_cache()
+    from r2d2_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+        log_compile_cache_stats,
+    )
 
     p = argparse.ArgumentParser(description="r2d2_tpu benchmarks")
+    p.add_argument(
+        "--compile-cache", default=None, metavar="DIR",
+        help="persistent XLA compilation cache directory "
+             "(R2D2_COMPILE_CACHE env var is the same knob; default: "
+             "repo-local .jax_cache on accelerator backends; "
+             "R2D2_TPU_NO_COMPILE_CACHE=1 disables for cold-compile "
+             "measurements)",
+    )
     p.add_argument(
         "--mode", default="learner",
         choices=["learner", "system", "fused", "long_context", "serve",
@@ -1365,6 +1457,19 @@ if __name__ == "__main__":
         help="tiered plane: replay capacity in transitions (host RAM)",
     )
     p.add_argument(
+        "--priority-plane", default="host", choices=["host", "device"],
+        help="system mode: where the prioritized sum tree lives — host "
+             "(numpy tree, per-update host fence) or device (HBM tree, "
+             "in-jit sampling + write-back via the megastep superstep). "
+             "The round-9 A/B arm",
+    )
+    p.add_argument(
+        "--superstep", type=int, default=1,
+        help="system mode with --priority-plane device: chain N fused "
+             "K-update dispatches per host re-entry "
+             "(config.superstep_dispatches)",
+    )
+    p.add_argument(
         "--sessions", type=int, default=0,
         help="serve mode: stateful client session population (0 = auto: "
              "256 open-loop so sessions ≫ cache capacity, 32 closed-loop)",
@@ -1392,6 +1497,7 @@ if __name__ == "__main__":
              "with session-affinity routing (serve/multi.py)",
     )
     args = p.parse_args()
+    enable_compilation_cache(args.compile_cache)
     precision = args.precision or (
         "fp32" if args.mode == "recovery" else "bf16"
     )
@@ -1405,7 +1511,8 @@ if __name__ == "__main__":
                    arrival_rate=args.arrival_rate, slo_ms=args.slo_ms,
                    devices=args.serve_devices)
     elif args.mode == "system":
-        system_main(args.core, args.lru_chunk, precision)
+        system_main(args.core, args.lru_chunk, precision,
+                    args.priority_plane, args.superstep)
     elif args.mode == "fused":
         fused_system_main(args.collect_every, args.core, args.lru_chunk,
                           precision)
@@ -1416,3 +1523,4 @@ if __name__ == "__main__":
                     precision=precision)
     else:
         learner_matrix_main(args.core, args.lru_chunk, args.batch, precision)
+    log_compile_cache_stats()

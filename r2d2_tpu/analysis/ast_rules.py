@@ -48,6 +48,14 @@ Rule catalog (ids, severities — the table in ARCHITECTURE.md mirrors this):
   with `with self.<lock>:` in one method but writes the same attributes
   bare in another (non-__init__) method — the trainer/serve/watcher
   threads share these objects, so the bare write races the guarded one.
+- host-tree-in-hot-loop  (warning)  a host `SumTree` method call
+  (`.tree.sample(...)`, `.tree.update(...)`, ...) inside a for/while body
+  in the learner hot-path modules: under priority_plane='device' the sum
+  tree lives in HBM and sampling/write-back run in-jit inside the
+  superstep (megastep.make_priority_superstep), so a host-tree call here
+  both stalls the dispatch pipeline per iteration and silently forks the
+  host tree away from the device tree. The in-jit device ops
+  (replay/device_sum_tree.py module functions) are not flagged.
 """
 
 from __future__ import annotations
@@ -70,6 +78,7 @@ ALL_RULES = (
     "dynamic-fault-site",
     "snapshot-missing-topology",
     "lock-discipline",
+    "host-tree-in-hot-loop",
 )
 
 # hot-path modules for the host-sync rule: the learner/collection dispatch
@@ -183,6 +192,73 @@ def _rule_host_sync(tree: ast.AST, path: str) -> List[Finding]:
                     and not isinstance(node.args[0], ast.Constant)
                 ):
                     flag(node, f"{node.func.id}(...) on a possible device value")
+    return out
+
+
+# host SumTree API surface (replay/sum_tree.py + the control plane's tree
+# attribute) and the receiver names that conventionally hold a HOST tree.
+# The device plane's ops are module functions (dst.tree_update(...)) so
+# their receiver chain never matches.
+_HOST_TREE_METHODS = {
+    "sample", "update", "sample_indices", "update_priorities",
+    "priorities_of", "leaves",
+}
+_HOST_TREE_NAMES = {"tree", "sum_tree", "host_tree"}
+
+
+def _rule_host_tree_in_hot_loop(tree: ast.AST, path: str) -> List[Finding]:
+    if not is_hot_path(path):
+        return []
+    out: List[Finding] = []
+    seen: Set[Tuple[int, int]] = set()
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for stmt in list(loop.body) + list(loop.orelse):
+            for node in ast.walk(stmt):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _HOST_TREE_METHODS
+                ):
+                    continue
+                recv = node.func.value
+                recv_d = _dotted(recv) or ""
+                # jax.tree.leaves / jax.tree_util & friends are pytree ops
+                if recv_d.startswith(("jax.", "jnp.", "tree_util.")):
+                    continue
+                last = (
+                    recv.attr
+                    if isinstance(recv, ast.Attribute)
+                    else recv.id if isinstance(recv, ast.Name) else ""
+                )
+                if last not in _HOST_TREE_NAMES:
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(
+                    Finding(
+                        rule="host-tree-in-hot-loop",
+                        severity="warning",
+                        path=path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=f"host SumTree call {recv_d or last}."
+                        f"{node.func.attr}(...) inside a hot-loop body: "
+                        "under priority_plane='device' sampling and "
+                        "priority write-back run in-jit over the HBM tree "
+                        "(megastep superstep); a host-tree call here syncs "
+                        "per iteration and forks the host tree from the "
+                        "device tree",
+                        hint="use the device ops "
+                        "(replay/device_sum_tree.py) or the control "
+                        "plane's _tree_write funnel; mark a deliberate "
+                        "host-plane path with "
+                        "`# r2d2: disable=host-tree-in-hot-loop`",
+                    )
+                )
     return out
 
 
@@ -631,6 +707,7 @@ _RULES = (
     _rule_fault_sites,
     _rule_snapshot_topology,
     _rule_lock_discipline,
+    _rule_host_tree_in_hot_loop,
 )
 
 

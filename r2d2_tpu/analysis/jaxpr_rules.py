@@ -225,6 +225,62 @@ def fused_train_step_jaxpr(precision: str) -> str:
     return str(jax.make_jaxpr(step)(state, _stacked_batch_struct(precision, _NUM_STEPS)))
 
 
+_SUPERSTEP_N = 2  # dispatches: >1 so the outer scan over dispatch keys is real
+
+
+@functools.lru_cache(maxsize=None)
+def _superstep_cfg(precision: str):
+    """tiny_test on the device priority plane — the config family the
+    superstep is built for (replay store + sum tree both HBM-resident)."""
+    return _cfg(precision).replace(
+        replay_plane="device",
+        priority_plane="device",
+        superstep_dispatches=_SUPERSTEP_N,
+        updates_per_dispatch=_NUM_STEPS,
+        # step target plays no role in the trace; any N*K multiple is valid
+        training_steps=_SUPERSTEP_N * _NUM_STEPS,
+    )
+
+
+def _superstep_inputs(precision: str):
+    """(stores, tree, num_seq_store, key) avals for the superstep trace —
+    shapes pinned to the DeviceReplayBuffer layout (store_field_specs) and
+    the flat f32 sum tree (device_sum_tree.tree_size)."""
+    import jax
+
+    from r2d2_tpu.replay import device_sum_tree as dst
+    from r2d2_tpu.replay.block import store_field_specs
+
+    cfg = _superstep_cfg(precision)
+    sds = jax.ShapeDtypeStruct
+    stores = {
+        k: sds((cfg.num_blocks, *shape), dt)
+        for k, (shape, dt) in store_field_specs(cfg).items()
+    }
+    L = dst.tree_layers(cfg.num_sequences)
+    tree = sds((dst.tree_size(L),), np.float32)
+    nss = sds((cfg.num_blocks,), np.int32)
+    return stores, tree, nss, jax.random.PRNGKey(0)
+
+
+@functools.lru_cache(maxsize=None)
+def priority_superstep_jaxpr(precision: str) -> str:
+    """Jaxpr text of the N×K priority superstep (megastep.
+    make_priority_superstep): in-jit stratified sum-tree descent, IS
+    weights, K fused train updates, and priority write-back chained over
+    N dispatches — the whole program the host re-enters around when
+    priority_plane='device'."""
+    import jax
+
+    from r2d2_tpu.megastep import make_priority_superstep
+
+    cfg = _superstep_cfg(precision)
+    net, state = _net_and_state(precision)
+    ss = make_priority_superstep(cfg, net, _SUPERSTEP_N, _NUM_STEPS, donate=False)
+    stores, tree, nss, key = _superstep_inputs(precision)
+    return str(jax.make_jaxpr(ss)(state, stores, tree, nss, key))
+
+
 @functools.lru_cache(maxsize=None)
 def act_select_jaxpr(precision: str, num_envs: int = 4) -> str:
     """Jaxpr text of the fused act tail (net.act_select: core step +
@@ -712,6 +768,51 @@ def scan_fused_unroll(precision: str) -> List[Finding]:
     return out
 
 
+def scan_superstep(precision: str) -> List[Finding]:
+    """The N×K priority superstep entry: the tree descent / IS-weight /
+    write-back math must stay off f64 at either precision (the device
+    tree IS the f32 arm of the host-parity contract — an f64 op would
+    mean the drift bound is being met by accident), the fp32 golden path
+    stays bf16-free, the bf16 path keeps its loss/target/priority
+    islands, and the donated (state, tree) pair is fully consumed so XLA
+    aliases both in place across the N-dispatch scan."""
+    import jax
+
+    from r2d2_tpu.megastep import make_priority_superstep
+
+    label = f"priority_superstep[N{_SUPERSTEP_N}xK{_NUM_STEPS},{precision}]"
+    text = priority_superstep_jaxpr(precision)
+    out = check_no_float64(text, label)
+    if precision == "fp32":
+        out += check_no_bf16(text, label)
+    else:
+        out += check_fp32_island(text, label)
+    # donation contract of the production build (donate_argnums=(0, 2)):
+    # every TrainState leaf and the tree buffer must reappear unchanged in
+    # (shape, dtype) or the superstep silently copies 2x the model + tree
+    cfg = _superstep_cfg(precision)
+    net, state = _net_and_state(precision)
+    ss = make_priority_superstep(cfg, net, _SUPERSTEP_N, _NUM_STEPS, donate=True)
+    stores, tree, nss, key = _superstep_inputs(precision)
+    out_state, out_tree, _ = jax.eval_shape(ss, state, stores, tree, nss, key)
+    out += compare_donated_leaves(state, out_state, f"{label}.donation")
+    if (tuple(out_tree.shape), str(out_tree.dtype)) != (
+        tuple(tree.shape), str(tree.dtype)
+    ):
+        out.append(
+            _finding(
+                "jaxpr-donation-mismatch", f"{label}.donation",
+                f"superstep returns a tree of {out_tree.dtype}"
+                f"{list(out_tree.shape)} against a donated "
+                f"{tree.dtype}{list(tree.shape)} input — the HBM tree "
+                "cannot alias in place across dispatches",
+                hint="tree_update must preserve the flat f32 layout "
+                "(replay/device_sum_tree.py)",
+            )
+        )
+    return out
+
+
 def scan_act_select(precision: str) -> List[Finding]:
     """The fused act tail (dueling + ε-mask + argmax with the core
     step)."""
@@ -881,6 +982,7 @@ def scan_entry_points(
         out += scan_act(p)
         out += scan_act_select(p)
         out += scan_fused_unroll(p)
+        out += scan_superstep(p)
         out += scan_serve_step(p)
         out += scan_multi_serve_step(p)
         out += scan_donation(p)

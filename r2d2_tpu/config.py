@@ -271,6 +271,23 @@ class R2D2Config:
     # trades priority/publish granularity for throughput — the reference's
     # own pipeline already lags ~12 batches (worker.py:364-371).
     updates_per_dispatch: int = 1
+    # where the prioritized sum tree lives: "host" (numpy/C++ f64 tree,
+    # stratified draws + priority write-backs on the host thread — today's
+    # bit-exact behavior on every plane) or "device" (float32 JAX-array
+    # tree in HBM, replay/device_sum_tree.py: sampling, IS weights, and
+    # priority write-back all happen inside the learner dispatch, so the
+    # K-update scan is no longer fenced by host tree work on either side).
+    # "device" rides the device/sharded replay planes only.
+    priority_plane: str = "host"
+    # priority_plane="device" only: N fused K-update dispatches chained in
+    # ONE lax.scan (megastep.make_priority_superstep) — the host re-enters
+    # the loop every N*K updates for ingestion/metrics/snapshots. Within a
+    # superstep, later dispatches sample from the tree updated by earlier
+    # ones (no one-dispatch priority lag) and do not see blocks ingested
+    # mid-flight; both are the documented superstep semantics
+    # (ARCHITECTURE.md priority plane section). 1 = plain per-dispatch
+    # device sampling.
+    superstep_dispatches: int = 1
 
     # --- derived ----------------------------------------------------------
     @property
@@ -468,6 +485,33 @@ class R2D2Config:
                 "training_steps must be a multiple of updates_per_dispatch "
                 "(each dispatch advances the step counter by that amount)"
             )
+        if self.priority_plane not in ("host", "device"):
+            raise ValueError(f"unknown priority_plane {self.priority_plane!r}")
+        if self.priority_plane == "device" and self.replay_plane not in (
+            "device", "sharded"
+        ):
+            raise ValueError(
+                "priority_plane='device' keeps the sum tree in HBM next to "
+                "the store; it requires replay_plane='device' or 'sharded'"
+            )
+        if self.superstep_dispatches < 1:
+            raise ValueError("superstep_dispatches must be >= 1")
+        if self.superstep_dispatches > 1 and self.priority_plane != "device":
+            raise ValueError(
+                "superstep_dispatches > 1 chains N fused dispatches with "
+                "in-jit sampling/write-back between them; it requires "
+                "priority_plane='device'"
+            )
+        if (
+            self.training_steps
+            % (self.updates_per_dispatch * self.superstep_dispatches)
+            != 0
+        ):
+            raise ValueError(
+                "training_steps must be a multiple of updates_per_dispatch "
+                "* superstep_dispatches (each superstep advances the step "
+                "counter by that amount)"
+            )
         if self.collector == "device" and self.replay_plane in ("host", "tiered"):
             raise ValueError(
                 "collector='device' writes packed blocks straight into the "
@@ -626,6 +670,10 @@ def tiny_test() -> R2D2Config:
         save_interval=25,
         max_episode_steps=100,
         encoder="mlp",
+        # 0.0 = emit every record: tests assert per-update metrics streams
+        # (learning curves, record counts); the deferred-fetch throttle is
+        # a production-cadence concern (Trainer._log)
+        log_interval=0.0,
     ).validate()
 
 

@@ -768,3 +768,180 @@ class MultiHostFusedRunner(_DeferredDrainRunner):
         # pair: addressable pieces only, row i under draw i's per-shard
         # staleness window + lap stamp
         self.replay.drain_pending(pending)
+
+
+# ---------------------------------------------------------------------------
+# Priority superstep (priority_plane="device"): N fused K-update dispatches
+# chained in ONE lax.scan, with stratified sampling, IS weights, the batch
+# gather, the train step, AND the priority write-back all running against
+# the device-resident sum tree (replay/device_sum_tree.py). The host
+# re-enters the loop only every N*K updates — for block ingestion, metrics,
+# and snapshots — instead of fencing every dispatch with a host tree draw
+# before it and a D2H priority drain after it.
+# ---------------------------------------------------------------------------
+
+
+def make_priority_superstep(
+    cfg: R2D2Config,
+    net: R2D2Network,
+    num_dispatches: int,
+    num_updates: int,
+    donate: bool = True,
+):
+    """Build the single-chip superstep over a device-resident tree.
+
+    Signature:
+      superstep(state, stores, tree, num_seq_store, key) ->
+        (state', tree', metrics-of-last-update)
+
+    where `tree` is the DeviceSumTree's flat float32 array,
+    `num_seq_store` the (num_blocks,) per-slot sequence counts (the
+    zero-leaf clamp's input, uploaded per superstep — a few hundred
+    bytes), and `key` a jax PRNG key consumed deterministically: one
+    split per dispatch, K sub-keys per dispatch, one stratified (B,) draw
+    per sub-key — the same draw structure as the host plane's K
+    sequential SumTree.sample calls.
+
+    Semantics (pinned by tests/test_superstep.py):
+    - all K coordinate sets of a dispatch are drawn against the tree at
+      dispatch entry (exactly like DeviceReplayBuffer.sample_and_run's
+      K draws under one lock hold), and the K updates' priorities land
+      after the K-scan in row order — last write wins on duplicate
+      leaves, like the host drain;
+    - consecutive dispatches inside the superstep see each other's
+      write-backs immediately (there is no host to lag behind), so the
+      one-dispatch priority lag of the deferred-drain protocol does not
+      exist here — dispatch d+1 samples the post-d tree. A superstep of
+      N on `key` is bit-identical to N sequential superstep-1 calls on
+      the key sequence jax.random.split(key, N) (the equivalence test;
+      superstep-1 consumes its key directly), NOT bit-identical to the
+      host plane's deferred drain;
+    - blocks ingested while the superstep is in flight are dispatched
+      after it on the device stream (DeviceReplayBuffer.superstep_run
+      installs the output tree under the buffer lock), so their leaf
+      writes land on top of the superstep's — the same verdict the host
+      pointer-window mask reaches for overwritten slots."""
+    from r2d2_tpu.replay import device_sum_tree as dst
+
+    multi_core = make_multi_update_core(cfg, net, num_updates)
+    L = dst.tree_layers(cfg.num_sequences)
+    S = cfg.seqs_per_block
+    B = cfg.batch_size
+    K = num_updates
+
+    def superstep(state: TrainState, stores, tree, num_seq_store, key):
+        def dispatch(carry, kd):
+            state, tree = carry
+            keys = jax.random.split(kd, K)
+            # K stratified (B,) draws against the dispatch-entry tree
+            leaf = jax.vmap(lambda k: dst.tree_sample(tree, L, B, k))(keys)
+            # weights from the UNCLAMPED sampled leaves (host contract:
+            # SumTree.sample computes weights before the zero-leaf clamp)
+            w = jax.vmap(
+                lambda li: dst.is_weights(tree, L, li, cfg.is_exponent)
+            )(leaf)
+            b = leaf // S
+            s = jnp.minimum(leaf % S, jnp.maximum(num_seq_store[b] - 1, 0))
+            state, metrics, prios = multi_core(state, stores, b, s, w)
+            idxes = b * S + s  # clamped global slots, like the host drain
+
+            def write_back(tree, row):
+                li, td = row
+                return dst.tree_update(tree, L, li, td, cfg.prio_exponent), None
+
+            tree, _ = jax.lax.scan(write_back, tree, (idxes, prios))
+            return (state, tree), metrics
+
+        # N=1 consumes the key DIRECTLY so that superstep-N on `key` is
+        # bit-identical to N sequential superstep-1 calls on
+        # jax.random.split(key, N) — the equivalence tests' contract
+        if num_dispatches > 1:
+            keys = jax.random.split(key, num_dispatches)
+        else:
+            keys = key[None]
+        (state, tree), metrics = jax.lax.scan(dispatch, (state, tree), keys)
+        return state, tree, jax.tree.map(lambda x: x[-1], metrics)
+
+    return jax.jit(superstep, donate_argnums=(0, 2) if donate else ())
+
+
+def make_sharded_priority_superstep(
+    cfg: R2D2Config,
+    net: R2D2Network,
+    mesh,
+    num_dispatches: int,
+    num_updates: int,
+    donate: bool = True,
+):
+    """The dp-sharded superstep: shard_map over the mesh's dp axis with
+    per-shard trees stacked (dp, tree_size) alongside the sharded stores.
+
+    Each shard draws its (B/dp,) sub-batches from its OWN tree shard and
+    writes its priorities back locally — zero cross-device tree traffic.
+    IS weights use the host sharded plane's batch-global contract: raw
+    sampled priorities feed make_multi_update_core(is_from_priorities=
+    True), which normalizes each update's batch against the global
+    minimum via a pmin over dp (the same formula ShardedDeviceReplay
+    applies on host).
+
+    Signature: superstep(state, stores, trees, num_seq_store, keys) ->
+      (state', trees', metrics) with trees (dp, tree_size), num_seq_store
+      (dp, nb/dp), keys (dp, 2) raw PRNG key data — one independent
+      stream per shard, mirroring the host plane's per-shard
+      Generators."""
+    from jax.sharding import PartitionSpec as P
+
+    from r2d2_tpu.parallel.jax_compat import shard_map
+    from r2d2_tpu.replay import device_sum_tree as dst
+    from r2d2_tpu.replay.control_plane import shard_config
+
+    dp = int(mesh.shape["dp"])
+    scfg = shard_config(cfg, dp)
+    multi_core = make_multi_update_core(
+        cfg, net, num_updates, axis_name="dp", is_from_priorities=True
+    )
+    L = dst.tree_layers(scfg.num_sequences)
+    S = scfg.seqs_per_block
+    B = scfg.batch_size  # B/dp
+    K = num_updates
+
+    def body(state: TrainState, stores, trees, num_seq_store, keys):
+        # local views: trees (1, tree_size), num_seq_store (1, nb/dp),
+        # keys (1, 2); stores = this shard's (nb/dp, ...) slabs
+        tree, nss = trees[0], num_seq_store[0]
+
+        def dispatch(carry, kd):
+            state, tree = carry
+            ks = jax.random.split(kd, K)
+            leaf = jax.vmap(lambda k: dst.tree_sample(tree, L, B, k))(ks)
+            # RAW priorities: the multi core pmin-normalizes per update
+            p = jax.vmap(lambda li: dst.priorities_of(tree, L, li))(leaf)
+            b = leaf // S
+            s = jnp.minimum(leaf % S, jnp.maximum(nss[b] - 1, 0))
+            state, metrics, prios = multi_core(state, stores, b, s, p)
+            idxes = b * S + s
+
+            def write_back(tree, row):
+                li, td = row
+                return dst.tree_update(tree, L, li, td, cfg.prio_exponent), None
+
+            tree, _ = jax.lax.scan(write_back, tree, (idxes, prios))
+            return (state, tree), metrics
+
+        # same N=1 direct-consumption rule as the single-chip superstep
+        if num_dispatches > 1:
+            dkeys = jax.random.split(keys[0], num_dispatches)
+        else:
+            dkeys = keys[0][None]
+        (state, tree), metrics = jax.lax.scan(dispatch, (state, tree), dkeys)
+        return state, tree[None], jax.tree.map(lambda x: x[-1], metrics)
+
+    sharded = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P("dp"), P("dp"), P("dp"), P("dp")),
+        out_specs=(P(), P("dp"), P()),
+        axis_names={"dp"},
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0, 2) if donate else ())

@@ -33,6 +33,11 @@ def shard_config(cfg: R2D2Config, dp: int) -> R2D2Config:
         replay_plane="host",
         collector="host",  # collection is the PARENT plane's concern
         updates_per_dispatch=1,
+        # the PARENT plane owns device-tree residency and the superstep;
+        # each shard's control plane is plain host bookkeeping (its device
+        # tree, when any, is attached by the parent)
+        priority_plane="host",
+        superstep_dispatches=1,
     )
 
 
@@ -64,7 +69,29 @@ class ReplayControlPlane:
         self.learning_sum = np.zeros(cfg.num_blocks, np.int64)
         self.occupied = np.zeros(cfg.num_blocks, bool)
         self.num_seq_store = np.zeros(cfg.num_blocks, np.int32)
+        # priority_plane="device": an HBM float32 mirror of the tree
+        # (replay/device_sum_tree.DeviceSumTree) attached by the owning
+        # data plane. Every host-side tree write goes through _tree_write,
+        # which keeps the mirror in sync. All mirror writes happen under
+        # self.lock — the same lock the data plane holds while dispatching
+        # a learner superstep and installing its output tree — so device
+        # tree mutations enqueue in lock-acquisition order and the device
+        # stream serializes them exactly like the host tree: ingestion
+        # dispatched after a superstep lands ON TOP of its write-backs,
+        # which is precisely the verdict the host pointer-window mask
+        # reaches for slots overwritten during a round trip.
+        self.dtree = None
         self.lock = threading.Lock()
+
+    def attach_device_tree(self, dtree) -> None:
+        self.dtree = dtree
+
+    def _tree_write(self, idxes: np.ndarray, td_errors: np.ndarray) -> None:
+        """The single funnel for host-initiated tree writes (ingestion,
+        retirement, drained priorities). Caller holds the lock."""
+        self.tree.update(idxes, td_errors)
+        if self.dtree is not None:
+            self.dtree.update(idxes, td_errors)
 
     def __len__(self) -> int:
         return self.size
@@ -84,7 +111,7 @@ class ReplayControlPlane:
         Caller holds the lock."""
         S = self.cfg.seqs_per_block
         idxes = np.arange(slot * S, (slot + 1) * S, dtype=np.int64)
-        self.tree.update(idxes, priorities)
+        self._tree_write(idxes, priorities)
         if self.occupied[slot]:
             self.size -= int(self.learning_sum[slot])
         self.learning_sum[slot] = learning_total
@@ -142,7 +169,7 @@ class ReplayControlPlane:
         if occ.size:
             S = self.cfg.seqs_per_block
             idxes = (occ[:, None] * S + np.arange(S)[None, :]).ravel()
-            self.tree.update(idxes, np.zeros(idxes.size, np.float32))
+            self._tree_write(idxes, np.zeros(idxes.size, np.float32))
             self.size -= int(self.learning_sum[occ].sum())
             self.learning_sum[occ] = 0
             self.occupied[occ] = False
@@ -246,7 +273,7 @@ class ReplayControlPlane:
                 mask = (idxes < old_ptr * S) & (idxes >= ptr * S)
             else:
                 mask = np.ones_like(idxes, dtype=bool)
-            self.tree.update(idxes[mask], td_errors[mask])
+            self._tree_write(idxes[mask], td_errors[mask])
 
     def pop_episode_stats(self):
         with self.lock:

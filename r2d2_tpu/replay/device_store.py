@@ -65,6 +65,15 @@ class DeviceReplayBuffer(ReplayControlPlane):
     def __init__(self, cfg: R2D2Config):
         super().__init__(cfg)
         nb = cfg.num_blocks
+        if cfg.priority_plane == "device":
+            from r2d2_tpu.replay.device_sum_tree import DeviceSumTree
+
+            # HBM float32 twin of the host tree: ingestion/retirement keep
+            # it in sync via _tree_write; sampling + priority write-back
+            # run in-jit inside the learner superstep (superstep_run)
+            self.attach_device_tree(
+                DeviceSumTree(cfg.num_sequences, cfg.prio_exponent, cfg.is_exponent)
+            )
         self.stores: Dict[str, jnp.ndarray] = {
             k: jnp.zeros((nb, *shape), dt)
             for k, (shape, dt) in store_field_specs(cfg).items()
@@ -198,6 +207,23 @@ class DeviceReplayBuffer(ReplayControlPlane):
         with self.lock:
             draws = [self._draw_sample_idx(rng) for _ in range(k)]
             return draws, fn(self.stores, draws)
+
+    def superstep_run(self, fn: Callable):
+        """Dispatch an in-jit sample/train/write-back superstep under ONE
+        lock hold (priority_plane="device"): fn(stores, tree,
+        num_seq_store) -> (tree_out, rest). The output tree is installed
+        before the lock releases, so every later _tree_write enqueues its
+        device update AFTER the superstep in stream order — the device
+        tree serializes exactly like the host tree does under the lock,
+        and ingestion racing the dispatch wins over the dispatch's
+        write-backs for the slots it overwrites (the same verdict the
+        host pointer-window mask reaches). Returns `rest`."""
+        with self.lock:
+            tree_out, rest = fn(
+                self.stores, self.dtree.tree, jnp.asarray(self.num_seq_store)
+            )
+            self.dtree.swap(tree_out)
+            return rest
 
     # ------------------------------------------------------------- dispatch
 

@@ -1,0 +1,248 @@
+"""Device-resident sum tree: in-jit stratified sampling and write-back.
+
+The float32, JAX-array twin of replay/sum_tree.SumTree. Same layout (one
+flat array, leaf_offset = 2**(num_layers-1) - 1), same stratum arithmetic
+((arange(n) + U[0,1)) * p_sum / n, right edge clipped to nextafter(p_sum,
+0)), same vectorized layer descent, same (max(p, min_p)/min_p)^-beta IS
+weights with the zero-leaf fallback, and the same stale-priority
+pointer-window mask contract (old_ptr / old_advances) — but every
+operation is a pure jnp function traceable inside jit/scan, so the
+learner superstep can sample, gather, train, and write priorities back
+without ever re-entering the host (ISSUE 9 tentpole; the SEED RL shape
+ARCHITECTURE.md cites).
+
+Two deliberate differences from the host tree, both pinned by
+tests/test_sum_tree.py:
+
+- float32 storage (HBM residency; f64 is gated off on TPU by the no-f64
+  jaxpr rule). Internal sums are recomputed from children on every
+  update — never accumulated incrementally — so error does not compound
+  with update count; the three-way parity test bounds the drift vs the
+  f64 host tree.
+- duplicate leaf writes in ONE update call resolve last-wins
+  *deterministically* (the host's numpy fancy assignment guarantees this;
+  jnp .at[].set with duplicate indices does not), via an O(M^2)
+  last-occurrence argmax. M is a batch row (<= K*B), so the matrix is
+  tiny next to the train step it rides along.
+
+Functions take `num_layers` (python int) as a static argument and close
+over nothing; the DeviceSumTree wrapper at the bottom gives the host-side
+control plane a SumTree-shaped handle (update / leaves / load_leaves)
+over the functional core for ingestion, snapshot, and tests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def tree_layers(capacity: int) -> int:
+    """Minimal num_layers with capacity <= 2**(num_layers-1) — identical to
+    SumTree.__init__'s loop."""
+    num_layers = 1
+    while capacity > 2 ** (num_layers - 1):
+        num_layers += 1
+    return num_layers
+
+
+def leaf_offset(num_layers: int) -> int:
+    return 2 ** (num_layers - 1) - 1
+
+
+def tree_size(num_layers: int) -> int:
+    return 2 ** num_layers - 1
+
+
+def tree_init(capacity: int) -> jnp.ndarray:
+    return jnp.zeros(tree_size(tree_layers(capacity)), jnp.float32)
+
+
+def _resum(tree: jnp.ndarray, num_layers: int) -> jnp.ndarray:
+    """Rebuild every internal node from its children, bottom-up. Full-layer
+    strided slices (static shapes), not sparse ancestor scatter: duplicate
+    parents cannot race, and each parent is an exact child sum — the same
+    values sparse recomputation would produce, at O(tree) vectorized adds
+    (negligible next to a train step)."""
+    for k in range(num_layers - 1, 0, -1):
+        p0, p1 = 2 ** (k - 1) - 1, 2 ** k - 1
+        tree = tree.at[p0:p1].set(
+            tree[2 * p0 + 1 : 2 * p1 : 2] + tree[2 * p0 + 2 : 2 * p1 + 1 : 2]
+        )
+    return tree
+
+
+def tree_update(
+    tree: jnp.ndarray,
+    num_layers: int,
+    idxes: jnp.ndarray,
+    td_errors: jnp.ndarray,
+    prio_exponent: float,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Set leaf priorities to td**prio_exponent and resum — the in-jit twin
+    of SumTree.update. `mask` rows that are False are dropped (the caller's
+    stale-window verdict); their leaves keep their current value. Duplicate
+    indices: the LAST valid occurrence wins, exactly like the host's numpy
+    assignment."""
+    off = leaf_offset(num_layers)
+    values = jnp.asarray(td_errors, jnp.float32) ** jnp.float32(prio_exponent)
+    idxes = jnp.asarray(idxes, jnp.int32)
+    m = idxes.shape[0]
+    valid = jnp.ones((m,), bool) if mask is None else jnp.asarray(mask, bool)
+    safe = jnp.where(valid, idxes, 0)
+    # last-valid-occurrence dedupe: score[i, j] = j where row j targets the
+    # same leaf as row i AND is valid, else -1; argmax over j is the winner.
+    ar = jnp.arange(m, dtype=jnp.int32)
+    same = safe[None, :] == safe[:, None]
+    score = jnp.where(same & valid[None, :], ar[None, :], -1)
+    win = jnp.argmax(score, axis=1)
+    has = jnp.max(score, axis=1) >= 0
+    val = jnp.where(has, values[win], tree[off + safe])
+    # duplicates all carry the winner's value, so .at[].set is deterministic
+    return _resum(tree.at[off + safe].set(val), num_layers)
+
+
+def tree_sample(
+    tree: jnp.ndarray, num_layers: int, num_samples: int, key: jax.Array
+) -> jnp.ndarray:
+    """Stratified sample of `num_samples` leaf indices — SumTree.sample's
+    stratum arithmetic and layer descent, in-jit. The caller guarantees
+    total > 0 (warmup gate); an empty tree cannot raise inside jit and
+    would descend to leaf 0."""
+    p_sum = tree[0]
+    interval = p_sum / jnp.float32(num_samples)
+    u = jax.random.uniform(key, (num_samples,), dtype=jnp.float32)
+    pref = (jnp.arange(num_samples, dtype=jnp.float32) + u) * interval
+    # guard the right edge against float accumulation (same as host)
+    pref = jnp.clip(pref, 0.0, jnp.nextafter(p_sum, jnp.float32(0.0)))
+    nodes = jnp.zeros((num_samples,), jnp.int32)
+    for _ in range(num_layers - 1):
+        left = tree[nodes * 2 + 1]
+        go_left = pref < left
+        nodes = jnp.where(go_left, nodes * 2 + 1, nodes * 2 + 2)
+        pref = jnp.where(go_left, pref, pref - left)
+    return nodes - leaf_offset(num_layers)
+
+
+def is_weights(
+    tree: jnp.ndarray, num_layers: int, idxes: jnp.ndarray, is_exponent: float
+) -> jnp.ndarray:
+    """(max(p, min_p) / min_p)^-beta over the batch, min_p the smallest
+    POSITIVE sampled priority (1.0 when none — zero-priority leaves get the
+    max weight instead of NaN, matching the host fallback)."""
+    p = tree[jnp.asarray(idxes, jnp.int32) + leaf_offset(num_layers)]
+    pos_min = jnp.min(jnp.where(p > 0.0, p, jnp.inf))
+    min_p = jnp.where(jnp.isfinite(pos_min), pos_min, 1.0)
+    return (jnp.maximum(p, min_p) / min_p) ** jnp.float32(-is_exponent)
+
+
+def priorities_of(tree: jnp.ndarray, num_layers: int, idxes: jnp.ndarray) -> jnp.ndarray:
+    return tree[jnp.asarray(idxes, jnp.int32) + leaf_offset(num_layers)]
+
+
+def stale_mask(
+    idxes: jnp.ndarray,
+    old_ptr,
+    ptr,
+    seqs_per_block: int,
+    old_advances,
+    advances,
+    num_blocks: int,
+) -> jnp.ndarray:
+    """The pointer-window staleness verdict of
+    ReplayControlPlane.update_priorities, branchless for jit: True = the
+    leaf survived the sample->train round trip. ptr == old_ptr accepts all
+    (nothing moved) UNLESS the advance stamps show a full ring lap, which
+    rejects everything."""
+    S = seqs_per_block
+    idxes = jnp.asarray(idxes)
+    lo = jnp.asarray(old_ptr, idxes.dtype) * S
+    hi = jnp.asarray(ptr, idxes.dtype) * S
+    fwd = (idxes < lo) | (idxes >= hi)
+    wrap = (idxes < lo) & (idxes >= hi)
+    m = jnp.where(hi > lo, fwd, jnp.where(hi < lo, wrap, True))
+    lap = (jnp.asarray(advances) - jnp.asarray(old_advances)) >= num_blocks
+    return m & ~lap
+
+
+def tree_from_leaves(leaves: np.ndarray, capacity: int) -> jnp.ndarray:
+    """Build the flat device tree from raw leaf priorities (already ^alpha),
+    internal sums recomputed bottom-up in numpy before the single upload —
+    the restore half of snapshot support."""
+    num_layers = tree_layers(capacity)
+    off = leaf_offset(num_layers)
+    flat = np.zeros(tree_size(num_layers), np.float32)
+    flat[off : off + capacity] = np.asarray(leaves, np.float32)[:capacity]
+    for k in range(num_layers - 1, 0, -1):
+        p = np.arange(2 ** (k - 1) - 1, 2 ** k - 1)
+        flat[p] = flat[2 * p + 1] + flat[2 * p + 2]
+    return jnp.asarray(flat)
+
+
+@partial(jax.jit, static_argnums=(1, 4), donate_argnums=(0,))
+def _jit_update(tree, num_layers, idxes, td_errors, prio_exponent):
+    return tree_update(tree, num_layers, idxes, td_errors, prio_exponent)
+
+
+class DeviceSumTree:
+    """Host-side handle over the functional core, API-compatible with the
+    slice of SumTree the control plane and snapshots use (update / sample /
+    priorities_of / leaves / load_leaves). Ingestion and retirement go
+    through update() off the hot path (one tiny dispatch per block, jit
+    cache keyed by batch shape — the shape set is {S, k*S}); the learner
+    superstep bypasses this handle entirely and carries `self.tree` through
+    lax.scan, handing the updated array back via swap()."""
+
+    def __init__(self, capacity: int, prio_exponent: float = 0.9, is_exponent: float = 0.6):
+        self.capacity = capacity
+        self.num_layers = tree_layers(capacity)
+        self.leaf_offset = leaf_offset(self.num_layers)
+        self.prio_exponent = prio_exponent
+        self.is_exponent = is_exponent
+        self.tree = tree_init(capacity)
+
+    @property
+    def total(self) -> float:
+        return float(self.tree[0])
+
+    def update(self, idxes: np.ndarray, td_errors: np.ndarray) -> None:
+        if len(idxes) == 0:
+            return
+        self.tree = _jit_update(
+            self.tree,
+            self.num_layers,
+            jnp.asarray(np.asarray(idxes, np.int32)),
+            jnp.asarray(np.asarray(td_errors, np.float32)),
+            self.prio_exponent,
+        )
+
+    def sample(self, num_samples: int, key: jax.Array) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(leaf indices, IS weights) as device arrays. Host callers (tests,
+        parity harnesses) pass a jax PRNG key; the superstep uses the
+        functional ops directly."""
+        idx = tree_sample(self.tree, self.num_layers, num_samples, key)
+        return idx, is_weights(self.tree, self.num_layers, idx, self.is_exponent)
+
+    def priorities_of(self, idxes: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            priorities_of(self.tree, self.num_layers, jnp.asarray(np.asarray(idxes, np.int32)))
+        )
+
+    def swap(self, tree: jnp.ndarray) -> None:
+        """Install a superstep's output tree as the live state."""
+        self.tree = tree
+
+    # ------------------------------------------------------- snapshot support
+
+    def leaves(self) -> np.ndarray:
+        return np.asarray(self.tree[self.leaf_offset : self.leaf_offset + self.capacity])
+
+    def load_leaves(self, values: np.ndarray) -> None:
+        if len(values) != self.capacity:
+            raise ValueError(f"expected {self.capacity} leaves, got {len(values)}")
+        self.tree = tree_from_leaves(values, self.capacity)

@@ -60,6 +60,32 @@ class ShardedSampleIdx:
     env_steps: int
 
 
+class _ShardTreeMirror:
+    """The per-shard face of the parent's stacked device tree: quacks like
+    DeviceSumTree for the slice of its API the shard control plane
+    (_tree_write) and snapshots (leaves/load_leaves) touch, routing every
+    operation to the parent's (dp, tree_size) P("dp")-sharded array — one
+    global array, row updates resolved to the owning device by XLA, same
+    pattern as the block stores."""
+
+    def __init__(self, parent: "ShardedDeviceReplay", sid: int):
+        self.parent = parent
+        self.sid = sid
+
+    def update(self, idxes: np.ndarray, td_errors: np.ndarray) -> None:
+        if len(idxes) == 0:
+            return
+        self.parent._dtree_row_update(self.sid, idxes, td_errors)
+
+    def leaves(self) -> np.ndarray:
+        p = self.parent
+        off = 2 ** (p._dtree_layers - 1) - 1
+        return np.asarray(p.dtree_stack[self.sid, off : off + p._dtree_cap])
+
+    def load_leaves(self, values: np.ndarray) -> None:
+        self.parent._dtree_row_load(self.sid, values)
+
+
 class ShardedDeviceReplay:
     def __init__(self, cfg: R2D2Config, mesh: Mesh):
         dp = mesh.shape["dp"]
@@ -146,7 +172,68 @@ class ShardedDeviceReplay:
             donate_argnums=(0,),
             out_shardings={k: shd for k in self.stores},
         )
+
+        # priority_plane="device": per-shard float32 trees stacked
+        # (dp, tree_size) with the SAME P("dp") sharding as the stores —
+        # each shard's tree lives next to its blocks. Host-side ingestion
+        # mirrors through _ShardTreeMirror row updates; the sharded
+        # superstep (megastep.make_sharded_priority_superstep) carries the
+        # whole stack through its scan and hands it back via superstep_run.
+        self.dtree_stack: Optional[jnp.ndarray] = None
+        if cfg.priority_plane == "device":
+            from r2d2_tpu.replay import device_sum_tree as dst
+
+            self._dst = dst
+            self._dtree_cap = shard_cfg.num_sequences
+            self._dtree_layers = dst.tree_layers(self._dtree_cap)
+            self._dtree_shd = shd
+            tsize = dst.tree_size(self._dtree_layers)
+            self.dtree_stack = jnp.zeros((dp, tsize), jnp.float32, device=shd)
+
+            def _row_update(stack, sid, idxes, td):
+                row = dst.tree_update(
+                    stack[sid], self._dtree_layers, idxes, td, cfg.prio_exponent
+                )
+                return jax.lax.dynamic_update_index_in_dim(stack, row, sid, axis=0)
+
+            self._row_update_fn = jax.jit(
+                _row_update, donate_argnums=(0,), out_shardings=shd
+            )
+            for sid, sh in enumerate(self.shards):
+                sh.attach_device_tree(_ShardTreeMirror(self, sid))
         self.lock = threading.Lock()
+
+    def _dtree_row_update(self, sid: int, idxes, td_errors) -> None:
+        # callers (_tree_write via add_block/update_priorities) already hold
+        # self.lock; the Lock is non-reentrant  # r2d2: disable=lock-discipline
+        self.dtree_stack = self._row_update_fn(
+            self.dtree_stack,
+            jnp.int32(sid),
+            jnp.asarray(np.asarray(idxes, np.int32)),
+            jnp.asarray(np.asarray(td_errors, np.float32)),
+        )
+
+    def _dtree_row_load(self, sid: int, values: np.ndarray) -> None:
+        """Snapshot-restore path: rebuild one shard's tree from raw leaves
+        and re-deal the stack (host round trip; restore-time only)."""
+        host = np.asarray(self.dtree_stack)
+        host[sid] = np.asarray(self._dst.tree_from_leaves(values, self._dtree_cap))
+        # restore runs before any worker thread starts (single-threaded
+        # phase, snapshot.load_replay)  # r2d2: disable=lock-discipline
+        self.dtree_stack = jax.device_put(host, self._dtree_shd)
+
+    def superstep_run(self, fn: Callable):
+        """Dispatch an in-jit sharded superstep under ONE buffer-lock hold:
+        fn(stores, dtree_stack, num_seq_store (dp, nb/dp)) -> (stack',
+        rest). Installing the output stack before the lock releases orders
+        every later ingestion mirror write after the superstep on the
+        device stream — the same serialization argument as
+        DeviceReplayBuffer.superstep_run, per shard."""
+        with self.lock:
+            nss = np.stack([sh.num_seq_store for sh in self.shards])
+            stack_out, rest = fn(self.stores, self.dtree_stack, nss)
+            self.dtree_stack = stack_out
+            return rest
 
     # ---------------------------------------------------------------- state
 

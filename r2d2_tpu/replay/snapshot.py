@@ -188,6 +188,12 @@ def read_manifest(path: str) -> Optional[Dict]:
 
 def _plane_state(plane: ReplayControlPlane, prefix: str = "") -> Dict[str, np.ndarray]:
     d = {prefix + "tree_leaves": plane.tree.leaves()}
+    if plane.dtree is not None:
+        # priority_plane="device": the float32 HBM tree is AUTHORITATIVE
+        # for sampling and carries the learner's write-backs (the host
+        # tree only sees ingestion there) — snapshot its leaves so
+        # --resume continues from the same priority distribution
+        d[prefix + "dtree_leaves"] = np.asarray(plane.dtree.leaves(), np.float32)
     for k in _COUNTERS:
         d[prefix + k] = np.asarray(getattr(plane, k))
     d[prefix + "learning_sum"] = plane.learning_sum.copy()
@@ -199,6 +205,16 @@ def _plane_state(plane: ReplayControlPlane, prefix: str = "") -> Dict[str, np.nd
 def _restore_plane(plane: ReplayControlPlane, d, prefix: str = "") -> None:
     plane.tree.load_leaves(d[prefix + "tree_leaves"])
     names = getattr(d, "files", None) or list(d)
+    if plane.dtree is not None:
+        if prefix + "dtree_leaves" in names:
+            plane.dtree.load_leaves(d[prefix + "dtree_leaves"])
+        else:
+            # host-plane snapshot restored under priority_plane="device":
+            # seed the device tree from the host leaves (f64 -> f32, the
+            # parity-bounded drift class, ARCHITECTURE.md)
+            plane.dtree.load_leaves(
+                np.asarray(d[prefix + "tree_leaves"], np.float32)
+            )
     for k in _COUNTERS:
         if prefix + k not in names:  # pre-ptr_advances snapshot
             setattr(plane, k, 0)

@@ -64,8 +64,19 @@ def main(argv=None) -> int:
     p.add_argument("--dryrun", type=int, default=0, metavar="N",
                    help="serve N synthetic requests in-process (no TCP) "
                         "and exit 0 — the multi-device smoke path")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache directory "
+                        "(R2D2_COMPILE_CACHE env var is the same knob) — "
+                        "amortizes the bucket-warmup compiles across "
+                        "server restarts")
     args = p.parse_args(argv)
 
+    from r2d2_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+        log_compile_cache_stats,
+    )
+
+    enable_compilation_cache(args.compile_cache)
     cfg = PRESETS[args.preset]()
     if args.set:
         cfg = cfg.replace(**parse_overrides(args.set))
@@ -93,6 +104,7 @@ def main(argv=None) -> int:
                               metrics=metrics)
     print(f"[serve] warming up {len(serve_cfg.buckets)} bucket shapes", file=sys.stderr)
     server.warmup()
+    log_compile_cache_stats("serve compile-cache")
     server.start()
     if args.dryrun:
         import numpy as np

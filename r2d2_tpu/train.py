@@ -300,7 +300,20 @@ class _DevicePlane:
         self.replay = DeviceReplayBuffer(tr.cfg)
         self.K = self.steps_per_update = tr.cfg.updates_per_dispatch
         self._pending = None  # deferred (priorities, draws) readback
-        if self.K > 1:
+        self.device_priority = tr.cfg.priority_plane == "device"
+        if self.device_priority:
+            from r2d2_tpu.megastep import make_priority_superstep
+
+            self.N = tr.cfg.superstep_dispatches
+            self.steps_per_update = self.N * self.K
+            self.superstep_fn = make_priority_superstep(
+                tr.cfg, tr.net, self.N, self.K
+            )
+            # key stream derived from the STEP COUNTER, not carried state:
+            # a --resume at step s re-derives superstep s/(N*K)'s key
+            # exactly, with nothing extra to snapshot
+            self._superstep_base_key = jax.random.PRNGKey(tr.cfg.seed + 4)
+        elif self.K > 1:
             from r2d2_tpu.learner import make_fused_multi_train_step
 
             self.multi_fn = make_fused_multi_train_step(tr.cfg, tr.net, self.K)
@@ -308,7 +321,27 @@ class _DevicePlane:
         self.gather_fn = make_gather_step(tr.cfg)
         self.batch_step_fn = make_batch_train_step(tr.cfg, tr.net)
 
+    def _superstep_update(self, state):
+        """priority_plane="device": ONE dispatch runs N x K updates with
+        sampling, IS weights, gather, train, and priority write-back all
+        in-jit against the HBM tree (megastep.make_priority_superstep).
+        Nothing is drawn on host, nothing drains afterwards — the host's
+        only work here is deriving the dispatch key and swapping the tree
+        handle under the buffer lock."""
+        key = jax.random.fold_in(
+            self._superstep_base_key, self.tr._step // self.steps_per_update
+        )
+
+        def dispatch(stores, tree, nss):
+            new_state, tree_out, m = self.superstep_fn(state, stores, tree, nss, key)
+            return tree_out, (new_state, m)
+
+        return self.replay.superstep_run(dispatch)
+
     def sample(self, pipelined: bool = False):
+        if self.device_priority:
+            # sampling happens in-jit at update time, against the live tree
+            return ("superstep", None, None, None)
         if self.K > 1:
             # multi-update dispatch draws its own coordinates at update
             # time (atomically with the dispatch) — queued coordinates
@@ -396,6 +429,8 @@ class _DevicePlane:
 
     def update(self, state, item):
         kind, payload, idxes, stamp = item
+        if kind == "superstep":
+            return self._superstep_update(state)
         if kind == "multi":
             return self._multi_update(state)
         if kind == "batch":
@@ -424,7 +459,17 @@ class _ShardedPlane:
         self.replay = ShardedDeviceReplay(tr.cfg, tr.mesh)
         self.K = self.steps_per_update = tr.cfg.updates_per_dispatch
         self._pending = None  # deferred (priorities, draws) readback
-        if self.K > 1:
+        self.device_priority = tr.cfg.priority_plane == "device"
+        if self.device_priority:
+            from r2d2_tpu.megastep import make_sharded_priority_superstep
+
+            self.N = tr.cfg.superstep_dispatches
+            self.steps_per_update = self.N * self.K
+            self.superstep_fn = make_sharded_priority_superstep(
+                tr.cfg, tr.net, tr.mesh, self.N, self.K
+            )
+            self._superstep_base_key = jax.random.PRNGKey(tr.cfg.seed + 4)
+        elif self.K > 1:
             from r2d2_tpu.learner import make_sharded_fused_multi_train_step
 
             self.multi_fn = make_sharded_fused_multi_train_step(
@@ -434,7 +479,27 @@ class _ShardedPlane:
         self.gather_fn = make_sharded_gather_step(tr.cfg, tr.mesh)
         self.batch_step_fn = make_batch_train_step(tr.cfg, tr.net)
 
+    def _superstep_update(self, state):
+        """Sharded in-jit superstep: one independent key stream per dp
+        shard (fold_in by shard id, then by superstep counter — counter-
+        derived like _DevicePlane's, so --resume re-derives the streams)."""
+        ctr = self.tr._step // self.steps_per_update
+        base = jax.random.fold_in(self._superstep_base_key, ctr)
+        keys = jnp.stack(
+            [jax.random.fold_in(base, sid) for sid in range(self.replay.dp)]
+        )
+
+        def dispatch(stores, trees, nss):
+            new_state, trees_out, m = self.superstep_fn(
+                state, stores, trees, jnp.asarray(nss), keys
+            )
+            return trees_out, (new_state, m)
+
+        return self.replay.superstep_run(dispatch)
+
     def sample(self, pipelined: bool = False):
+        if self.device_priority:
+            return ("superstep", None, None, None)
         if self.K > 1:
             # multi-update dispatch draws its own coordinates at update
             # time, atomically with the dispatch (_DevicePlane rationale)
@@ -481,6 +546,8 @@ class _ShardedPlane:
 
     def update(self, state, item):
         kind, payload, idxes, stamp = item
+        if kind == "superstep":
+            return self._superstep_update(state)
         if kind == "multi":
             return self._multi_update(state)
         old_ptrs, old_adv = stamp
@@ -641,14 +708,19 @@ class Trainer:
         # backend only syncs on host readback); increments are known
         # exactly (updates_per_dispatch per plane.update)
         self._step = self._initial_step
-        if self._initial_step % cfg.updates_per_dispatch != 0:
+        _quantum = cfg.updates_per_dispatch * cfg.superstep_dispatches
+        if self._initial_step % _quantum != 0:
             raise ValueError(
                 f"resumed step {self._initial_step} is not a multiple of "
-                f"updates_per_dispatch={cfg.updates_per_dispatch}; training "
-                "would overshoot training_steps — resume with the K the "
-                "checkpoint was trained with (or K=1)"
+                f"updates_per_dispatch*superstep_dispatches={_quantum}; "
+                "training would overshoot training_steps — resume with the "
+                "N and K the checkpoint was trained with (or N=K=1)"
             )
         self.sample_rng = np.random.default_rng(cfg.seed + 2)
+        # deferred metrics queue (_log / _flush_log): latest un-emitted
+        # (m, step, extra); epoch-zero stamp emits the FIRST record eagerly
+        self._pending_metrics = None
+        self._last_log_emit = 0.0
         # preemption protocol: request_preempt (usually via SIGTERM inside
         # a run mode's _sigterm_to_preempt window) sets the event; the run
         # loop honors it at the next iteration boundary, snapshots replay +
@@ -933,6 +1005,9 @@ class Trainer:
             self.cfg.snapshot_every > 0
             and step // self.cfg.snapshot_every > prev // self.cfg.snapshot_every
         ):
+            # cut point: the metrics record preceding a snapshot must land
+            # in the jsonl before the snapshot it describes
+            self._flush_log()
             self._snapshot_async()
 
     def _global_env_steps(self) -> int:
@@ -957,6 +1032,7 @@ class Trainer:
         drain = getattr(self.plane, "drain_pending", None)
         if drain is not None:
             drain()
+        self._flush_log()
 
     def _replay_snapshot_path(self) -> str:
         # the multihost plane snapshots PER PROCESS (each host owns its
@@ -1069,6 +1145,33 @@ class Trainer:
             self._profile_remaining = 0
 
     def _log(self, m, step, extra: Optional[dict] = None):
+        """Queue this update's metrics WITHOUT materializing them.
+
+        float(m["loss"]) on a live device handle is a full stream sync —
+        paid once per update, it re-serializes the pipeline every dispatch
+        ("async dispatch tax"). Instead: start the device->host copies
+        async, remember the LATEST (m, step, extra), and materialize only
+        when the log cadence fires (cfg.log_interval seconds) or at a cut
+        point (finish_updates, snapshot crossings, run-mode exit — via
+        _flush_log). Updates between cadence firings are never fetched:
+        the metrics jsonl samples the update stream at the log cadence
+        rather than recording every update (episode stats still aggregate
+        exactly — pop_episode_stats moves to emit time)."""
+        for v in (m or {}).values():
+            copy = getattr(v, "copy_to_host_async", None)
+            if copy is not None:
+                copy()
+        self._pending_metrics = (m, step, extra)
+        if time.time() - self._last_log_emit >= self.cfg.log_interval:
+            self._flush_log()
+
+    def _flush_log(self) -> None:
+        """Materialize and emit the queued metrics record, if any."""
+        pend, self._pending_metrics = self._pending_metrics, None
+        if pend is None:
+            return
+        m, step, extra = pend
+        self._last_log_emit = time.time()
         log_extras = getattr(self.plane, "log_extras", None)
         if log_extras is not None:
             extra = {**(extra or {}), **log_extras()}
@@ -1427,6 +1530,7 @@ class Trainer:
             # the deferred metrics of the final dispatch have landed by now
             if pending_log is not None:
                 self._log(*pending_log)
+            self._flush_log()
             # hand the collector loop state back so a later warmup/eval on
             # this Trainer continues from consistent episodes (the sharded
             # runner keeps one PRNG stream per shard; shard 0's continues
@@ -1486,7 +1590,17 @@ def main(argv=None):
     p.add_argument("--profile-steps", type=int, default=20)
     p.add_argument("--profile-port", type=int, default=0,
                    help="if set, start a live profiler server on this port")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache directory "
+                        "(R2D2_COMPILE_CACHE env var is the same knob; "
+                        "default: repo-local .jax_cache on accelerator "
+                        "backends)")
     args = p.parse_args(argv)
+
+    if args.compile_cache:
+        from r2d2_tpu.utils.compilation_cache import enable_compilation_cache
+
+        enable_compilation_cache(args.compile_cache)
 
     if args.distributed:
         from r2d2_tpu.parallel.multihost import initialize_distributed
@@ -1562,6 +1676,9 @@ def main(argv=None):
         from r2d2_tpu.utils.supervision import exit_for_stall
 
         exit_for_stall(e)
+    from r2d2_tpu.utils.compilation_cache import log_compile_cache_stats
+
+    log_compile_cache_stats()
     if trainer.preempted:
         # CLI contract: SIGTERM was absorbed into a clean cut — replay
         # snapshot + mid-run carry + finalized checkpoint are on disk.

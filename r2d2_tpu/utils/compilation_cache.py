@@ -15,7 +15,17 @@ distinct program, not once per process.
 
 Opt-out: set R2D2_TPU_NO_COMPILE_CACHE=1 (e.g. when measuring true cold
 compile times — bench.py does this for its compile-time metric).
-"""
+
+Directory selection (first match wins):
+  1. explicit `cache_dir` argument (the CLIs' --compile-cache flag)
+  2. R2D2_COMPILE_CACHE env var
+  3. the repo-local .jax_cache default
+
+Hit/miss accounting: enable_compilation_cache registers a
+jax.monitoring listener counting the persistent-cache events jax's
+compiler emits; log_compile_cache_stats() prints one
+`[compile-cache] dir=... hits=H misses=M` line (the CLIs call it after
+warmup/run so a driver log shows whether the cache actually served)."""
 
 from __future__ import annotations
 
@@ -26,6 +36,46 @@ _DEFAULT_DIR = os.path.join(
     ".jax_cache",
 )
 
+# persistent-cache event counters (jax._src.compiler emits these names)
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_REQ_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+_counts = {_HIT_EVENT: 0, _REQ_EVENT: 0}
+_listener_installed = False
+
+
+def _count_event(event: str, **kwargs) -> None:
+    if event in _counts:
+        _counts[event] += 1
+
+
+def _install_listener() -> None:
+    global _listener_installed
+    if _listener_installed:
+        return
+    import jax
+
+    jax.monitoring.register_event_listener(_count_event)
+    _listener_installed = True
+
+
+def compile_cache_stats() -> dict:
+    """(hits, misses) observed by this process so far. A `miss` is a
+    compile request that consulted the cache and fell through to XLA —
+    cold programs that get WRITTEN for the next process to hit."""
+    hits = _counts[_HIT_EVENT]
+    return {"hits": hits, "misses": max(_counts[_REQ_EVENT] - hits, 0)}
+
+
+def log_compile_cache_stats(prefix: str = "compile-cache") -> str:
+    """Print and return the one-line cache report the CLIs emit."""
+    import jax
+
+    d = jax.config.jax_compilation_cache_dir or "<disabled>"
+    s = compile_cache_stats()
+    line = f"[{prefix}] dir={d} hits={s['hits']} misses={s['misses']}"
+    print(line, flush=True)
+    return line
+
 
 def enable_compilation_cache(cache_dir: str | None = None) -> bool:
     """Idempotently point jax at a persistent compilation cache directory.
@@ -33,14 +83,18 @@ def enable_compilation_cache(cache_dir: str | None = None) -> bool:
     Returns True when the cache is (already) enabled, False when opted
     out. Safe to call before or after backend init; an explicit
     JAX_COMPILATION_CACHE_DIR env var or earlier jax.config setting
-    wins."""
+    wins. cache_dir (or R2D2_COMPILE_CACHE) also enables the cache on
+    the CPU backend — an explicit ask beats the SIGILL-warning caution
+    below, and it is what the tests use."""
     if os.environ.get("R2D2_TPU_NO_COMPILE_CACHE"):
         return False
     import jax
 
+    _install_listener()
     if jax.config.jax_compilation_cache_dir:  # env var or earlier caller
         return True
-    if jax.default_backend() == "cpu":
+    cache_dir = cache_dir or os.environ.get("R2D2_COMPILE_CACHE")
+    if jax.default_backend() == "cpu" and not cache_dir:
         # XLA:CPU AOT cache loads warn about machine-feature mismatches
         # ("could lead to SIGILL") and CPU compiles are cheap — the cache
         # earns its keep only on the accelerator backend

@@ -352,6 +352,57 @@ def test_lock_discipline_covers_affinity_router_shape():
     assert "_counts" in findings[0].message
 
 
+# ------------------------------------------------------ host-tree-in-hot-loop
+
+
+def test_host_tree_in_hot_loop_fires():
+    """A host SumTree call in a learner hot-loop body: under
+    priority_plane='device' that work belongs in-jit (the superstep), so
+    the lint flags each call site."""
+    src = """
+    def drain(self, batches):
+        for b in batches:
+            idx, w = self.tree.sample(64, self.rng)
+            self.tree.update(idx, b)
+            n = sum_tree.leaves()
+        return n
+    """
+    findings, _ = lint(src, path="megastep.py")
+    assert rules_of(findings) == ["host-tree-in-hot-loop"]
+    assert len(findings) == 3
+    assert "priority_plane" in findings[0].message
+
+
+def test_host_tree_rule_ignores_device_ops_and_pytrees():
+    """The in-jit device ops (dst.tree_update / device_sum_tree module
+    functions), jax.tree pytree calls, and non-tree receivers never
+    flag; cold files are exempt entirely; suppression works in place."""
+    src = """
+    import jax
+    from r2d2_tpu.replay import device_sum_tree as dst
+    def superstep(tree, rows, cache):
+        for row in rows:
+            tree = dst.tree_update(tree, 4, row[0], row[1], 0.9)
+            flat = jax.tree.leaves(tree)
+            cache.update(row)
+        return tree
+    """
+    findings, _ = lint(src, path="megastep.py")
+    assert [f for f in findings if f.rule == "host-tree-in-hot-loop"] == []
+    hot = """
+    def drain(self, xs):
+        for x in xs:
+            self.tree.update(x, x)  # r2d2: disable=host-tree-in-hot-loop
+    """
+    findings, suppressed = lint(hot, path="learner.py")
+    assert findings == []
+    assert [f.rule for f in suppressed] == ["host-tree-in-hot-loop"]
+    # the same source in a cold (non-hot-path) module never arms the rule
+    findings, _ = lint(hot.replace("  # r2d2: disable=host-tree-in-hot-loop", ""),
+                       path="replay/control_plane.py")
+    assert findings == []
+
+
 # ---------------------------------------------------------------- suppression
 
 
@@ -405,6 +456,22 @@ def test_jaxpr_entry_point_gate():
 
     findings = jaxpr_rules.scan_entry_points()
     assert findings == [], render_text(findings)
+
+
+def test_jaxpr_superstep_gate_both_precisions():
+    """The N×K priority superstep traces clean at fp32 AND bf16: no f64
+    anywhere (the device tree is the f32 arm of the parity contract),
+    fp32 path bf16-free, bf16 path keeps its islands, and the donated
+    (TrainState, tree) pair aliases fully (ISSUE 9 acceptance)."""
+    from r2d2_tpu.analysis import jaxpr_rules
+
+    for precision in ("fp32", "bf16"):
+        findings = jaxpr_rules.scan_superstep(precision)
+        assert findings == [], render_text(findings)
+    # the gate actually traces the superstep program: the tree-descent
+    # gathers and the train scan both appear in the jaxpr text
+    text = jaxpr_rules.priority_superstep_jaxpr("fp32")
+    assert "scan" in text and "f32[" in text
 
 
 # --------------------------------------------------- jaxpr checker negatives

@@ -336,3 +336,155 @@ def test_control_plane_fuzz_deferred_reservation_protocol():
         assert cp.block_ptr == ptr
         assert cp.ptr_advances == advances
         np.testing.assert_allclose(cp.tree.leaves(), leaf, rtol=1e-9)
+
+
+# --------------------------------------------------------------------------
+# three-way host-f64 / host-native / device-f32 parity (ISSUE 9 satellite):
+# the device tree (replay/device_sum_tree.py) must be ALGORITHMICALLY
+# identical to the host tree — same layout, stratum arithmetic, IS-weight
+# formula, stale-window verdict — with only a bounded f32 drift class.
+
+
+def _tree_arms(capacity, prio_exponent=0.9, is_exponent=0.6):
+    """All available sum-tree implementations keyed by arm name."""
+    from r2d2_tpu._native import load_native
+    from r2d2_tpu.replay.device_sum_tree import DeviceSumTree
+
+    arms = {
+        "host_f64": SumTree(capacity, prio_exponent, is_exponent),
+        "device_f32": DeviceSumTree(capacity, prio_exponent, is_exponent),
+    }
+    native = load_native()
+    if native is not None:  # toolchain-gated third arm
+        arms["host_native"] = SumTree(
+            capacity, prio_exponent, is_exponent, native=native
+        )
+    return arms
+
+
+def test_three_way_update_parity_with_f32_drift_bound():
+    """Random update rounds (with DUPLICATE indices — last-wins must agree)
+    keep every arm's leaves and total within the f32 drift bound of the
+    f64 reference; the native arm must match f64 near-exactly."""
+    cap = 200
+    arms = _tree_arms(cap)
+    rng = np.random.default_rng(11)
+    for _ in range(30):
+        m = int(rng.integers(1, 64))
+        idxes = rng.integers(0, cap, size=m)  # duplicates likely
+        tds = rng.uniform(0.0, 8.0, size=m)
+        for t in arms.values():
+            t.update(idxes, tds)
+        ref = arms["host_f64"].leaves()
+        for name, t in arms.items():
+            got = np.asarray(t.leaves() if name == "device_f32" else t.leaves())
+            rtol = 1e-5 if name == "device_f32" else 1e-9
+            np.testing.assert_allclose(got, ref, rtol=rtol, atol=1e-6, err_msg=name)
+            np.testing.assert_allclose(
+                t.total, arms["host_f64"].total, rtol=1e-4 if name == "device_f32" else 1e-9
+            )
+
+
+def test_three_way_sample_round_trip_and_is_weights():
+    """update -> sample -> IS-weight round trips on every arm: samples are
+    in range and stratified (bracketed by the f64 cumulative sums at each
+    arm's precision), and the IS weights reproduce (p/min_p)^-beta from
+    that arm's OWN sampled priorities."""
+    import jax
+
+    cap = 128
+    beta = 0.6
+    arms = _tree_arms(cap, prio_exponent=1.0, is_exponent=beta)
+    rng = np.random.default_rng(12)
+    tds = rng.uniform(0.1, 4.0, size=cap)
+    for t in arms.values():
+        t.update(np.arange(cap), tds)
+    n = 32
+    for name, t in arms.items():
+        if name == "device_f32":
+            idxes, w = t.sample(n, jax.random.PRNGKey(3))
+            idxes, w = np.asarray(idxes), np.asarray(w)
+        else:
+            idxes, w = t.sample(n, np.random.default_rng(3))
+        assert idxes.shape == (n,) and (idxes >= 0).all() and (idxes < cap).all()
+        # stratification: leaf i's cumulative interval must intersect
+        # stratum k's interval (float-boundary slop of one leaf allowed)
+        p = np.asarray(t.priorities_of(idxes), np.float64)
+        cum = np.cumsum(arms["host_f64"].leaves())
+        lo, hi = cum[idxes] - p * 1.001 - 1e-4, cum[idxes] + 1e-4
+        stratum = cum[-1] / n
+        assert (hi >= np.arange(n) * stratum * (1 - 1e-5)).all(), name
+        assert (lo <= (np.arange(n) + 1) * stratum * (1 + 1e-5)).all(), name
+        # IS weights: exact formula at this arm's own priorities
+        pos = p[p > 0]
+        min_p = pos.min() if pos.size else 1.0
+        want = (np.maximum(p, min_p) / min_p) ** -beta
+        np.testing.assert_allclose(w, want, rtol=1e-4, err_msg=name)
+
+
+def test_device_stale_mask_matches_host_window_verdict():
+    """device_sum_tree.stale_mask reproduces update_priorities' pointer-
+    window + full-lap verdict for every (old_ptr, ptr) shape (forward,
+    wrapped, equal) and both lap outcomes."""
+    from r2d2_tpu.replay.device_sum_tree import stale_mask
+
+    S, nb = 4, 6
+    idxes = np.arange(nb * S)
+    for old_ptr in range(nb):
+        for ptr in range(nb):
+            for adv in (0, nb - 1, nb, nb + 3):
+                got = np.asarray(
+                    stale_mask(idxes, old_ptr, ptr, S, 0, adv, nb)
+                )
+                if adv >= nb:
+                    want = np.zeros(len(idxes), bool)
+                elif ptr > old_ptr:
+                    want = (idxes < old_ptr * S) | (idxes >= ptr * S)
+                elif ptr < old_ptr:
+                    want = (idxes < old_ptr * S) & (idxes >= ptr * S)
+                else:
+                    want = np.ones(len(idxes), bool)
+                np.testing.assert_array_equal(got, want, err_msg=f"{old_ptr}->{ptr} adv={adv}")
+
+
+def test_device_tree_update_mask_and_duplicates():
+    """tree_update's mask drops rows without touching their leaves, and
+    duplicate indices resolve to the LAST VALID occurrence — the host
+    numpy fancy-assignment order."""
+    import jax.numpy as jnp
+
+    from r2d2_tpu.replay import device_sum_tree as dst
+
+    cap = 16
+    L = dst.tree_layers(cap)
+    tree = dst.tree_from_leaves(np.full(cap, 2.0, np.float32), cap)
+    idxes = jnp.asarray([3, 5, 3, 7, 3], jnp.int32)
+    tds = jnp.asarray([1.0, 4.0, 9.0, 16.0, 25.0], jnp.float32)
+    mask = jnp.asarray([True, True, True, False, False])
+    out = dst.tree_update(tree, L, idxes, tds, 0.5, mask=mask)
+    leaves = np.asarray(out[dst.leaf_offset(L) : dst.leaf_offset(L) + cap])
+    want = np.full(cap, 2.0, np.float32)
+    want[3] = 3.0   # last VALID duplicate (td=9.0)**0.5, not the masked 25.0
+    want[5] = 2.0
+    np.testing.assert_allclose(leaves, want, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[0]), want.sum(), rtol=1e-6)
+
+
+def test_device_tree_f32_drift_stays_bounded_over_many_updates():
+    """The drift class: internal sums are recomputed from children every
+    update (never accumulated), so f32 error vs the f64 tree must stay at
+    rounding scale after thousands of updates, not grow with update count."""
+    from r2d2_tpu.replay.device_sum_tree import DeviceSumTree
+
+    cap = 256
+    host = SumTree(cap, 0.9, 0.6)
+    dev = DeviceSumTree(cap, 0.9, 0.6)
+    rng = np.random.default_rng(13)
+    for _ in range(300):
+        m = int(rng.integers(1, 32))
+        idxes = rng.integers(0, cap, size=m)
+        tds = rng.uniform(0.0, 10.0, size=m)
+        host.update(idxes, tds)
+        dev.update(idxes, tds)
+    np.testing.assert_allclose(dev.leaves(), host.leaves(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(dev.total, host.total, rtol=1e-4)
