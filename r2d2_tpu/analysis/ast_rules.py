@@ -14,6 +14,22 @@ deliberate exception is silenced in place with
 
 so every suppression is visible in the diff it rides in on.
 
+Lock-discipline exceptions have a PRECISE variant: instead of muting the
+rule, an annotation asserts WHICH lock protects the write —
+
+    self.count += 1  # r2d2: guarded-by(lock)   (this write: caller holds
+                                                 self.lock)
+    def _account(self):  # r2d2: guarded-by(lock)
+        ...                                     (whole function runs with
+                                                 self.lock held — the
+                                                 caller-holds-lock contract)
+
+A guarded-by annotation silences `lock-discipline` for the covered lines
+exactly like a disable comment would, but unlike a disable it feeds the
+interprocedural concurrency pass (analysis/concurrency.py), which treats
+the named lock as held there and CHECKS the assertion's consequences
+(lock-order edges, cross-thread guard consistency) instead of going blind.
+
 Rule catalog (ids, severities — the table in ARCHITECTURE.md mirrors this):
 
 - host-sync-in-hot-path  (warning)  `.item()` / `jax.device_get` /
@@ -95,6 +111,7 @@ _SYNC_CALLS = {
 }
 
 _DISABLE_RE = re.compile(r"#\s*r2d2:\s*disable=([A-Za-z0-9_,\s-]+)")
+_GUARDED_BY_RE = re.compile(r"#\s*r2d2:\s*guarded-by\(([A-Za-z0-9_.\s,]+)\)")
 
 _MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
 
@@ -130,6 +147,39 @@ def _suppressions(src_lines: Sequence[str]) -> Dict[int, Set[str]]:
         targets = (i, i + 1) if line.lstrip().startswith("#") else (i,)
         for target in targets:
             out.setdefault(target, set()).update(rules)
+    return out
+
+
+def _guarded_by_comments(src_lines: Sequence[str]) -> Dict[int, Set[str]]:
+    """Line -> lock names asserted held there by `# r2d2: guarded-by(X)`
+    annotations. Same placement rules as _suppressions: a trailing comment
+    covers its own line, a comment-only line covers itself and the line
+    below."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(src_lines, start=1):
+        m = _GUARDED_BY_RE.search(line)
+        if not m:
+            continue
+        names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+        targets = (i, i + 1) if line.lstrip().startswith("#") else (i,)
+        for target in targets:
+            out.setdefault(target, set()).update(names)
+    return out
+
+
+def guarded_by_map(tree: ast.AST, src_lines: Sequence[str]) -> Dict[int, Set[str]]:
+    """The full guarded-by map for one file: per-line annotations, with a
+    def-line annotation expanded over the whole function body (the
+    caller-holds-lock contract — every statement in the function runs
+    with the named lock held)."""
+    out = _guarded_by_comments(src_lines)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        names = out.get(node.lineno)
+        if names:
+            for ln in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+                out.setdefault(ln, set()).update(names)
     return out
 
 
@@ -738,12 +788,18 @@ def analyze_source(
         )
     src_lines = text.splitlines()
     suppress = _suppressions(src_lines)
+    guards = guarded_by_map(tree, src_lines)
     findings: List[Finding] = []
     suppressed: List[Finding] = []
     for rule_fn in _RULES:
         for f in rule_fn(tree, path):
             rules_here = suppress.get(f.line, set())
             if f.rule in rules_here or "all" in rules_here:
+                suppressed.append(f)
+            elif f.rule == "lock-discipline" and guards.get(f.line):
+                # a guarded-by annotation asserts the named lock is held
+                # at this write (caller-holds-lock contract); the
+                # concurrency pass checks the assertion interprocedurally
                 suppressed.append(f)
             else:
                 findings.append(f)

@@ -1,14 +1,19 @@
 """CLI for the analysis plane.
 
-    python -m r2d2_tpu.analysis [--format text|json] [--changed-only]
-                                [--jaxpr] [paths...]
+    python -m r2d2_tpu.analysis [--format text|json|sarif] [--changed-only]
+                                [--jaxpr] [--concurrency] [paths...]
 
 Default paths: the installed r2d2_tpu package tree. Exit status 1 when any
 unsuppressed finding remains (suppressed ones are counted in text mode but
 never gate). `--changed-only` narrows to files reported by
 `git diff --name-only HEAD` plus untracked .py files — the fast local
 loop. `--jaxpr` additionally traces the canonical entry points at both
-precisions (slower: pulls in jax and the model stack).
+precisions (slower: pulls in jax and the model stack); combined with
+`--changed-only` the jaxpr results are served from a cache keyed on a
+hash of the traced entry-point sources, so unchanged traces cost nothing.
+`--concurrency` runs the interprocedural thread/lock pass (concurrency.py)
+over the same paths. `--format sarif` emits SARIF 2.1.0 for CI annotation
+(runs/run_analyze_ci.sh).
 """
 
 from __future__ import annotations
@@ -20,7 +25,11 @@ import sys
 from typing import List
 
 from r2d2_tpu.analysis import ast_rules
-from r2d2_tpu.analysis.findings import render_json, render_text
+from r2d2_tpu.analysis.findings import render_json, render_sarif, render_text
+
+# --changed-only --jaxpr result cache, relative to the repo root (see
+# scan_entry_points_cached); untracked, cheap to delete
+_JAXPR_CACHE = ".r2d2_jaxpr_cache.json"
 
 
 def _changed_files(repo_root: str) -> List[str]:
@@ -48,13 +57,16 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="r2d2-analyze",
         description="JAX-aware static analysis: dtype/recompile/host-sync/"
-        "donation/fault-site lints",
+        "donation/fault-site lints, jaxpr gates, and the interprocedural "
+        "concurrency pass",
     )
     parser.add_argument(
         "paths", nargs="*",
         help="files or directories to lint (default: the r2d2_tpu package)",
     )
-    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument(
+        "--format", choices=("text", "json", "sarif"), default="text"
+    )
     parser.add_argument(
         "--changed-only", action="store_true",
         help="lint only git-changed/untracked .py files (fast local loop)",
@@ -62,13 +74,20 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--jaxpr", action="store_true",
         help="also trace the canonical train/act/serve entry points at both "
-        "precisions and run the jaxpr checkers (slow: imports jax)",
+        "precisions and run the jaxpr checkers (slow: imports jax; cached "
+        "under --changed-only)",
+    )
+    parser.add_argument(
+        "--concurrency", action="store_true",
+        help="also run the interprocedural concurrency pass: thread-root "
+        "inventory, lock-order cycles, cross-thread write guards, and "
+        "blocking-under-lock",
     )
     args = parser.parse_args(argv)
 
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo_root = os.path.dirname(pkg_root)
     if args.changed_only:
-        repo_root = os.path.dirname(pkg_root)
         paths = _changed_files(repo_root)
     elif args.paths:
         paths = args.paths
@@ -76,13 +95,29 @@ def main(argv=None) -> int:
         paths = [pkg_root]
 
     findings, suppressed = ast_rules.analyze_paths(paths)
+    if args.concurrency:
+        # the pass is interprocedural: a changed file's hazards can live in
+        # its callers, so it always runs over the full requested tree (the
+        # default package root under --changed-only)
+        from r2d2_tpu.analysis import concurrency
+
+        conc_paths = args.paths if args.paths else [pkg_root]
+        cf, cs = concurrency.analyze_paths(conc_paths)
+        findings = findings + cf
+        suppressed = suppressed + cs
     if args.jaxpr:
         from r2d2_tpu.analysis import jaxpr_rules
 
-        findings = findings + jaxpr_rules.scan_entry_points()
+        if args.changed_only:
+            cache_path = os.path.join(repo_root, _JAXPR_CACHE)
+            findings = findings + jaxpr_rules.scan_entry_points_cached(cache_path)
+        else:
+            findings = findings + jaxpr_rules.scan_entry_points()
 
     if args.format == "json":
         print(render_json(findings))
+    elif args.format == "sarif":
+        print(render_sarif(findings))
     else:
         print(render_text(findings))
         if suppressed:

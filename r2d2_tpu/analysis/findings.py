@@ -1,10 +1,13 @@
 """Findings model for the static-analysis plane.
 
-One shape for every checker — AST lints and jaxpr scanners alike — so the
-CLI, the tier-1 gate (tests/test_analysis.py), and ad-hoc callers all
-consume the same records: rule id, severity, file:line, message, and a fix
-hint. JSON output is stable-sorted (path, line, col, rule, message) so two
-runs over the same tree diff clean.
+One shape for every checker — AST lints, jaxpr scanners, and the
+interprocedural concurrency pass alike — so the CLI, the tier-1 gate
+(tests/test_analysis.py), and ad-hoc callers all consume the same records:
+rule id, severity, file:line, message, and a fix hint. Rendering is fully
+deterministic: stable-sorted (path, line, col, rule, message) AND deduped
+(identical findings from overlapping scans — e.g. a file passed twice, or
+a rule family run both standalone and via the CLI — collapse to one), so
+text/JSON/SARIF outputs diff clean in CI.
 """
 
 from __future__ import annotations
@@ -53,7 +56,9 @@ class Finding:
 
 
 def stable_sort(findings: Iterable[Finding]) -> List[Finding]:
-    return sorted(findings, key=Finding.sort_key)
+    """Sorted AND deduped: identical findings from overlapping scans
+    (same rule/severity/location/message/hint) collapse to one record."""
+    return sorted(dict.fromkeys(findings), key=Finding.sort_key)
 
 
 def render_text(findings: Iterable[Finding]) -> str:
@@ -72,3 +77,55 @@ def render_json(findings: Iterable[Finding]) -> str:
         indent=2,
         sort_keys=True,
     )
+
+
+# SARIF severity levels per the 2.1.0 spec (sarifv2.1.0 §3.27.10)
+_SARIF_LEVELS = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def render_sarif(findings: Iterable[Finding]) -> str:
+    """SARIF 2.1.0 for CI annotation (runs/run_analyze_ci.sh). Rule ids
+    are the stable in-repo rule names; locations map to file + 1-based
+    line/column regions (jaxpr findings keep their `<jaxpr:label>` pseudo
+    path with a line-1 region — SARIF requires a positive startLine).
+    Output is stable-sorted + deduped like the JSON renderer."""
+    fs = stable_sort(findings)
+    rules = sorted({f.rule for f in fs})
+    results = []
+    for f in fs:
+        text = f.message if not f.hint else f"{f.message} (hint: {f.hint})"
+        results.append(
+            {
+                "ruleId": f.rule,
+                "level": _SARIF_LEVELS[f.severity],
+                "message": {"text": text},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {
+                                "startLine": max(f.line, 1),
+                                "startColumn": f.col + 1,
+                            },
+                        }
+                    }
+                ],
+            }
+        )
+    doc = {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+        "master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "r2d2-analyze",
+                        "rules": [{"id": r} for r in rules],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)

@@ -31,6 +31,9 @@ for a traced program; the label names the entry point and precision.
 from __future__ import annotations
 
 import functools
+import hashlib
+import json
+import os
 from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
@@ -505,7 +508,7 @@ def check_int8_weights(jaxpr_text: str, label: str) -> List[Finding]:
                 "serve_quantization='int8' traced a step with no int8 "
                 "arrays: the quantized publish path is not reaching the "
                 "jitted step",
-                hint="PolicyServer._prepare_params must run at every "
+                hint="PolicyServer.prepare_for_publish must run at every "
                 "publish point (init and reload_now)",
             )
         )
@@ -992,3 +995,92 @@ def scan_entry_points(
     out += scan_multi_serve_step("fp32", "int8")
     out.sort(key=Finding.sort_key)
     return out
+
+
+# -------------------------------------------------- source-keyed result cache
+
+# Everything the canonical traces can reach: the jaxprs are pure functions
+# of these sources (plus jax itself, which the fast local loop does not
+# version — a jax upgrade warrants one uncached run). Directories are
+# walked recursively.
+_ENTRY_POINT_SOURCES = (
+    "config.py",
+    "learner.py",
+    "megastep.py",
+    "models",
+    "ops",
+    "parallel",
+    "replay/block.py",
+    "replay/device_store.py",
+    "replay/device_sum_tree.py",
+    "serve/batcher.py",
+    "serve/multi.py",
+    "serve/server.py",
+    "serve/state_cache.py",
+    "analysis/jaxpr_rules.py",  # the checkers are inputs too
+)
+
+
+def entry_point_source_files() -> List[str]:
+    """Absolute paths of every source file the traced entry points (and
+    the checkers) depend on."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out: List[str] = []
+    for rel in _ENTRY_POINT_SOURCES:
+        p = os.path.join(pkg, rel)
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                out.extend(
+                    os.path.join(root, f) for f in files if f.endswith(".py")
+                )
+        elif os.path.exists(p):
+            out.append(p)
+    return sorted(out)
+
+
+def source_fingerprint() -> str:
+    """sha256 over (relative path, bytes) of every entry-point source, in
+    sorted order — identical tree, identical fingerprint, regardless of
+    mtimes or checkout location."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    h = hashlib.sha256()
+    for path in entry_point_source_files():
+        h.update(os.path.relpath(path, pkg).replace(os.sep, "/").encode())
+        h.update(b"\0")
+        with open(path, "rb") as fh:
+            h.update(fh.read())
+        h.update(b"\0")
+    return h.hexdigest()
+
+
+def scan_entry_points_cached(
+    cache_path: str, precisions: Sequence[str] = ("fp32", "bf16")
+) -> List[Finding]:
+    """scan_entry_points with a result cache keyed on source_fingerprint():
+    when none of the traced sources changed, the cached findings are
+    returned without importing the model stack or tracing anything —
+    `--changed-only --jaxpr` drops from tens of seconds to milliseconds.
+    A corrupt/stale/missing cache falls through to a real scan."""
+    fp = source_fingerprint()
+    try:
+        with open(cache_path, encoding="utf-8") as fh:
+            data = json.load(fh)
+        if data.get("fingerprint") == fp:
+            return [Finding(**d) for d in data["findings"]]
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    findings = scan_entry_points(precisions)
+    tmp = f"{cache_path}.tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(
+                {
+                    "fingerprint": fp,
+                    "findings": [f.to_dict() for f in findings],
+                },
+                fh,
+            )
+        os.replace(tmp, cache_path)
+    except OSError:
+        pass  # cache is an optimization; the scan result stands
+    return findings

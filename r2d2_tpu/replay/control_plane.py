@@ -101,6 +101,7 @@ class ReplayControlPlane:
 
     # --- accounting (call with self.lock held) ----------------------------
 
+    # r2d2: guarded-by(lock)
     def _account_block_at(
         self, slot: int, num_sequences: int, learning_total: int,
         priorities: np.ndarray, episode_reward: Optional[float],
@@ -120,9 +121,8 @@ class ReplayControlPlane:
         self.size += learning_total
         self.env_steps += learning_total
         if episode_reward is not None:
-            # caller holds self.lock (method contract above)
-            self.episode_reward_sum += episode_reward  # r2d2: disable=lock-discipline
-            self.num_episodes += 1  # r2d2: disable=lock-discipline
+            self.episode_reward_sum += episode_reward
+            self.num_episodes += 1
             self.total_episodes += 1
             self.total_reward_sum += episode_reward
 

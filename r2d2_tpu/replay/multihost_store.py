@@ -155,24 +155,33 @@ class MultiHostShardedReplay:
 
     # ------------------------------------------------------------------ add
 
+    def _reserve_shards(self, n: int) -> List[int]:
+        """Round-robin shard assignment for the next n blocks. The only
+        touch of self._rr, so callers can stage each block's H2D copy onto
+        its shard device BEFORE taking the store lock — a concurrent
+        run_step must never wait on a device transfer."""
+        with self.lock:
+            out = []
+            for _ in range(n):
+                out.append(self.local_ids[self._rr])
+                self._rr = (self._rr + 1) % len(self.local_ids)
+            return out
+
     def _add_one_locked(
-        self, vals: Dict[str, jnp.ndarray], num_sequences: int,
+        self, g: int, vals: Dict[str, jnp.ndarray], num_sequences: int,
         learning_total: int, priorities: np.ndarray,
         episode_reward: Optional[float],
     ) -> None:
-        """Write ONE block's fields into the next local shard and account
-        it (write first, account last — the add contract shared with the
-        other planes). Caller holds self.lock; vals must live on (or be
-        movable to) the owning shard's device."""
-        g = self.local_ids[self._rr]
+        """Write ONE block's fields into local shard g and account it
+        (write first, account last — the add contract shared with the
+        other planes). Caller holds self.lock and has already placed vals
+        on shard g's device."""
         shard = self.shards[g]
-        vals = {k: jax.device_put(v, self._shard_device[g]) for k, v in vals.items()}
         with shard.lock:
             self.stores[g] = self._write(self.stores[g], shard.block_ptr, vals)
             shard._account_add(
                 num_sequences, learning_total, priorities, episode_reward
             )
-        self._rr = (self._rr + 1) % len(self.local_ids)
 
     def add_block(
         self, block: Block, priorities: np.ndarray, episode_reward: Optional[float]
@@ -180,9 +189,11 @@ class MultiHostShardedReplay:
         """Write one block into the next LOCAL shard (host-local op; other
         hosts add to their own shards independently)."""
         vals = DeviceReplayBuffer.pad_block_fields(self.cfg, block)
+        (g,) = self._reserve_shards(1)
+        vals = {k: jax.device_put(v, self._shard_device[g]) for k, v in vals.items()}
         with self.lock:
             self._add_one_locked(
-                vals, block.num_sequences, int(block.learning_steps.sum()),
+                g, vals, block.num_sequences, int(block.learning_steps.sum()),
                 priorities, episode_reward,
             )
 
@@ -201,11 +212,19 @@ class MultiHostShardedReplay:
         so the device collector composes with the multihost plane exactly
         like with the single-host planes. Block i's fields hop from the
         collect dispatch's device to the owning shard's device (an
-        intra-host copy of ~one block)."""
+        intra-host copy of ~one block, staged before the store lock)."""
+        gs = self._reserve_shards(len(num_seq))
+        staged = [
+            {
+                k: jax.device_put(v[i], self._shard_device[g])
+                for k, v in fields.items()
+            }
+            for i, g in enumerate(gs)
+        ]
         with self.lock:
-            for i in range(len(num_seq)):
+            for i, g in enumerate(gs):
                 self._add_one_locked(
-                    {k: v[i] for k, v in fields.items()},
+                    g, staged[i],
                     int(num_seq[i]),
                     int(learning_totals[i]),
                     priorities[i],

@@ -203,9 +203,10 @@ class ShardedDeviceReplay:
                 sh.attach_device_tree(_ShardTreeMirror(self, sid))
         self.lock = threading.Lock()
 
+    # r2d2: guarded-by(lock)
     def _dtree_row_update(self, sid: int, idxes, td_errors) -> None:
         # callers (_tree_write via add_block/update_priorities) already hold
-        # self.lock; the Lock is non-reentrant  # r2d2: disable=lock-discipline
+        # self.lock; the Lock is non-reentrant, so this must not re-acquire
         self.dtree_stack = self._row_update_fn(
             self.dtree_stack,
             jnp.int32(sid),

@@ -110,16 +110,17 @@ class MicroBatcher:
     # -------------------------------------------------------------- batching
 
     def _take_deferred(self, batch: List[ServeRequest], seen: set) -> None:
-        keep: "deque[ServeRequest]" = deque()
-        while self._deferred and len(batch) < self.max_batch:
-            req = self._deferred.popleft()
-            if req.session_id in seen:
-                keep.append(req)
-            else:
-                seen.add(req.session_id)
-                batch.append(req)
-        keep.extend(self._deferred)
-        self._deferred = keep
+        with self._lock:
+            keep: "deque[ServeRequest]" = deque()
+            while self._deferred and len(batch) < self.max_batch:
+                req = self._deferred.popleft()
+                if req.session_id in seen:
+                    keep.append(req)
+                else:
+                    seen.add(req.session_id)
+                    batch.append(req)
+            keep.extend(self._deferred)
+            self._deferred = keep
 
     def next_batch(self, timeout: float = 0.25) -> List[ServeRequest]:
         """Form one batch: block up to `timeout` for the first request
@@ -145,15 +146,19 @@ class MicroBatcher:
             except queue.Empty:
                 break
             if req.session_id in seen:
-                self._deferred.append(req)
-                self.deferrals += 1
+                with self._lock:
+                    self._deferred.append(req)
+                    self.deferrals += 1
             else:
                 seen.add(req.session_id)
                 batch.append(req)
-        self.batches += 1
-        self.requests += len(batch)
-        self.occupancy_sum += len(batch)
-        self.padded_sum += self.bucket_for(len(batch))
+        # drain()/stats() run on the shutdown/metrics threads while the
+        # serve loop is mid-batch: counters share the deferral lock
+        with self._lock:
+            self.batches += 1
+            self.requests += len(batch)
+            self.occupancy_sum += len(batch)
+            self.padded_sum += self.bucket_for(len(batch))
         return batch
 
     def bucket_for(self, n: int) -> int:
@@ -166,8 +171,9 @@ class MicroBatcher:
     def drain(self) -> List[ServeRequest]:
         """Remove and return everything still queued (server shutdown —
         the caller fails the futures)."""
-        out: List[ServeRequest] = list(self._deferred)
-        self._deferred.clear()
+        with self._lock:
+            out: List[ServeRequest] = list(self._deferred)
+            self._deferred.clear()
         while True:
             try:
                 out.append(self._q.get_nowait())
@@ -176,16 +182,15 @@ class MicroBatcher:
 
     def stats(self) -> dict:
         with self._lock:
-            rejected = self.rejected
-        batches = max(self.batches, 1)
-        return {
-            "queue_depth": self.qsize(),
-            "batches": self.batches,
-            "requests": self.requests,
-            "rejected": rejected,
-            "deferrals": self.deferrals,
-            "mean_batch_occupancy": self.occupancy_sum / batches,
-            # real rows / padded rows: how much of the compiled shapes the
-            # traffic actually fills
-            "bucket_fill": self.occupancy_sum / max(self.padded_sum, 1),
-        }
+            batches = max(self.batches, 1)
+            return {
+                "queue_depth": self.qsize(),
+                "batches": self.batches,
+                "requests": self.requests,
+                "rejected": self.rejected,
+                "deferrals": self.deferrals,
+                "mean_batch_occupancy": self.occupancy_sum / batches,
+                # real rows / padded rows: how much of the compiled shapes
+                # the traffic actually fills
+                "bucket_fill": self.occupancy_sum / max(self.padded_sum, 1),
+            }

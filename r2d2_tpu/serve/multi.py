@@ -229,21 +229,26 @@ class MultiDeviceServer:
 
     def reload_now(self) -> bool:
         """One reload check for the whole fleet: restore the latest step
-        once, publish to every replica under one shared version inside one
-        critical section. Returns True if new params went live."""
+        once, stage every replica's device copy OUTSIDE the reload lock
+        (the per-device quantize + H2D transfer is the slow part — doing
+        it inside the critical section would stall serving fleet-wide for
+        N device transfers), then install all replicas under one shared
+        version inside one O(N) critical section. Returns True if new
+        params went live."""
         fault_point("serve.reload")
         step = latest_checkpoint_step(self.checkpoint_dir)
         if step is None or step == self._ckpt_step:
             return False
         state, _, _ = restore_checkpoint(self.checkpoint_dir, self._template, step)
+        staged = [r.prepare_for_publish(state.params) for r in self.replicas]
         with self._reload_lock:
             version = self._version + 1
-            for r in self.replicas:
-                r.publish(state.params, int(state.step), version=version)
+            for r, prepared in zip(self.replicas, staged):
+                r.install_prepared(prepared, int(state.step), version=version)
             self._params_host = state.params
             self._version = version
             self._ckpt_step = int(state.step)
-        self.reloads += 1
+            self.reloads += 1
         return True
 
     def _watch_iteration(self) -> None:
@@ -252,7 +257,8 @@ class MultiDeviceServer:
         try:
             self.reload_now()
         except (OSError, InjectedFault):
-            self.reload_errors += 1
+            with self._reload_lock:
+                self.reload_errors += 1
             wait = self._watch_backoff.fail()
         else:
             self._watch_backoff.reset()

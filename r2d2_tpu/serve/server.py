@@ -159,6 +159,14 @@ class PolicyServer:
         # jitted step dequantizes int8 weights in-jit instead of fetching
         # f32 kernels from HBM. Default "none" publishes params as-is.
         self.quantized_leaves = 0
+        # guards the mutable serve-plane state shared between the serve
+        # loop, the checkpoint watcher, the fleet reload path, and
+        # stop()-from-main: the publish cell + its version counter, the
+        # reload counters, and the in-flight batch handoff. The slow parts
+        # of a publish (quantize, device_put) stay OUTSIDE this lock —
+        # only the O(1) swap happens under it (prepare_for_publish /
+        # install_prepared).
+        self._state_lock = threading.Lock()
         # the atomic hot-reload cell: ONE attribute holding ONE tuple, read
         # once per batch — Python attribute reads are atomic, so a batch
         # sees exactly one (params, step, version) triple, never a mix
@@ -206,26 +214,42 @@ class PolicyServer:
 
     # ------------------------------------------------------------ jit step
 
-    def _prepare_params(self, params):
-        """Publish-time param transform: int8 quantization when enabled."""
+    def prepare_for_publish(self, params):
+        """The slow half of a publish, safe to run with NO lock held:
+        int8 re-quantization when enabled plus the H2D placement onto
+        this replica's device. Returns an opaque staged pair for
+        install_prepared. The fleet reload path stages every replica with
+        this before touching its reload lock so serving never stalls
+        behind a device transfer."""
         if self.cfg.serve_quantization == "int8":
             from r2d2_tpu.ops.quantize import quantize_tree
 
-            params, self.quantized_leaves = quantize_tree(params)
-        return params
+            params, leaves = quantize_tree(params)
+        else:
+            leaves = 0
+        if self.device is not None:
+            params = jax.device_put(params, self.device)
+        return params, leaves
+
+    def install_prepared(self, prepared, ckpt_step: int,
+                         version: Optional[int] = None) -> None:
+        """The O(1) lock-held tail of a publish: swap the publish cell
+        (one tuple write) and bump the version. No device work, no I/O."""
+        prepared_params, leaves = prepared
+        with self._state_lock:
+            self.quantized_leaves = leaves
+            if version is None:
+                version = self._published[2] + 1
+            self._published = (prepared_params, int(ckpt_step), version)
 
     def publish(self, params, ckpt_step: int, version: Optional[int] = None) -> None:
         """Atomically publish a param set to this server/replica: prepare
         (int8 re-quantization when enabled), place on this replica's
-        device, then swap the publish cell in ONE attribute write. The
-        multi-device server calls this per replica with an explicit shared
-        version so all replicas advance in lockstep."""
-        prepared = self._prepare_params(params)
-        if self.device is not None:
-            prepared = jax.device_put(prepared, self.device)
-        if version is None:
-            version = self._published[2] + 1
-        self._published = (prepared, int(ckpt_step), version)
+        device — both outside the state lock — then swap the publish cell
+        in ONE guarded write. The multi-device server stages all replicas
+        via prepare_for_publish and installs with an explicit shared
+        version so the fleet advances in lockstep."""
+        self.install_prepared(self.prepare_for_publish(params), ckpt_step, version)
 
     def _build_step(self):
         net = self.net
@@ -287,7 +311,8 @@ class PolicyServer:
         self.cache.evict(session_id)
 
     def _run_batch(self, batch: List[ServeRequest]) -> None:
-        self._inflight = batch
+        with self._state_lock:
+            self._inflight = batch
         # single read of the publish cell: the whole batch — and the
         # results' provenance — come from one params set
         params, ckpt_step, version = self._published
@@ -338,7 +363,8 @@ class PolicyServer:
             r.future.set_result(
                 ServeResult(int(act_np[i]), q_np[i], ckpt_step, version)
             )
-        self._inflight = []
+        with self._state_lock:
+            self._inflight = []
         if self.metrics is not None:
             self.metrics.log(
                 {
@@ -366,7 +392,8 @@ class PolicyServer:
         repair — stores only commit after a fully successful step, so a
         crash leaves every session at its last committed state and a
         client retry re-runs from exactly there."""
-        inflight, self._inflight = self._inflight, []
+        with self._state_lock:
+            inflight, self._inflight = self._inflight, []
         for r in inflight:
             if not r.future.done():
                 r.future.set_exception(
@@ -386,7 +413,8 @@ class PolicyServer:
             # unreachable (remount, NFS hiccup). Count it and re-poll with
             # exponential backoff; the next successful reload resets the
             # cadence.
-            self.reload_errors += 1
+            with self._state_lock:
+                self.reload_errors += 1
             wait = self._watch_backoff.fail()
         else:
             self._watch_backoff.reset()
@@ -406,7 +434,8 @@ class PolicyServer:
             return False
         state, _, _ = restore_checkpoint(self.checkpoint_dir, self._template, step)
         self.publish(state.params, int(state.step))
-        self.reloads += 1
+        with self._state_lock:
+            self.reloads += 1
         return True
 
     # ------------------------------------------------------------ lifecycle

@@ -255,7 +255,15 @@ class RecurrentStateCache:
                     lr.at[slots_].set(rlr),
                 )
 
+            # single-writer contract: the device stores (and this lazily
+            # compiled scatter) are only ever touched by the thread driving
+            # batches — the serve loop in production, the main thread in
+            # warmup/tests, never both at once (warmup completes before
+            # start()). Taking _lock here would put jit dispatch inside a
+            # critical section for no real race.
+            # r2d2: disable=cross-thread-unguarded-write
             self._promote_fn = jax.jit(scatter, donate_argnums=donate)
+        # r2d2: disable=cross-thread-unguarded-write  (same single-writer contract)
         self.h, self.c, self.last_action, self.last_reward = self._promote_fn(
             self.h, self.c, self.last_action, self.last_reward,
             jnp.asarray(slots), h_rows, c_rows, la_rows, lr_rows,
@@ -292,8 +300,15 @@ class RecurrentStateCache:
 
     def commit(self, h, c, last_action, last_reward) -> None:
         """Install the serve step's updated arrays (serve-loop thread
-        only). The old arrays may have been donated into the step."""
+        only). The old arrays may have been donated into the step.
+        Single-writer contract: only the batch-driving thread (serve loop,
+        or main during warmup — never concurrently) calls commit, so these
+        swaps deliberately take no lock; guarding them would serialize the
+        serve loop against stats() for device-array pointer writes that
+        nothing else mutates."""
+        # r2d2: disable=cross-thread-unguarded-write  (single-writer contract above)
         self.h, self.c = h, c
+        # r2d2: disable=cross-thread-unguarded-write  (single-writer contract above)
         self.last_action, self.last_reward = last_action, last_reward
 
     @property

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# CI entry for the static-analysis gate: run every rule family (AST lints,
+# the interprocedural concurrency pass, and — unless SKIP_JAXPR=1 — the
+# jaxpr entry-point gate) repo-wide and emit SARIF so the CI system can
+# annotate findings inline on the diff. Exit status is the analyzer's:
+# nonzero iff any unsuppressed finding remains, so this doubles as the
+# blocking check. Usage:
+#   runs/run_analyze_ci.sh [OUT.sarif]        # default: analysis.sarif
+#   SKIP_JAXPR=1 runs/run_analyze_ci.sh ...   # AST+concurrency only (fast)
+set -u
+cd "$(dirname "$0")/.."
+
+out=${1:-analysis.sarif}
+args=(--concurrency --format sarif)
+if [ "${SKIP_JAXPR:-0}" != "1" ]; then
+  args+=(--jaxpr)
+fi
+
+# keep tracing off any accelerator the CI runner may expose: the jaxpr
+# gate only inspects program text, CPU avals are identical
+JAX_PLATFORMS=cpu python -m r2d2_tpu.analysis "${args[@]}" > "$out"
+rc=$?
+
+# human-readable tail for the CI log (the SARIF is for the annotator)
+python - "$out" <<'EOF'
+import json, sys
+with open(sys.argv[1], encoding="utf-8") as fh:
+    doc = json.load(fh)
+results = doc["runs"][0]["results"]
+for r in results:
+    loc = r["locations"][0]["physicalLocation"]
+    print(f'{loc["artifactLocation"]["uri"]}:{loc["region"]["startLine"]} '
+          f'[{r["level"]}] {r["ruleId"]}: {r["message"]["text"]}')
+print(f'{len(results)} finding(s) -> {sys.argv[1]}')
+EOF
+exit $rc

@@ -636,3 +636,474 @@ def test_cli_syntax_error_reported(tmp_path, capsys):
     bad = _write(tmp_path, "broken.py", "def f(:\n")
     assert main([bad]) == 1
     assert "syntax-error" in capsys.readouterr().out
+
+
+# ----------------------------------------------------- findings determinism
+
+
+def test_findings_dedupe_overlapping_scans():
+    """Identical findings from overlapping scans collapse to one record in
+    every renderer — the SARIF/JSON outputs must be diff-stable in CI."""
+    from r2d2_tpu.analysis.findings import stable_sort
+
+    f = Finding("r", "error", "a.py", 1, 0, "m")
+    g = Finding("r", "error", "a.py", 1, 0, "m")
+    distinct = Finding("r", "error", "a.py", 1, 0, "other message")
+    assert stable_sort([f, g]) == [f]
+    assert len(stable_sort([f, g, distinct])) == 2
+    assert "1 finding" in render_text([f, g])
+    assert json.loads(render_json([f, g, f]))["count"] == 1
+
+
+def test_sarif_rendering():
+    from r2d2_tpu.analysis.findings import render_sarif
+
+    a = Finding("rule-b", "error", "b.py", 2, 4, "m", hint="h")
+    b = Finding("rule-a", "info", "<jaxpr:x>", 0, 0, "m2")
+    doc = json.loads(render_sarif([a, b, a]))  # dupe collapses
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "r2d2-analyze"
+    # stable rule ids, sorted
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] == [
+        "rule-a", "rule-b"
+    ]
+    assert len(run["results"]) == 2
+    by_rule = {r["ruleId"]: r for r in run["results"]}
+    assert by_rule["rule-a"]["level"] == "note"  # info maps to SARIF note
+    assert by_rule["rule-b"]["level"] == "error"
+    # jaxpr pseudo-paths keep a positive startLine (SARIF requirement)
+    region = by_rule["rule-a"]["locations"][0]["physicalLocation"]["region"]
+    assert region["startLine"] == 1
+    loc = by_rule["rule-b"]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "b.py"
+    assert loc["region"] == {"startLine": 2, "startColumn": 5}  # col is 1-based
+    assert "(hint: h)" in by_rule["rule-b"]["message"]["text"]
+
+
+def test_cli_sarif_format(tmp_path, capsys):
+    from r2d2_tpu.analysis.cli import main
+
+    dirty = _write(
+        tmp_path, "learner.py",
+        """
+        def f(xs):
+            for x in xs:
+                y = x.item()
+        """,
+    )
+    assert main(["--format", "sarif", dirty]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["results"][0]["ruleId"] == "host-sync-in-hot-path"
+
+
+# ------------------------------------------------------- jaxpr result cache
+
+
+def test_jaxpr_source_fingerprint_stable():
+    from r2d2_tpu.analysis import jaxpr_rules as j
+
+    files = j.entry_point_source_files()
+    # the canonical traced surfaces are all in the closure
+    rels = {os.path.relpath(p, PKG_DIR).replace(os.sep, "/") for p in files}
+    for must in ("learner.py", "megastep.py", "serve/server.py",
+                 "serve/multi.py", "replay/block.py",
+                 "analysis/jaxpr_rules.py"):
+        assert must in rels, must
+    assert j.source_fingerprint() == j.source_fingerprint()
+
+
+def test_jaxpr_cache_roundtrip(tmp_path, monkeypatch):
+    """scan_entry_points_cached: first call scans and writes the cache,
+    second call is served from it (no retrace), a fingerprint mismatch
+    forces a rescan, a corrupt cache falls through to a real scan."""
+    from r2d2_tpu.analysis import jaxpr_rules as j
+
+    calls = []
+
+    def fake_scan(precisions=("fp32", "bf16")):
+        calls.append(1)
+        return [Finding("jaxpr-float64", "error", "<jaxpr:x>", 0, 0, "m")]
+
+    monkeypatch.setattr(j, "scan_entry_points", fake_scan)
+    cache = str(tmp_path / "cache.json")
+    out1 = j.scan_entry_points_cached(cache)
+    assert len(calls) == 1 and out1[0].rule == "jaxpr-float64"
+    out2 = j.scan_entry_points_cached(cache)
+    assert len(calls) == 1  # cache hit: no retrace
+    assert out2 == out1
+    with open(cache, encoding="utf-8") as fh:
+        data = json.load(fh)
+    data["fingerprint"] = "stale"
+    with open(cache, "w", encoding="utf-8") as fh:
+        json.dump(data, fh)
+    j.scan_entry_points_cached(cache)
+    assert len(calls) == 2  # source hash mismatch -> rescan
+    with open(cache, "w", encoding="utf-8") as fh:
+        fh.write("not json")
+    j.scan_entry_points_cached(cache)
+    assert len(calls) == 3  # corrupt cache -> rescan
+
+
+def test_cli_changed_only_jaxpr_uses_cache(monkeypatch, capsys):
+    from r2d2_tpu.analysis import cli, jaxpr_rules
+
+    monkeypatch.setattr(cli, "_changed_files", lambda root: [])
+    seen = {}
+
+    def fake_cached(path):
+        seen["path"] = path
+        return []
+
+    monkeypatch.setattr(jaxpr_rules, "scan_entry_points_cached", fake_cached)
+    assert cli.main(["--changed-only", "--jaxpr"]) == 0
+    assert seen["path"].endswith(".r2d2_jaxpr_cache.json")
+    capsys.readouterr()
+
+
+# -------------------------------------------------------- concurrency pass
+
+
+def conc(tmp_path, files):
+    """Run the interprocedural concurrency pass over a fixture package."""
+    from r2d2_tpu.analysis import concurrency
+
+    for name, src in files.items():
+        _write(tmp_path, name, src)
+    return concurrency.analyze_paths([str(tmp_path)])
+
+
+def test_lock_order_cycle_fires_and_consistent_order_clean(tmp_path):
+    cyclic = """
+    import threading
+    class S:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+        def rev(self):
+            with self._b:
+                with self._a:
+                    pass
+    """
+    findings, _ = conc(tmp_path / "pos", {"mod.py": cyclic})
+    assert rules_of(findings) == ["lock-order-cycle"]
+    assert "S._a" in findings[0].message and "S._b" in findings[0].message
+
+    consistent = cyclic.replace(
+        "with self._b:\n                with self._a:",
+        "with self._a:\n                with self._b:",
+    )
+    findings, _ = conc(tmp_path / "neg", {"mod.py": consistent})
+    assert findings == []
+
+
+def test_nonreentrant_reacquire_is_deadlock_rlock_is_not(tmp_path):
+    """Holding a plain Lock while calling a helper that re-acquires it is
+    a guaranteed self-deadlock (threading.Lock is non-reentrant); the same
+    shape on an RLock is legal."""
+    src = """
+    import threading
+    class T:
+        def __init__(self):
+            self._lock = threading.Lock()
+        def _helper(self):
+            with self._lock:
+                pass
+        def run(self):
+            with self._lock:
+                self._helper()
+    """
+    findings, _ = conc(tmp_path / "pos", {"mod.py": src})
+    assert rules_of(findings) == ["lock-order-cycle"]
+    assert "non-reentrant" in findings[0].message
+
+    findings, _ = conc(
+        tmp_path / "neg",
+        {"mod.py": src.replace("threading.Lock()", "threading.RLock()")},
+    )
+    assert findings == []
+
+
+def test_cross_thread_unguarded_write_fires(tmp_path):
+    src = """
+    import threading
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._loop, daemon=True)
+        def _loop(self):
+            while True:
+                self.count += 1
+        def bump(self):
+            self.count += 1
+    """
+    findings, _ = conc(tmp_path, {"mod.py": src})
+    assert rules_of(findings) == ["cross-thread-unguarded-write"]
+    assert all(f.severity == "error" for f in findings)
+    assert "W.count" in findings[0].message
+    assert "2 thread roots" in findings[0].message
+
+
+def test_cross_thread_write_clean_when_guarded_everywhere(tmp_path):
+    src = """
+    import threading
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._loop, daemon=True)
+        def _loop(self):
+            while True:
+                with self._lock:
+                    self.count += 1
+        def bump(self):
+            with self._lock:
+                self.count += 1
+    """
+    findings, _ = conc(tmp_path, {"mod.py": src})
+    assert findings == []
+
+
+def test_cross_thread_write_exempts_threadsafe_and_unthreaded(tmp_path):
+    """queue.Queue/Event attrs are internally synchronized; a class with
+    no lock and no thread spawn is presumed single-thread-confined."""
+    src = """
+    import queue
+    import threading
+    class Plumbing:
+        def __init__(self):
+            self._q = queue.Queue()
+            self._stop = threading.Event()
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._lock = threading.Lock()
+        def _loop(self):
+            self._q.put(1)
+        def close(self):
+            self._stop.set()
+    class PlainCounter:
+        def fail(self):
+            self.failures = getattr(self, "failures", 0) + 1
+        def reset(self):
+            self.failures = 0
+    """
+    findings, _ = conc(tmp_path, {"mod.py": src})
+    assert findings == []
+
+
+def test_guarded_by_def_annotation_asserts_contract(tmp_path):
+    """The def-line `# r2d2: guarded-by(<lock>)` form declares a caller-
+    holds-lock contract: annotated helpers' writes count as guarded, and
+    the annotation is CHECKED — re-acquiring the same non-reentrant lock
+    inside is flagged as a deadlock."""
+    clean = """
+    import threading
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._loop, daemon=True)
+        def _loop(self):
+            with self._lock:
+                self._bump()
+        # r2d2: guarded-by(_lock)
+        def _bump(self):
+            self.count += 1
+        def bump(self):
+            with self._lock:
+                self._bump()
+    """
+    findings, _ = conc(tmp_path / "clean", {"mod.py": clean})
+    assert findings == []
+
+    checked = clean.replace(
+        "def _bump(self):\n            self.count += 1",
+        "def _bump(self):\n            with self._lock:\n"
+        "                self.count += 1",
+    )
+    findings, _ = conc(tmp_path / "checked", {"mod.py": checked})
+    assert "lock-order-cycle" in rules_of(findings)
+
+
+def test_guarded_by_write_line_annotation(tmp_path):
+    src = """
+    import threading
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._loop, daemon=True)
+        def _loop(self):
+            with self._lock:
+                self.count += 1
+        def external(self):
+            self.count += 1  # r2d2: guarded-by(_lock)
+    """
+    findings, _ = conc(tmp_path, {"mod.py": src})
+    assert findings == []
+
+
+def test_guarded_by_silences_ast_lock_discipline():
+    """The annotation reuses the suppression machinery in the AST lint:
+    an annotated write is moved to suppressed, not reported."""
+    src = """
+    import threading
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+        def add(self):
+            with self._lock:
+                self.count += 1
+        # r2d2: guarded-by(_lock)
+        def reset(self):
+            self.count = 0
+    """
+    findings, suppressed = lint(src, path="replay/thing.py")
+    assert findings == []
+    assert [f.rule for f in suppressed] == ["lock-discipline"]
+
+
+def test_blocking_under_lock_fires_direct_and_interprocedural(tmp_path):
+    src = """
+    import threading
+    import time
+    class B:
+        def __init__(self):
+            self._lock = threading.Lock()
+        def slow(self):
+            with self._lock:
+                time.sleep(1.0)
+        def outer(self):
+            with self._lock:
+                self._inner()
+        def _inner(self):
+            time.sleep(0.1)
+    """
+    findings, _ = conc(tmp_path, {"mod.py": src})
+    assert rules_of(findings) == ["blocking-under-lock"]
+    assert len(findings) == 2
+    assert all(f.severity == "warning" for f in findings)
+    # the interprocedural one names the caller-holds contract
+    inner = [f for f in findings if "_inner" in f.message]
+    assert inner and "caller-holds-lock contract" in inner[0].message
+
+
+def test_blocking_outside_lock_clean(tmp_path):
+    src = """
+    import threading
+    import time
+    class B:
+        def __init__(self):
+            self._lock = threading.Lock()
+        def ok(self):
+            with self._lock:
+                n = 1
+            time.sleep(0.1)
+            return n
+    """
+    findings, _ = conc(tmp_path, {"mod.py": src})
+    assert findings == []
+
+
+def test_concurrency_suppression_in_place(tmp_path):
+    src = """
+    import threading
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._loop, daemon=True)
+        def _loop(self):
+            self.count += 1  # r2d2: disable=cross-thread-unguarded-write
+        def bump(self):
+            # r2d2: disable=cross-thread-unguarded-write
+            self.count += 1
+    """
+    findings, suppressed = conc(tmp_path, {"mod.py": src})
+    assert findings == []
+    assert {f.rule for f in suppressed} == {"cross-thread-unguarded-write"}
+
+
+def test_thread_root_inventory_repo_wide():
+    """The inventory covers every threaded plane: raw Thread constructions,
+    supervision spawn sites (body AND restart hook run on the worker),
+    socketserver handlers, and the synthetic main root."""
+    from r2d2_tpu.analysis import concurrency
+
+    roots = concurrency.thread_roots([PKG_DIR])
+    kinds = {r.kind for r in roots}
+    assert {"thread", "spawn", "handler", "main"} <= kinds
+    spawn_names = {r.name for r in roots if r.kind == "spawn"}
+    assert "ckpt-watcher-multi" in spawn_names  # the fleet watcher
+    paths = {os.path.relpath(r.path, PKG_DIR) for r in roots if r.path}
+    for mod in ("serve/server.py", "serve/multi.py", "serve/client.py",
+                "utils/supervision.py", "replay/tiered_store.py", "train.py"):
+        assert mod in paths, f"no thread root found in {mod}"
+
+
+def test_concurrency_repo_wide_gate():
+    """The shipped tree has zero unsuppressed concurrency findings: no
+    lock-order cycles, no cross-thread unguarded writes, nothing blocking
+    under a lock. Deliberate exceptions (the state-cache single-writer
+    contract) are annotated in place. This is the tier-1 race gate."""
+    from r2d2_tpu.analysis import concurrency
+
+    findings, suppressed = concurrency.analyze_paths([PKG_DIR])
+    assert findings == [], render_text(findings)
+    assert suppressed, "expected documented single-writer exceptions in-tree"
+
+
+def test_cli_concurrency_flag(capsys):
+    from r2d2_tpu.analysis.cli import main
+
+    assert main(["--concurrency", PKG_DIR]) == 0
+    assert "no findings" in capsys.readouterr().out
+
+
+def test_seeded_mutation_trips_concurrency_gate(tmp_path):
+    """Delete ONE lock acquisition from the real serve/state_cache.py
+    source (the assign fast path) inside a fixture package that drives the
+    cache from two thread roots — the gate must trip. The unmutated copy
+    of the same fixture is clean, so the trip is attributable to exactly
+    the removed acquisition."""
+    from r2d2_tpu.analysis import concurrency
+
+    with open(os.path.join(PKG_DIR, "serve", "state_cache.py"),
+              encoding="utf-8") as fh:
+        real = fh.read()
+    driver = """
+    import threading
+
+    from cachemod import RecurrentStateCache
+
+    class Driver:
+        def __init__(self):
+            self.cache = RecurrentStateCache(4, 8)
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+        def _loop(self):
+            while True:
+                self.cache.assign(["s"])
+        def evict(self, sid):
+            self.cache.evict(sid)
+    """
+    intact = tmp_path / "intact"
+    _write(intact, "cachemod.py", real)
+    _write(intact, "driver.py", driver)
+    findings, _ = concurrency.analyze_paths([str(intact)])
+    assert findings == [], render_text(findings)
+
+    i = real.index("def assign")
+    j = real.index("with self._lock:", i)
+    mutated = real[:j] + "if True:" + real[j + len("with self._lock:"):]
+    broken = tmp_path / "mutated"
+    _write(broken, "cachemod.py", mutated)
+    _write(broken, "driver.py", driver)
+    findings, _ = concurrency.analyze_paths([str(broken)])
+    assert findings, "removing a lock acquisition must trip the gate"
+    assert "cross-thread-unguarded-write" in rules_of(findings)
+    assert any("cachemod.py" in f.path for f in findings)
