@@ -817,12 +817,14 @@ def _serve_load(cfg, sessions: int, seconds: float, label: str = "",
     import os
     import shutil
     import tempfile
+    from concurrent.futures import TimeoutError as FutureTimeout
 
     from r2d2_tpu.envs.catch import CatchHostEnv
     from r2d2_tpu.serve import (
         LocalClient,
         MultiDeviceServer,
         PolicyServer,
+        QueueFullError,
         ServeConfig,
     )
     from r2d2_tpu.utils.checkpoint import save_checkpoint
@@ -864,9 +866,12 @@ def _serve_load(cfg, sessions: int, seconds: float, label: str = "",
         server.start()
         client = LocalClient(server)
         stop = threading.Event()
-        # (submit time rel. to window start, latency seconds | None=error);
-        # appends are GIL-atomic, done-callbacks run on the serve loop
+        # (submit time rel. to window start, latency seconds | None,
+        # error class | None); appends are GIL-atomic, done-callbacks run
+        # on the serve loop. submitted[0] vs len(records) at the end is
+        # the timeout class: offered requests whose future never resolved.
         records: list = []
+        submitted = [0]
         bench_t0 = time.perf_counter()
 
         def session_loop(i: int) -> None:
@@ -875,8 +880,19 @@ def _serve_load(cfg, sessions: int, seconds: float, label: str = "",
             obs, reward, reset = env.reset(), 0.0, True
             while not stop.is_set():
                 t = time.perf_counter()
-                res = client.act(sid, obs, reward=reward, reset=reset)
-                records.append((t - bench_t0, time.perf_counter() - t))
+                submitted[0] += 1
+                try:
+                    res = client.act(sid, obs, reward=reward, reset=reset)
+                except QueueFullError:
+                    records.append((t - bench_t0, None, "rejected"))
+                    continue  # re-offer the same step next loop
+                except FutureTimeout:
+                    records.append((t - bench_t0, None, "timeout"))
+                    continue
+                except Exception:
+                    records.append((t - bench_t0, None, "transport"))
+                    continue
+                records.append((t - bench_t0, time.perf_counter() - t, None))
                 obs, reward, done, _ = env.step(res.action)
                 reset = done
                 if done:
@@ -904,12 +920,19 @@ def _serve_load(cfg, sessions: int, seconds: float, label: str = "",
                 reset = sid not in seen
                 seen.add(sid)
                 t_sub = time.perf_counter()
+                submitted[0] += 1
                 fut = server.submit(sid, obs, reward=0.0, reset=reset)
 
                 def _done(f, t_sub=t_sub):
-                    lat = None if f.exception() is not None \
-                        else time.perf_counter() - t_sub
-                    records.append((t_sub - bench_t0, lat))
+                    exc = f.exception()
+                    if exc is None:
+                        rec = (t_sub - bench_t0,
+                               time.perf_counter() - t_sub, None)
+                    elif isinstance(exc, QueueFullError):
+                        rec = (t_sub - bench_t0, None, "rejected")
+                    else:
+                        rec = (t_sub - bench_t0, None, "transport")
+                    records.append(rec)
 
                 fut.add_done_callback(_done)
 
@@ -940,10 +963,18 @@ def _serve_load(cfg, sessions: int, seconds: float, label: str = "",
         server.stop()
 
         warmup_s = min(2.0, 0.2 * seconds)
-        warmup_requests = sum(1 for t_sub, _ in records if t_sub < warmup_s)
-        measured = [(t_sub, lat) for t_sub, lat in records if t_sub >= warmup_s]
-        ok = np.sort(np.asarray([lat for _, lat in measured if lat is not None]))
-        errors = len(measured) - ok.size
+        warmup_requests = sum(1 for t_sub, _, _ in records if t_sub < warmup_s)
+        measured = [r for r in records if r[0] >= warmup_s]
+        ok = np.sort(np.asarray([lat for _, lat, _ in measured if lat is not None]))
+        # per-class failure breakdown (not one lumped count): rejected =
+        # shed/full queue, timeout = a future that never resolved within
+        # the client deadline (or at all), transport = everything else
+        errors = {"rejected": 0, "timeout": 0, "transport": 0}
+        for _, _, err in measured:
+            if err is not None:
+                errors[err] += 1
+        errors["timeout"] += max(submitted[0] - len(records), 0)
+        errors_total = sum(errors.values())
         rps = ok.size / max(elapsed - warmup_s, 1e-9)
         if ok.size:
             p50, p95, p99 = (
@@ -958,7 +989,7 @@ def _serve_load(cfg, sessions: int, seconds: float, label: str = "",
         print(
             f"[serve:{label}] {ok.size} requests over {sessions} sessions "
             f"in {elapsed:.1f}s ({'open' if open_loop else 'closed'}-loop, "
-            f"warmup={warmup_requests}, errors={errors}, "
+            f"warmup={warmup_requests}, errors={errors_total} {errors}, "
             f"reloads={stats['reloads']}, occupancy="
             f"{stats['mean_batch_occupancy']:.1f}, "
             f"spills={stats['cache_spills']}, "
@@ -976,6 +1007,7 @@ def _serve_load(cfg, sessions: int, seconds: float, label: str = "",
             "slo_attainment": round(slo_attainment, 4),
             "warmup_requests": warmup_requests,
             "errors": errors,
+            "errors_total": errors_total,
             "rejected": stats["rejected"],
             "serve_devices": devices,
             "mean_batch_occupancy": round(stats["mean_batch_occupancy"], 2),
@@ -998,19 +1030,34 @@ def _serve_load(cfg, sessions: int, seconds: float, label: str = "",
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def _int8_q_drift(cfg, steps: int = 8, batch: int = 8) -> float:
-    """The serve_int8 row's drift column: max |q_int8 - q_fp| / max |q_fp|
+def _arm_q_drift(cfg, arm: str, steps: int = 8, batch: int = 8) -> float:
+    """A degradation arm's quality column: max |q_arm - q_fp| / max |q_fp|
     over a short recurrent act stream — both arms fed IDENTICAL inputs
     (including the fp arm's greedy actions) so the only difference is the
-    int8 weight round-trip, compounding through the carry exactly as it
-    does in a served session. Deterministic; independent of load traffic."""
+    arm's weight transform (int8 round-trip, or the weight-only bf16
+    cast), compounding through the carry exactly as it does in a served
+    session. Deterministic; independent of load traffic. Arms that leave
+    the weights untouched ("full", "admit") are exactly 0 by definition."""
     import jax.numpy as jnp
 
-    from r2d2_tpu.ops.quantize import dequantize_tree, quantize_tree
-
+    if arm in ("full", "admit"):
+        return 0.0
     net, state = init_train_state(cfg, jax.random.PRNGKey(0))
     params = state.params
-    deq = dequantize_tree(quantize_tree(params)[0])
+    if arm == "int8":
+        from r2d2_tpu.ops.quantize import dequantize_tree, quantize_tree
+
+        deq = dequantize_tree(quantize_tree(params)[0])
+    elif arm == "bf16":
+        # the served bf16 arm keeps the leaves AS bf16 (the model's own
+        # dtype promotion upcasts at compute) — probe exactly that
+        deq = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            params,
+        )
+    else:
+        raise ValueError(f"unknown arm {arm!r}")
     act = jax.jit(
         lambda p, o, la, lr, c: net.apply(p, o, la, lr, c, method=net.act)
     )
@@ -1031,6 +1078,132 @@ def _int8_q_drift(cfg, steps: int = 8, batch: int = 8) -> float:
         scale = max(scale, float(jnp.max(jnp.abs(q_fp))))
         la = jnp.argmax(q_fp, axis=-1).astype(jnp.int32)
     return drift / max(scale, 1e-9)
+
+
+def _int8_q_drift(cfg, steps: int = 8, batch: int = 8) -> float:
+    """The serve_int8 row's historical drift column (see _arm_q_drift)."""
+    return _arm_q_drift(cfg, "int8", steps=steps, batch=batch)
+
+
+def scenarios_main(
+    core: str = "lstm",
+    lru_chunk: int = 0,
+    sessions: int = 64,
+    seconds: float = 4.0,
+    base_rate: float = 100.0,
+    slo_ms: float = 50.0,
+    out_path: str = "",
+    seed: int = 0,
+):
+    """The scenario x rung readiness matrix (ROADMAP item 5): every
+    built-in traffic scenario (steady control, diurnal ramp, flash crowd,
+    Pareto-tailed sessions, slow clients, mid-scenario replica kill —
+    serve/scenarios.py) against every degradation-ladder rung
+    (full / admit / bf16 / int8 — serve/degrade.py), each cell reporting
+    p99 latency, SLO attainment, per-class error breakdown, the rung's
+    quality cost (`q_drift_vs_fp32`, the deterministic _arm_q_drift
+    probe), and `sessions_lost` (kill-scenario migrations that found no
+    spill room — the number that must stay 0).
+
+    One TWO-REPLICA fleet per rung (both replicas on the first local
+    device when only one is visible — affinity, migration, and the kill
+    path are device-count-independent), controller PINNED at the rung so
+    the cell measures one ladder position, and the kill scenario runs
+    LAST on each fleet (it retires a replica for good). Emits one
+    `serve_scenario_matrix` row; --scenario-out also writes it as the
+    BENCH_r11-style readiness report."""
+    from r2d2_tpu.serve import (
+        RUNGS,
+        MultiDeviceServer,
+        ScenarioRunner,
+        ServeConfig,
+        builtin_scenarios,
+    )
+
+    cfg = _system_cfg(core=core, lru_chunk=lru_chunk, precision="fp32")
+    cfg = cfg.replace(
+        # per-replica slab sized so one scenario's whole session
+        # population (slot recycling included) fits a SURVIVOR's slab
+        # after a kill-migration wave — sessions_lost must stay 0
+        serve_spill=4 * sessions,
+        serve_degrade=True,
+        serve_degrade_slo_ms=slo_ms,
+    ).validate()
+    serve_cfg = ServeConfig(
+        buckets=(2, 4, 8, 16, 32),
+        max_wait_ms=2.0,
+        cache_capacity=max(32, sessions // 2),
+        poll_interval_s=0.5,
+    )
+    d0 = jax.local_devices()[0]
+    drifts = {rung: round(_arm_q_drift(cfg, rung), 6) for rung in RUNGS}
+    specs = builtin_scenarios(
+        base_rate=base_rate, duration_s=seconds, sessions=sessions, seed=seed
+    )
+    cells = []
+    for rung in RUNGS:
+        # a fresh fleet per rung: the kill scenario retires a replica and
+        # the ladder state must not leak across rungs
+        server = MultiDeviceServer(cfg, serve_cfg, devices=[d0, d0])
+        server.degrade.pin(rung)  # warmup traces the PINNED arm's step
+        t0 = time.perf_counter()
+        server.warmup()
+        print(
+            f"[scenarios:{rung}] warmup in {time.perf_counter() - t0:.1f}s "
+            f"(q_drift_vs_fp32={drifts[rung]})",
+            file=sys.stderr,
+        )
+        server.start(watch_checkpoints=False)
+        try:
+            for spec in specs:
+                before = server.stats()
+                server.degrade.reset_window()
+                row = ScenarioRunner(server, spec, slo_ms=slo_ms).run()
+                after = server.stats()
+                cell = {
+                    "rung": rung,
+                    "q_drift_vs_fp32": drifts[rung],
+                    **row,
+                    "sessions_lost": after["sessions_lost"]
+                    - before["sessions_lost"],
+                    "sessions_migrated": after["sessions_migrated"]
+                    - before["sessions_migrated"],
+                    "shed": after["shed"] - before["shed"],
+                    "serve_arm": after["serve_arm"],
+                }
+                cells.append(cell)
+                print(
+                    f"[scenarios:{rung}] {spec.name}: "
+                    f"p99={cell['p99_latency_ms'] and round(cell['p99_latency_ms'], 1)}ms "
+                    f"slo={cell['slo_attainment']:.3f} "
+                    f"errors={cell['errors_total']} "
+                    f"lost={cell['sessions_lost']} "
+                    f"migrated={cell['sessions_migrated']}",
+                    file=sys.stderr,
+                )
+        finally:
+            server.stop()
+    report = {
+        "metric": "serve_scenario_matrix",
+        "unit": "matrix",
+        "value": len(cells),
+        "rungs": list(RUNGS),
+        "scenarios": [s.name for s in specs],
+        "slo_ms": slo_ms,
+        "base_rate": base_rate,
+        "duration_s": seconds,
+        "sessions": sessions,
+        "seed": seed,
+        "q_drift_vs_fp32": drifts,
+        "cells": cells,
+        "core": cfg.recurrent_core
+        + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"[scenarios] readiness report -> {out_path}", file=sys.stderr)
+    print(json.dumps(report))
 
 
 def serve_main(
@@ -1402,7 +1575,7 @@ if __name__ == "__main__":
     p.add_argument(
         "--mode", default="learner",
         choices=["learner", "system", "fused", "long_context", "serve",
-                 "recovery", "breakdown"],
+                 "recovery", "breakdown", "scenarios"],
         help="learner: fused-update throughput on synthetic replay (the "
              "driver's default metric). system: concurrent on-device "
              "collection + learning via threads. fused: the same full "
@@ -1414,7 +1587,13 @@ if __name__ == "__main__":
              "with an injected SIGTERM and measure resume-to-first-update "
              "wall time (utils/faults.py). breakdown: per-phase learner "
              "step timing (unroll / head / loss+grad / optimizer as "
-             "separately jitted programs under jax.profiler spans).",
+             "separately jitted programs under jax.profiler spans). "
+             "scenarios: scenario x degradation-rung readiness matrix — "
+             "every built-in traffic/chaos scenario (serve/scenarios.py) "
+             "against every rung of the graceful-degradation ladder "
+             "(serve/degrade.py) on a two-replica fleet, reporting p99, "
+             "SLO attainment, error breakdown, q_drift_vs_fp32 and "
+             "sessions_lost per cell.",
     )
     p.add_argument(
         "--collect-every", type=int, default=6,
@@ -1496,6 +1675,31 @@ if __name__ == "__main__":
         help="serve mode: replicate the serve stack over N local devices "
              "with session-affinity routing (serve/multi.py)",
     )
+    p.add_argument(
+        "--scenario-rate", type=float, default=100.0,
+        help="scenarios mode: base arrival rate in requests/s (scenario "
+             "profiles multiply this: diurnal peaks at 3x, flash crowd "
+             "bursts to 8x)",
+    )
+    p.add_argument(
+        "--scenario-seconds", type=float, default=4.0,
+        help="scenarios mode: duration of EACH scenario's offered-load "
+             "window (the matrix runs 6 scenarios x 4 rungs)",
+    )
+    p.add_argument(
+        "--scenario-sessions", type=int, default=64,
+        help="scenarios mode: concurrent session slots per scenario",
+    )
+    p.add_argument(
+        "--scenario-seed", type=int, default=0,
+        help="scenarios mode: base seed for the deterministic arrival "
+             "traces (each built-in scenario offsets it)",
+    )
+    p.add_argument(
+        "--scenario-out", default="",
+        help="scenarios mode: also write the readiness report JSON here "
+             "(e.g. BENCH_r11.json)",
+    )
     args = p.parse_args()
     enable_compilation_cache(args.compile_cache)
     precision = args.precision or (
@@ -1510,6 +1714,12 @@ if __name__ == "__main__":
                    args.serve_seconds, precision,
                    arrival_rate=args.arrival_rate, slo_ms=args.slo_ms,
                    devices=args.serve_devices)
+    elif args.mode == "scenarios":
+        scenarios_main(args.core, args.lru_chunk,
+                       sessions=args.scenario_sessions,
+                       seconds=args.scenario_seconds,
+                       base_rate=args.scenario_rate, slo_ms=args.slo_ms,
+                       out_path=args.scenario_out, seed=args.scenario_seed)
     elif args.mode == "system":
         system_main(args.core, args.lru_chunk, precision,
                     args.priority_plane, args.superstep)

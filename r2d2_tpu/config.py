@@ -164,6 +164,20 @@ class R2D2Config:
     # replicas in one pass (int8 re-quantization included). Each replica
     # keeps the compile-once-per-bucket property independently.
     serve_devices: int = 1
+    # Serve-plane graceful-degradation ladder (serve/degrade.py). When
+    # True the server runs a supervised "degrade-controller" worker that
+    # watches queue depth, windowed p99 latency, and SLO attainment
+    # against serve_degrade_slo_ms, and steps a rung ladder with
+    # hysteresis: full -> admission control at the micro-batcher (bounded
+    # QueueFullError shed) -> weight-only bf16 arm -> int8 arm + spill
+    # slab pressure shed. Every rung transition is stamped into stats.
+    # Default False: NO controller exists, no admission watermark is
+    # installed, and the publish path is byte-for-byte the pre-ladder
+    # behavior — the golden serve paths stay bit-exact.
+    serve_degrade: bool = False
+    # The ladder's SLO target: p99 above this (or attainment below the
+    # controller's low-water band) counts as a pressured evaluation.
+    serve_degrade_slo_ms: float = 50.0
 
     # Fused-sequence training semantics for the LSTM core: the T-step
     # unroll treats each row's burn-in prefix as state-refresh only — a
@@ -392,6 +406,12 @@ class R2D2Config:
             raise ValueError(
                 "serve_devices must be >= 1 (replicas of the serve stack "
                 "over local devices, serve/multi.py)"
+            )
+        if self.serve_degrade_slo_ms <= 0.0:
+            raise ValueError(
+                "serve_degrade_slo_ms is the degradation ladder's p99 "
+                "latency target in milliseconds (serve/degrade.py); it "
+                "must be > 0"
             )
         if self.lstm_backend not in ("auto", "scan", "pallas"):
             raise ValueError(f"unknown lstm_backend {self.lstm_backend!r}")

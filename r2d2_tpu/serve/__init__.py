@@ -9,7 +9,15 @@ turning a trained R2D2 checkpoint into a low-latency policy service.
 
 from r2d2_tpu.serve.batcher import MicroBatcher, QueueFullError, ServeRequest
 from r2d2_tpu.serve.client import LocalClient, PolicyClient
+from r2d2_tpu.serve.degrade import RUNGS, DegradeConfig, DegradeController
 from r2d2_tpu.serve.multi import MultiDeviceServer, SessionRouter
+from r2d2_tpu.serve.scenarios import (
+    Arrival,
+    ScenarioRunner,
+    ScenarioSpec,
+    arrival_trace,
+    builtin_scenarios,
+)
 from r2d2_tpu.serve.server import (
     PolicyServer,
     ServeConfig,
@@ -19,16 +27,24 @@ from r2d2_tpu.serve.server import (
 from r2d2_tpu.serve.state_cache import RecurrentStateCache
 
 __all__ = [
+    "Arrival",
+    "DegradeConfig",
+    "DegradeController",
     "LocalClient",
     "MicroBatcher",
     "MultiDeviceServer",
     "PolicyClient",
     "PolicyServer",
     "QueueFullError",
+    "RUNGS",
     "RecurrentStateCache",
+    "ScenarioRunner",
+    "ScenarioSpec",
     "ServeConfig",
     "ServeRequest",
     "ServeResult",
     "SessionRouter",
+    "arrival_trace",
+    "builtin_scenarios",
     "reference_act",
 ]

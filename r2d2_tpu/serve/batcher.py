@@ -67,9 +67,20 @@ class MicroBatcher:
         # same-session requests deferred out of a batch, FIFO per session
         self._deferred: "deque[ServeRequest]" = deque()
         self._lock = threading.Lock()
+        # degradation-ladder admission control (serve/degrade.py): None
+        # admits up to the queue bound (the only behavior when the ladder
+        # is off); an int sheds submissions once qsize() reaches it, but
+        # only while the shed allowance lasts — a BOUNDED shed, so one
+        # controller decision can never starve the queue indefinitely.
+        # The limit is written under _lock and read without it (atomic
+        # attribute read; stale-by-one-submit is fine for a watermark).
+        self._admit_limit: Optional[int] = None
+        self._shed_allowance = 0
+        self._closed = False
         self.batches = 0
         self.requests = 0
         self.rejected = 0
+        self.shed = 0  # rejections due to admission control, not queue.Full
         self.deferrals = 0
         self.occupancy_sum = 0  # real rows summed over batches
         self.padded_sum = 0  # bucket rows summed over batches
@@ -84,6 +95,26 @@ class MicroBatcher:
         loop's ServeResult. A full queue fails the future immediately with
         QueueFullError instead of blocking the client thread."""
         fut: Future = Future()
+        if self._closed:
+            fut.set_exception(
+                QueueFullError("serve queue closed (replica retired)")
+            )
+            return fut
+        limit = self._admit_limit
+        if limit is not None and self._q.qsize() >= limit:
+            with self._lock:
+                if self._shed_allowance > 0:
+                    self._shed_allowance -= 1
+                    self.shed += 1
+                    self.rejected += 1
+                    fut.set_exception(
+                        QueueFullError(
+                            f"admission control: queue depth >= {limit} "
+                            "(degrade-ladder shed)"
+                        )
+                    )
+                    return fut
+                # shed budget spent: admit anyway (bounded shed contract)
         req = ServeRequest(
             session_id=session_id,
             obs=np.asarray(obs),
@@ -103,6 +134,22 @@ class MicroBatcher:
                 )
             )
         return fut
+
+    def set_admission(self, limit: Optional[int], budget: int = 0) -> None:
+        """Install (or clear, limit=None) the degrade ladder's admission
+        watermark. `budget` re-arms the bounded shed allowance: at most
+        that many submissions are shed before the batcher reverts to
+        admitting (the controller re-arms it every evaluation tick)."""
+        with self._lock:
+            self._admit_limit = None if limit is None else max(int(limit), 1)
+            self._shed_allowance = max(int(budget), 0)
+
+    def close(self) -> None:
+        """Refuse all future submissions (QueueFullError) — a retired
+        replica's queue must fail fast, not strand futures that no serve
+        loop will ever resolve."""
+        with self._lock:
+            self._closed = True
 
     def qsize(self) -> int:
         return self._q.qsize() + len(self._deferred)
@@ -188,6 +235,8 @@ class MicroBatcher:
                 "batches": self.batches,
                 "requests": self.requests,
                 "rejected": self.rejected,
+                "shed": self.shed,
+                "admit_limit": self._admit_limit,
                 "deferrals": self.deferrals,
                 "mean_batch_occupancy": self.occupancy_sum / batches,
                 # real rows / padded rows: how much of the compiled shapes

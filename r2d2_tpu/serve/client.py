@@ -24,13 +24,19 @@ import json
 import socket
 import socketserver
 import threading
-from typing import Optional, Tuple
+import time
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from r2d2_tpu.serve.batcher import QueueFullError
 from r2d2_tpu.serve.server import ServeResult
-from r2d2_tpu.utils.faults import TRANSIENT_ERRORS, fault_point, with_retries
+from r2d2_tpu.utils.faults import (
+    TRANSIENT_ERRORS,
+    Backoff,
+    fault_point,
+    with_retries,
+)
 
 
 class LocalClient:
@@ -113,21 +119,37 @@ class PolicyClient:
     """Blocking JSON-lines TCP client; one socket, one session stream at a
     time per instance (open one client per concurrent session).
 
-    Transient trouble is retried in the client, not surfaced: a full serve
-    queue (`QueueFullError` answered in-band) and socket-level errors
-    (reset/refused/closed connections — reconnected between attempts) go
-    through the shared `utils/faults.with_retries` backoff policy under
-    the `serve.client` fault site, so each retry shows up in
-    `retry_stats()` like every other retried boundary. The final
-    attempt's error propagates — retries bound tail latency, they do not
-    hide a down server. `retries=1` restores fail-fast behavior."""
+    Transient trouble is retried in the client, not surfaced: socket-level
+    errors (reset/refused/closed connections — reconnected between
+    attempts) go through the shared `utils/faults.with_retries` backoff
+    policy under the `serve.client` fault site, so each retry shows up in
+    `retry_stats()` like every other retried boundary. Overload is a
+    SEPARATE budget: a full serve queue (`QueueFullError` answered
+    in-band) retries up to `queue_retries` times with SEEDED JITTERED
+    backoff — a fleet of clients rejected by the same overloaded (or
+    freshly killed) replica spreads its retries instead of
+    thundering-herding the survivors — then gives up and raises. The
+    final error of either budget propagates — retries bound tail latency,
+    they do not hide a down or drowning server. `retries=1` /
+    `queue_retries=1` restore fail-fast behavior.
+
+    Every give-up is classified in `error_counts` (`rejected` — queue
+    budget exhausted; `timeout` — the socket deadline; `transport` —
+    every other connection/server failure) so bench rows report WHY
+    requests failed, not one lumped count."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 timeout: float = 30.0, retries: int = 3):
+                 timeout: float = 30.0, retries: int = 3,
+                 queue_retries: int = 3, seed: int = 0):
         self.host = host
         self.port = port
         self.timeout = timeout
         self.retries = max(int(retries), 1)
+        self.queue_retries = max(int(queue_retries), 1)
+        self.seed = seed
+        self.error_counts: Dict[str, int] = {
+            "rejected": 0, "timeout": 0, "transport": 0,
+        }
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._connect()
@@ -179,12 +201,37 @@ class PolicyClient:
         return resp
 
     def _round_trip(self, payload: dict) -> dict:
-        return with_retries(
-            lambda: self._attempt(payload),
-            "serve.client",
-            attempts=self.retries,
-            retry_on=TRANSIENT_ERRORS + (QueueFullError,),
-        )
+        # two nested budgets: the INNER with_retries absorbs transport
+        # transients (counted per-site in retry_stats); the OUTER loop is
+        # the overload budget — QueueFullError means the server is ALIVE
+        # and shedding, so wait a jittered backoff and re-offer, at most
+        # queue_retries times. Jitter is seeded per client: a rejected
+        # fleet de-synchronizes instead of re-offering in lockstep.
+        backoff = Backoff(base=0.01, factor=2.0, max_delay=0.5,
+                          jitter=0.5, seed=self.seed)
+        for attempt in range(self.queue_retries):
+            try:
+                return with_retries(
+                    lambda: self._attempt(payload),
+                    "serve.client",
+                    attempts=self.retries,
+                    retry_on=TRANSIENT_ERRORS,
+                )
+            except QueueFullError:
+                if attempt == self.queue_retries - 1:
+                    self.error_counts["rejected"] += 1
+                    raise
+                time.sleep(backoff.fail())
+            except socket.timeout:
+                self.error_counts["timeout"] += 1
+                raise
+            except TRANSIENT_ERRORS:
+                self.error_counts["transport"] += 1
+                raise
+            except RuntimeError:
+                # in-band server-side failure (non-overload)
+                self.error_counts["transport"] += 1
+                raise
 
     def act(self, session_id: str, obs, reward: float = 0.0,
             reset: bool = False, want_q: bool = False) -> dict:

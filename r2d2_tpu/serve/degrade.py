@@ -1,0 +1,246 @@
+"""Graceful-degradation ladder for the serving plane.
+
+Under overload a stateful policy service has exactly three levers, each a
+measured SLO/quality tradeoff (ROADMAP item 5): shed load before it
+queues, serve cheaper weights, and shrink the session-memory footprint.
+This module is the controller that pulls them, in order, as a RUNG LADDER:
+
+    rung 0  "full"   baseline: no shedding, the config's own arm
+    rung 1  "admit"  admission control at the MicroBatcher — submissions
+                     past a queue watermark are shed with QueueFullError
+                     under a bounded per-tick budget (latency relief,
+                     zero quality loss for admitted traffic)
+    rung 2  "bf16"   + publish the weight-only bf16 arm (half the HBM
+                     fetch bytes per batch; bounded Q drift)
+    rung 3  "int8"   + publish the int8 arm (quarter-width weights,
+                     ops/quantize.py) and pressure-shed the session
+                     spill slab to its keep watermark (sessions past it
+                     restart fresh if they return)
+
+The controller watches three signals — queue depth, windowed p99 latency,
+and windowed SLO attainment — and steps the ladder with HYSTERESIS: a
+rung only moves after `dwell_up` consecutive pressured evaluations (or
+`dwell_down` healthy ones), the enter/exit thresholds are deliberately
+apart, and evaluations between the bands reset neither counter, so an
+oscillating signal parks the ladder instead of flapping it. Every
+transition is stamped into `transitions` (and counters) so the bench
+matrix and the metrics stream can attribute every quality dip to the rung
+that bought it.
+
+Threading: `observe()` is called per answered request from the serve
+loop(s); `evaluate_once()` runs as a supervised "degrade-controller"
+worker (one bounded evaluation per call — the same contract every other
+worker body follows). All mutable controller state lives under one lock;
+rung ACTIONS (publishing an arm does a quantize + H2D) run strictly
+outside it, per the blocking-under-lock rule the PR 10 analyzer enforces.
+
+Default-off: with `config.serve_degrade` False no controller exists, no
+admission watermark is installed, and the publish path never deviates
+from the config arm — the serve plane is bit-identical to before this
+module existed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# rung order IS the ladder; index = rung number
+RUNGS: Tuple[str, ...] = ("full", "admit", "bf16", "int8")
+
+# admission watermark per rung, as a fraction of the queue bound (rung 0
+# installs None: no admission control at all, the bit-identical default)
+_ADMIT_FRAC = {"admit": 0.5, "bf16": 0.375, "int8": 0.25}
+
+
+@dataclasses.dataclass(frozen=True)
+class DegradeConfig:
+    """Ladder thresholds. The enter (high/low) pairs are the hysteresis
+    bands; dwell counts are consecutive evaluation ticks."""
+
+    slo_ms: float = 50.0
+    eval_interval_s: float = 0.25
+    window: int = 512           # latency samples the signals are computed over
+    min_samples: int = 8        # below this the latency signals abstain
+    queue_high: float = 0.5     # pressured when depth >= high * queue bound
+    queue_low: float = 0.05     # healthy requires depth <= low * queue bound
+    attain_low: float = 0.9     # pressured when SLO attainment < low
+    attain_high: float = 0.98   # healthy requires attainment >= high
+    dwell_up: int = 2
+    dwell_down: int = 8
+    shed_budget: int = 256      # max sheds re-armed per evaluation tick
+    spill_keep_frac: float = 0.5  # int8 rung: slab shed watermark
+
+
+class DegradeController:
+    """Watches a server's overload signals and steps the rung ladder.
+
+    `server` is a PolicyServer or MultiDeviceServer — both expose the
+    same degrade surface: `set_arm(arm)`, `set_admission(limit, budget)`,
+    `shed_spill(frac)`, `queue_depth()`, and `queue_bound`.
+    """
+
+    def __init__(self, server, cfg: DegradeConfig = DegradeConfig()):
+        self.server = server
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        self._window: List[float] = []  # ring buffer of latency seconds
+        self._w_idx = 0
+        self._up_evals = 0
+        self._down_evals = 0
+        self._rung = 0
+        self._pinned = False
+        self.evaluations = 0
+        self.rung_ups = 0
+        self.rung_downs = 0
+        # (monotonic t, from_rung, to_rung, reason) — bounded history
+        self.transitions: List[Tuple[float, str, str, str]] = []
+
+    # -------------------------------------------------------------- signals
+
+    def observe(self, latency_s: float) -> None:
+        """One answered request's latency (serve-loop thread(s))."""
+        with self._lock:
+            if len(self._window) < self.cfg.window:
+                self._window.append(latency_s)
+            else:
+                self._window[self._w_idx] = latency_s
+                self._w_idx = (self._w_idx + 1) % self.cfg.window
+
+    def reset_window(self) -> None:
+        """Drop the latency window (scenario boundaries in the bench)."""
+        with self._lock:
+            self._window = []
+            self._w_idx = 0
+            self._up_evals = 0
+            self._down_evals = 0
+
+    def signals(self) -> Dict[str, float]:
+        with self._lock:
+            lats = np.asarray(self._window, np.float64)
+        depth = float(self.server.queue_depth())
+        bound = max(float(self.server.queue_bound), 1.0)
+        out = {"queue_frac": depth / bound, "p99_ms": 0.0, "attainment": 1.0,
+               "samples": float(lats.size)}
+        if lats.size >= self.cfg.min_samples:
+            out["p99_ms"] = float(np.percentile(lats, 99) * 1e3)
+            out["attainment"] = float(
+                np.count_nonzero(lats <= self.cfg.slo_ms / 1e3) / lats.size
+            )
+        return out
+
+    # --------------------------------------------------------------- ladder
+
+    @property
+    def rung(self) -> int:
+        return self._rung
+
+    @property
+    def rung_name(self) -> str:
+        return RUNGS[self._rung]
+
+    @property
+    def pinned(self) -> bool:
+        return self._pinned
+
+    def pin(self, rung) -> None:
+        """Force a rung and stop auto-stepping (the bench matrix pins each
+        rung so scenario cells measure ONE ladder position)."""
+        idx = RUNGS.index(rung) if isinstance(rung, str) else int(rung)
+        with self._lock:
+            self._pinned = True
+            prev = self._rung
+            self._rung = idx
+            if idx != prev:
+                self._stamp(prev, idx, "pinned")
+        self._apply(idx)
+
+    def _stamp(self, prev: int, new: int, reason: str) -> None:
+        # caller holds self._lock
+        self.transitions.append(
+            (time.monotonic(), RUNGS[prev], RUNGS[new], reason)
+        )
+        del self.transitions[:-256]
+        if new > prev:
+            self.rung_ups += 1
+        else:
+            self.rung_downs += 1
+
+    def _apply(self, rung_idx: int) -> None:
+        """Install a rung's actions on the server. NO controller lock held:
+        arm publication stages a quantize/cast + device transfer."""
+        name = RUNGS[rung_idx]
+        frac = _ADMIT_FRAC.get(name)
+        limit = None if frac is None else int(frac * self.server.queue_bound)
+        self.server.set_admission(limit, budget=self.cfg.shed_budget)
+        self.server.set_arm(name if name in ("bf16", "int8") else "full")
+        if name == "int8":
+            self.server.shed_spill(self.cfg.spill_keep_frac)
+
+    def evaluate_once(self) -> Optional[str]:
+        """One bounded evaluation tick: read the signals, advance the
+        hysteresis counters, step at most one rung. Returns the new rung
+        name on a transition, else None."""
+        sig = self.signals()
+        cfg = self.cfg
+        have_lat = sig["samples"] >= cfg.min_samples
+        pressured = sig["queue_frac"] >= cfg.queue_high or (
+            have_lat and (sig["p99_ms"] > cfg.slo_ms
+                          or sig["attainment"] < cfg.attain_low)
+        )
+        healthy = sig["queue_frac"] <= cfg.queue_low and (
+            not have_lat or (sig["p99_ms"] <= cfg.slo_ms
+                             and sig["attainment"] >= cfg.attain_high)
+        )
+        apply: Optional[int] = None
+        stepped = False
+        with self._lock:
+            self.evaluations += 1
+            if self._pinned:
+                # keep the shed allowance of a pinned admit-class rung armed
+                apply = self._rung if RUNGS[self._rung] in _ADMIT_FRAC else None
+            else:
+                if pressured:
+                    self._up_evals += 1
+                    self._down_evals = 0
+                elif healthy:
+                    self._down_evals += 1
+                    self._up_evals = 0
+                # between the bands: hold both counters — the dead band is
+                # what keeps an oscillating signal from flapping the ladder
+                if self._up_evals >= cfg.dwell_up and self._rung < len(RUNGS) - 1:
+                    prev, self._rung = self._rung, self._rung + 1
+                    self._up_evals = 0
+                    self._stamp(prev, self._rung, "pressured")
+                    apply, stepped = self._rung, True
+                elif self._down_evals >= cfg.dwell_down and self._rung > 0:
+                    prev, self._rung = self._rung, self._rung - 1
+                    self._down_evals = 0
+                    self._stamp(prev, self._rung, "recovered")
+                    apply, stepped = self._rung, True
+                elif RUNGS[self._rung] in _ADMIT_FRAC:
+                    apply = self._rung  # re-arm the bounded shed allowance
+        if apply is not None:
+            self._apply(apply)
+        return RUNGS[apply] if stepped else None
+
+    # -------------------------------------------------------------- metrics
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "degrade_rung": self._rung,
+                "degrade_rung_name": RUNGS[self._rung],
+                "degrade_rung_ups": self.rung_ups,
+                "degrade_rung_downs": self.rung_downs,
+                "degrade_evaluations": self.evaluations,
+                "degrade_pinned": self._pinned,
+                "degrade_transitions": [
+                    {"t": round(t, 3), "from": a, "to": b, "reason": r}
+                    for t, a, b, r in self.transitions[-16:]
+                ],
+            }

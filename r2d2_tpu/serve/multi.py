@@ -63,19 +63,34 @@ class SessionRouter:
         self.max_tracked = max_tracked
         self._map: "OrderedDict[str, int]" = OrderedDict()
         self._counts = [0] * n_replicas
+        # chaos plane: a killed replica is deactivated, never removed —
+        # indices stay stable, and route() treats its sessions as new
+        # placements among the survivors (the migration path re-assigns
+        # them explicitly first, so only un-migrated stragglers re-place)
+        self._active = [True] * n_replicas
         self._lock = threading.Lock()
         self.routed = 0      # total route() calls
         self.new_routes = 0  # sessions placed for the first time
         self.dropped = 0     # affinities LRU-dropped from the map
+        self.reroutes = 0    # affinities moved off a deactivated replica
 
     def route(self, session_id: str) -> int:
         """The replica index this session's requests must go to."""
         with self._lock:
             replica = self._map.get(session_id)
+            if replica is not None and not self._active[replica]:
+                # mapped to a dead replica and not migrated: place fresh
+                del self._map[session_id]
+                self._counts[replica] -= 1
+                self.reroutes += 1
+                replica = None
             if replica is None:
+                live = [i for i in range(self.n_replicas) if self._active[i]]
+                if not live:
+                    raise RuntimeError("no active replicas to route to")
                 self.new_routes += 1
-                lo = min(self._counts)
-                ties = [i for i, c in enumerate(self._counts) if c == lo]
+                lo = min(self._counts[i] for i in live)
+                ties = [i for i in live if self._counts[i] == lo]
                 replica = ties[zlib.crc32(session_id.encode()) % len(ties)]
                 self._counts[replica] += 1
                 self._map[session_id] = replica
@@ -86,6 +101,28 @@ class SessionRouter:
             self._map.move_to_end(session_id)
             self.routed += 1
             return replica
+
+    def deactivate(self, replica: int) -> None:
+        """Take a replica out of rotation (kill path). Its existing
+        affinities stay mapped until migrated (assign) or re-placed on
+        the session's next route()."""
+        with self._lock:
+            self._active[replica] = False
+
+    def assign(self, session_id: str, replica: int) -> None:
+        """Force a session's affinity (migration): move the mapping to
+        `replica`, adjusting both replicas' load counts."""
+        with self._lock:
+            old = self._map.pop(session_id, None)
+            if old is not None:
+                self._counts[old] -= 1
+            self._map[session_id] = replica
+            self._map.move_to_end(session_id)
+            self._counts[replica] += 1
+
+    def active(self) -> List[bool]:
+        with self._lock:
+            return list(self._active)
 
     def peek(self, session_id: str) -> Optional[int]:
         """The mapped replica, or None — never creates an affinity."""
@@ -110,9 +147,11 @@ class SessionRouter:
             return {
                 "router_sessions": len(self._map),
                 "router_counts": list(self._counts),
+                "router_active": list(self._active),
                 "router_routed": self.routed,
                 "router_new_routes": self.new_routes,
                 "router_dropped": self.dropped,
+                "router_reroutes": self.reroutes,
             }
 
 
@@ -187,6 +226,27 @@ class MultiDeviceServer:
         self.router = SessionRouter(
             len(self.replicas), max_tracked=per_replica * len(self.replicas)
         )
+        # ONE degrade controller for the whole fleet: each replica built
+        # its own under cfg.serve_degrade — replace them all with a shared
+        # one driving fleet-level actions (set_arm/set_admission fan out),
+        # and strip their ownership so only THIS server runs its worker
+        self.degrade = None
+        self._arm = "full"
+        if cfg.serve_degrade:
+            from r2d2_tpu.serve.degrade import DegradeConfig, DegradeController
+
+            self.degrade = DegradeController(
+                self, DegradeConfig(slo_ms=cfg.serve_degrade_slo_ms)
+            )
+            for r in self.replicas:
+                r.degrade = self.degrade
+                r._degrade_owner = False
+        self.replicas_killed = 0
+        self.sessions_migrated = 0
+        self.sessions_lost = 0
+        # sessions that re-placed on a survivor before their carry was
+        # imported: alive, but restarted from zero state
+        self.sessions_restarted = 0
         self.reloads = 0
         self.reload_errors = 0
         self._watch_backoff = Backoff(
@@ -225,6 +285,104 @@ class MultiDeviceServer:
         if idx is not None:
             self.replicas[idx].cache.evict(session_id)
 
+    # ---------------------------------------------------------- chaos plane
+
+    def kill_replica(self, idx: int) -> Dict[str, int]:
+        """Retire one replica and migrate its sessions to the survivors
+        through the spill tier. The order is the correctness argument:
+
+        1. deactivate routing — no NEW request can reach the victim;
+        2. close its batcher — racing submits fail fast (QueueFullError)
+           instead of stranding futures no loop will resolve;
+        3. stop its workers — after the join its cache has no writer, so
+        4. export_sessions() is a consistent snapshot (every session at
+           its last committed carry), and each row is imported into its
+           new replica's HOST SPILL SLAB — no survivor HBM resident is
+           evicted by a migrant; the carry promotes bit-exactly on the
+           session's next request (the spill tier's demote/promote
+           round-trip contract, tests/test_serve_spill.py).
+
+        A session whose client re-submitted between (1) and (4) was
+        already re-placed fresh by the router — counted `restarted`, not
+        migrated (its import is skipped: the survivor owns newer state).
+        A row with no spill room left is genuinely `lost`. Returns the
+        breakdown; counters accumulate in stats()."""
+        victim = self.replicas[idx]
+        self.router.deactivate(idx)
+        victim.batcher.close()
+        victim.stop()
+        exported = victim.cache.export_sessions()
+        migrated = lost = restarted = 0
+        for sid, (h, c, la, lr) in exported.items():
+            target = self.router.route(sid)  # least-loaded survivor
+            cache = self.replicas[target].cache
+            if sid in cache or cache.spilled(sid):
+                restarted += 1
+                continue
+            if cache.import_spilled(sid, h, c, la, lr):
+                self.router.assign(sid, target)
+                migrated += 1
+            else:
+                self.router.forget(sid)
+                lost += 1
+        with self._reload_lock:
+            self.replicas_killed += 1
+            self.sessions_migrated += migrated
+            self.sessions_lost += lost
+            self.sessions_restarted += restarted
+        return {"migrated": migrated, "lost": lost, "restarted": restarted}
+
+    # ------------------------------------------------------ degrade surface
+    # (mirrors PolicyServer's so serve/degrade.py drives either; actions
+    # fan out to the surviving replicas)
+
+    @property
+    def queue_bound(self) -> int:
+        # per-replica bound: the ladder reacts to the most pressured
+        # replica, not the fleet aggregate a straggler hides inside
+        return self.serve_cfg.queue_depth
+
+    def queue_depth(self) -> int:
+        return max(
+            (r.queue_depth() for r, a in
+             zip(self.replicas, self.router.active()) if a),
+            default=0,
+        )
+
+    def set_admission(self, limit: Optional[int], budget: int = 0) -> None:
+        """Install the admission watermark on every live replica (the
+        limit and shed budget are per replica — each batcher's queue is
+        its own overload domain)."""
+        for r, a in zip(self.replicas, self.router.active()):
+            if a:
+                r.set_admission(limit, budget=budget)
+
+    def shed_spill(self, keep_fraction: float) -> int:
+        return sum(
+            r.shed_spill(keep_fraction)
+            for r, a in zip(self.replicas, self.router.active()) if a
+        )
+
+    def set_arm(self, arm: str, params=None) -> bool:
+        """Fleet arm switch: stage every live replica's re-prepared params
+        OUTSIDE the reload lock (quantize/cast + per-device H2D), then
+        install all under one shared version — same lockstep discipline
+        as reload_now, so no two replicas serve different arms after this
+        returns."""
+        if arm == self._arm:
+            return False
+        raw = self._params_host if params is None else params
+        alive = [r for r, a in zip(self.replicas, self.router.active()) if a]
+        staged = [r.prepare_for_publish(raw, arm) for r in alive]
+        with self._reload_lock:
+            version = self._version + 1
+            for r, prepared in zip(alive, staged):
+                r.install_prepared(prepared, self._ckpt_step, version=version)
+                r.arm_switches += 1
+            self._version = version
+            self._arm = arm
+        return True
+
     # ----------------------------------------------------------- hot reload
 
     def reload_now(self) -> bool:
@@ -240,11 +398,16 @@ class MultiDeviceServer:
         if step is None or step == self._ckpt_step:
             return False
         state, _, _ = restore_checkpoint(self.checkpoint_dir, self._template, step)
-        staged = [r.prepare_for_publish(state.params) for r in self.replicas]
+        # killed replicas are skipped (their publish cell is frozen at
+        # death); prepare_for_publish(arm=None) keeps each survivor's
+        # current degrade arm across the reload
+        alive = [r for r, a in zip(self.replicas, self.router.active()) if a]
+        staged = [r.prepare_for_publish(state.params) for r in alive]
         with self._reload_lock:
             version = self._version + 1
-            for r, prepared in zip(self.replicas, staged):
-                r.install_prepared(prepared, int(state.step), version=version)
+            for r, prepared in zip(alive, staged):
+                r.install_prepared(prepared, int(state.step), version=version,
+                                   raw_params=state.params)
             self._params_host = state.params
             self._version = version
             self._ckpt_step = int(state.step)
@@ -268,6 +431,14 @@ class MultiDeviceServer:
         else:
             time.sleep(wait)
 
+    def _degrade_iteration(self) -> None:
+        # supervised fleet-controller body: one bounded evaluation tick
+        self.degrade.evaluate_once()
+        if self.supervisor is not None:
+            self.supervisor.stop.wait(self.degrade.cfg.eval_interval_s)
+        else:
+            time.sleep(self.degrade.cfg.eval_interval_s)
+
     # ------------------------------------------------------------ lifecycle
 
     def warmup(self) -> None:
@@ -288,6 +459,14 @@ class MultiDeviceServer:
             self.supervisor.spawn(
                 "ckpt-watcher-multi",
                 lambda: self._watch_iteration(),
+                max_restarts=self.serve_cfg.max_restarts,
+            )
+        if self.degrade is not None:
+            # the fleet owns the one controller (replicas spawned none:
+            # their _degrade_owner was stripped in __init__)
+            self.supervisor.spawn(
+                "degrade-controller-multi",
+                lambda: self._degrade_iteration(),
                 max_restarts=self.serve_cfg.max_restarts,
             )
 
@@ -318,8 +497,9 @@ class MultiDeviceServer:
         "cache_sessions", "cache_evictions", "cache_admissions",
         "cache_hits", "cache_misses", "cache_readmits", "cache_spills",
         "cache_promotes", "cache_spill_evictions", "spill_sessions",
-        "requests", "batches", "rejected", "deferrals", "queue_depth",
-        "trace_count", "quantized_leaves",
+        "cache_imports", "cache_spill_sheds",
+        "requests", "batches", "rejected", "shed", "deferrals",
+        "queue_depth", "trace_count", "quantized_leaves", "arm_switches",
     )
 
     def stats(self) -> Dict[str, object]:
@@ -328,8 +508,13 @@ class MultiDeviceServer:
             "serve_devices": len(self.replicas),
             "ckpt_step": self._ckpt_step,
             "params_version": self._version,
+            "serve_arm": self._arm,
             "reloads": self.reloads,
             "reload_errors": self.reload_errors,
+            "replicas_killed": self.replicas_killed,
+            "sessions_migrated": self.sessions_migrated,
+            "sessions_lost": self.sessions_lost,
+            "sessions_restarted": self.sessions_restarted,
             "serve_quantization": self.cfg.serve_quantization,
         }
         for key in self._SUMMED:
@@ -349,5 +534,7 @@ class MultiDeviceServer:
         out["cache_capacity"] = cache0.capacity * len(self.replicas)
         out["spill_capacity"] = cache0.spill_capacity * len(self.replicas)
         out.update(self.router.stats())
+        if self.degrade is not None:
+            out.update(self.degrade.stats())
         out["replicas"] = per_replica
         return out

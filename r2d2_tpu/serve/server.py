@@ -14,10 +14,14 @@ request's Future with the chosen action. Two supervised workers run under
   atomically publishes new params.
 
 Hot reload is a single-attribute swap: params travel as one
-``(params, ckpt_step, version)`` tuple, read ONCE per batch, so every
+``(params, ckpt_step, version, arm)`` tuple, read ONCE per batch, so every
 request in a batch is answered by exactly one checkpoint — a reload
 mid-traffic can never tear a batch across two param sets. In-flight
-requests complete under the params they were batched with.
+requests complete under the params they were batched with. The fourth
+element is the degradation-ladder ARM ("full" | "bf16" | "int8",
+serve/degrade.py): the same atomic cell that makes reloads tearless makes
+arm fallback tearless — a batch runs entirely on one (params, arm) pair,
+and the step function is selected per batch from the arm it read.
 
 Bucketed shapes bound compilation: the jitted step retraces only when the
 (bucket,) batch shape is new, and `trace_count` counts the retraces so
@@ -40,6 +44,7 @@ from r2d2_tpu.config import R2D2Config
 from r2d2_tpu.learner import init_train_state
 from r2d2_tpu.models.r2d2 import R2D2Network
 from r2d2_tpu.serve.batcher import MicroBatcher, ServeRequest
+from r2d2_tpu.serve.degrade import DegradeConfig, DegradeController
 from r2d2_tpu.serve.state_cache import RecurrentStateCache
 from r2d2_tpu.utils.checkpoint import latest_checkpoint_step, restore_checkpoint
 from r2d2_tpu.utils.faults import Backoff, InjectedFault, fault_point, total_retries
@@ -169,8 +174,15 @@ class PolicyServer:
         self._state_lock = threading.Lock()
         # the atomic hot-reload cell: ONE attribute holding ONE tuple, read
         # once per batch — Python attribute reads are atomic, so a batch
-        # sees exactly one (params, step, version) triple, never a mix
-        self._published: Tuple[object, int, int] = (None, ckpt_step, -1)
+        # sees exactly one (params, step, version, arm), never a mix. The
+        # arm rides in the same cell so a degrade-ladder fallback is as
+        # tearless as a reload (indices 0-2 are unchanged for readers that
+        # predate the arm, e.g. analysis/jaxpr_rules.py).
+        self._published: Tuple[object, int, int, str] = (None, ckpt_step, -1, "full")
+        # raw (pre-quantize, host-or-wherever) params the arms re-prepare
+        # from: a bf16->int8 fallback must not re-round already-cast leaves
+        self._params_raw = params
+        self.arm_switches = 0
         self.publish(params, ckpt_step, version=0)
 
         if serve_cfg.cache_capacity < max(serve_cfg.buckets):
@@ -206,7 +218,23 @@ class PolicyServer:
             max_delay=max(30.0, serve_cfg.poll_interval_s),
         )
         self._inflight: List[ServeRequest] = []
-        self._step = self._build_step()
+        # jitted steps by their one trace-relevant switch (in-jit dequant
+        # or not); built lazily so the default config compiles exactly the
+        # steps it always did. self._step tracks the last-selected one.
+        self._steps: Dict[bool, object] = {}
+        self._step = self._step_for(self._published[3])
+
+        # degradation ladder (serve/degrade.py): default OFF — no
+        # controller object, no admission watermark, no observe() calls,
+        # the serve plane byte-for-byte as before. A fleet overrides
+        # .degrade with ONE shared controller and owns its worker.
+        self.degrade: Optional[DegradeController] = None
+        self._degrade_owner = False
+        if cfg.serve_degrade:
+            self.degrade = DegradeController(
+                self, DegradeConfig(slo_ms=cfg.serve_degrade_slo_ms)
+            )
+            self._degrade_owner = True
 
         self.supervisor: Optional[Supervisor] = None
         self._serve_worker = None
@@ -214,51 +242,134 @@ class PolicyServer:
 
     # ------------------------------------------------------------ jit step
 
-    def prepare_for_publish(self, params):
+    def prepare_for_publish(self, params, arm: Optional[str] = None):
         """The slow half of a publish, safe to run with NO lock held:
-        int8 re-quantization when enabled plus the H2D placement onto
-        this replica's device. Returns an opaque staged pair for
-        install_prepared. The fleet reload path stages every replica with
-        this before touching its reload lock so serving never stalls
-        behind a device transfer."""
-        if self.cfg.serve_quantization == "int8":
+        the arm's weight transform (int8 quantization / weight-only bf16
+        cast) plus the H2D placement onto this replica's device. Returns
+        an opaque staged triple for install_prepared. The fleet reload
+        path stages every replica with this before touching its reload
+        lock so serving never stalls behind a device transfer.
+
+        `arm` is the degradation-ladder rung's weight format (None keeps
+        the currently published arm): "full" is the config's own behavior
+        (int8 under serve_quantization="int8", verbatim otherwise);
+        "bf16" casts float leaves to bfloat16 — the model's own dtype
+        promotion upcasts at compute, so only weight rounding drifts;
+        "int8" quantizes regardless of config."""
+        if arm is None:
+            arm = self._published[3]
+        leaves = 0
+        if arm == "int8" or (arm == "full" and self.cfg.serve_quantization == "int8"):
             from r2d2_tpu.ops.quantize import quantize_tree
 
             params, leaves = quantize_tree(params)
-        else:
-            leaves = 0
+        elif arm == "bf16":
+            params = jax.tree.map(
+                lambda x: x.astype(jnp.bfloat16)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+                params,
+            )
+        elif arm != "full":
+            raise ValueError(f"unknown serve arm {arm!r}")
         if self.device is not None:
             params = jax.device_put(params, self.device)
-        return params, leaves
+        return params, leaves, arm
 
     def install_prepared(self, prepared, ckpt_step: int,
-                         version: Optional[int] = None) -> None:
+                         version: Optional[int] = None,
+                         raw_params=None) -> None:
         """The O(1) lock-held tail of a publish: swap the publish cell
-        (one tuple write) and bump the version. No device work, no I/O."""
-        prepared_params, leaves = prepared
+        (one tuple write) and bump the version. No device work, no I/O.
+        `raw_params` (the fleet reload path) refreshes the pre-transform
+        params the arms re-prepare from."""
+        prepared_params, leaves, arm = prepared
         with self._state_lock:
             self.quantized_leaves = leaves
+            if raw_params is not None:
+                self._params_raw = raw_params
             if version is None:
                 version = self._published[2] + 1
-            self._published = (prepared_params, int(ckpt_step), version)
+            self._published = (prepared_params, int(ckpt_step), version, arm)
 
-    def publish(self, params, ckpt_step: int, version: Optional[int] = None) -> None:
+    def publish(self, params, ckpt_step: int, version: Optional[int] = None,
+                arm: Optional[str] = None) -> None:
         """Atomically publish a param set to this server/replica: prepare
-        (int8 re-quantization when enabled), place on this replica's
-        device — both outside the state lock — then swap the publish cell
-        in ONE guarded write. The multi-device server stages all replicas
-        via prepare_for_publish and installs with an explicit shared
-        version so the fleet advances in lockstep."""
-        self.install_prepared(self.prepare_for_publish(params), ckpt_step, version)
+        (the arm's weight transform), place on this replica's device —
+        both outside the state lock — then swap the publish cell in ONE
+        guarded write. The multi-device server stages all replicas via
+        prepare_for_publish and installs with an explicit shared version
+        so the fleet advances in lockstep."""
+        self.install_prepared(
+            self.prepare_for_publish(params, arm), ckpt_step, version,
+            raw_params=params,
+        )
 
-    def _build_step(self):
+    def set_arm(self, arm: str, params=None) -> bool:
+        """Switch the degradation-ladder arm: re-prepare the RAW params
+        under the new arm (outside all locks — quantize/cast + H2D) and
+        swap the publish cell, preserving ckpt_step and bumping the
+        version. No-op (False) when the arm is already live. Called by
+        the degrade controller and the bench matrix; safe against a
+        concurrent reload — whichever swap lands second wins the cell,
+        and both are internally consistent (params, arm) pairs."""
+        if arm == self._published[3]:
+            return False
+        raw = self._params_raw if params is None else params
+        prepared = self.prepare_for_publish(raw, arm)
+        with self._state_lock:
+            ckpt_step = self._published[1]
+        self.install_prepared(prepared, ckpt_step)
+        with self._state_lock:
+            self.arm_switches += 1
+        return True
+
+    # -------------------------------------------------- degrade surface
+    # (serve/degrade.py drives these; MultiDeviceServer mirrors them)
+
+    @property
+    def queue_bound(self) -> int:
+        return self.serve_cfg.queue_depth
+
+    def queue_depth(self) -> int:
+        return self.batcher.qsize()
+
+    def set_admission(self, limit: Optional[int], budget: int = 0) -> None:
+        self.batcher.set_admission(limit, budget=budget)
+
+    def shed_spill(self, keep_fraction: float) -> int:
+        return self.cache.shed_spill(keep_fraction)
+
+    def _step_for(self, arm: str):
+        """The jitted step matching an arm's published weight format.
+        Only ONE switch is trace-relevant — whether the step dequantizes
+        in-jit — so "full" and "bf16" share a step (bf16 leaves flow
+        through the same graph at their own dtype) and the default config
+        never builds more than it used to. Also updates self._step so
+        external introspection (analysis/jaxpr_rules.py) always sees the
+        step that last served traffic."""
+        quantized = arm == "int8" or (
+            arm == "full" and self.cfg.serve_quantization == "int8"
+        )
+        # warmup (main) and the serve loop both reach this cache; building
+        # a step is cheap (jit wrapping is lazy — compilation happens at
+        # the first call, outside the lock)
+        with self._state_lock:
+            fn = self._steps.get(quantized)
+            if fn is None:
+                fn = self._steps[quantized] = self._build_step(quantized)
+            self._step = fn
+        return fn
+
+    def _build_step(self, quantized: bool):
         net = self.net
-        quantized = self.cfg.serve_quantization == "int8"
 
         def step(params, h_store, c_store, la_store, lr_store,
                  obs, rewards, slots, reset_mask, explore_mask, random_actions):
-            # runs once per TRACE (new bucket shape), not per call
-            self.trace_count += 1
+            # runs once per TRACE (new bucket shape), not per call; a
+            # metrics counter bumped at trace time — a lock can't live in
+            # a traced function, and a lost increment under a concurrent
+            # warmup/serve trace only undercounts a gauge
+            self.trace_count += 1  # r2d2: disable=cross-thread-unguarded-write
             if quantized:
                 # in-jit dequant: XLA fuses the i8->f32 convert + scale
                 # multiply into the consuming matmuls (ops/quantize.py)
@@ -314,8 +425,9 @@ class PolicyServer:
         with self._state_lock:
             self._inflight = batch
         # single read of the publish cell: the whole batch — and the
-        # results' provenance — come from one params set
-        params, ckpt_step, version = self._published
+        # results' provenance — come from one (params, arm) pair
+        params, ckpt_step, version, arm = self._published
+        step_fn = self._step_for(arm)
         n = len(batch)
         bucket = self.batcher.bucket_for(n)
         pad = bucket - n
@@ -346,7 +458,7 @@ class PolicyServer:
             randoms = np.zeros(bucket, np.int64)
 
         h, c, la, lr = self.cache.arrays()
-        q, action, h, c, la, lr = self._step(
+        q, action, h, c, la, lr = step_fn(
             params, h, c, la, lr,
             jnp.asarray(obs), jnp.asarray(rewards), jnp.asarray(slots_full),
             jnp.asarray(reset_mask), jnp.asarray(explore),
@@ -365,6 +477,11 @@ class PolicyServer:
             )
         with self._state_lock:
             self._inflight = []
+        if self.degrade is not None:
+            # feed the ladder's latency window (per answered request, the
+            # same queue-to-resolve latency clients experience)
+            for r in batch:
+                self.degrade.observe(t_done - r.t_enqueue)
         if self.metrics is not None:
             self.metrics.log(
                 {
@@ -375,6 +492,7 @@ class PolicyServer:
                     "latency_s_oldest": t_done - batch[0].t_enqueue,
                     "ckpt_step": ckpt_step,
                     "params_version": version,
+                    "serve_arm": arm,
                     "reloads": self.reloads,
                     "trace_count": self.trace_count,
                     **self.cache.stats(),
@@ -382,9 +500,22 @@ class PolicyServer:
             )
 
     def _serve_iteration(self) -> None:
+        # straggler-replica drill: a "stall:S" schedule here wedges THIS
+        # replica's serve loop (queue backs up, co-replicas keep serving);
+        # an "error" exercises the supervised-restart path
+        fault_point("serve.replica_stall")
         batch = self.batcher.next_batch(timeout=0.25)
         if batch:
             self._run_batch(batch)
+
+    def _degrade_iteration(self) -> None:
+        """Supervised degrade-controller body: one bounded evaluation
+        tick, then wait out the cadence on the stop event."""
+        self.degrade.evaluate_once()
+        if self.supervisor is not None:
+            self.supervisor.stop.wait(self.degrade.cfg.eval_interval_s)
+        else:
+            time.sleep(self.degrade.cfg.eval_interval_s)
 
     def _serve_recover(self) -> None:
         """Restart hook: fail the in-flight batch's futures so no client
@@ -444,11 +575,13 @@ class PolicyServer:
         """Pre-trace every bucket shape with pad-only batches so live
         traffic never waits on a compile. Writes touch only the scratch
         row, so session state is untouched."""
+        params, _, _, arm = self._published
+        step_fn = self._step_for(arm)
         for bucket in self.batcher.buckets:
             obs = np.zeros((bucket, *self.cfg.obs_shape), np.uint8)
             h, c, la, lr = self.cache.arrays()
-            out = self._step(
-                self._published[0], h, c, la, lr,
+            out = step_fn(
+                params, h, c, la, lr,
                 jnp.asarray(obs), jnp.zeros(bucket, jnp.float32),
                 jnp.full(bucket, self.cache.pad_slot, jnp.int32),
                 jnp.ones(bucket, bool), jnp.zeros(bucket, bool),
@@ -480,6 +613,15 @@ class PolicyServer:
                 lambda: self._watch_iteration(),
                 max_restarts=self.serve_cfg.max_restarts,
             )
+        if self.degrade is not None and self._degrade_owner:
+            # only the controller's OWNER spawns its worker: fleet
+            # replicas share the fleet's controller and must not run
+            # N competing evaluation loops against it
+            self.supervisor.spawn(
+                "degrade-controller" + suffix,
+                lambda: self._degrade_iteration(),
+                max_restarts=self.serve_cfg.max_restarts,
+            )
 
     def check(self) -> Dict[str, int]:
         """Supervisor passthrough: restart/stall counters for the metrics
@@ -505,9 +647,13 @@ class PolicyServer:
             "trace_count": self.trace_count,
             "ckpt_step": self._published[1],
             "params_version": self._published[2],
+            "serve_arm": self._published[3],
+            "arm_switches": self.arm_switches,
             "serve_quantization": self.cfg.serve_quantization,
             "quantized_leaves": self.quantized_leaves,
         }
         out.update(self.batcher.stats())
         out.update(self.cache.stats())
+        if self.degrade is not None and self._degrade_owner:
+            out.update(self.degrade.stats())
         return out

@@ -105,6 +105,8 @@ class RecurrentStateCache:
         self.promotes = 0         # sessions promoted back, carry intact
         self.readmits = 0         # misses that found host-spilled state
         self.spill_evictions = 0  # slab-LRU drops (session state lost)
+        self.imports = 0          # sessions migrated IN from another replica
+        self.spill_sheds = 0      # slab rows dropped by pressure shedding
 
     def _device_zeros(self, shape, dtype):
         z = jnp.zeros(shape, dtype)
@@ -269,6 +271,75 @@ class RecurrentStateCache:
             jnp.asarray(slots), h_rows, c_rows, la_rows, lr_rows,
         )
 
+    def export_sessions(self) -> "OrderedDict[str, tuple]":
+        """Drain every tracked session's carry to host memory for
+        migration (replica drain/kill, serve/multi.py): resident rows come
+        back in ONE vectorized D2H gather, spilled rows as host copies.
+        Returns sid -> (h, c, last_action, last_reward) rows in the cache
+        dtype verbatim, LRU-oldest first, so importing in order preserves
+        recency on the target. Call ONLY with this cache's serve loop
+        stopped — the export reads the device rows (single-writer
+        contract, same as _demote)."""
+        with self._lock:
+            resident = list(self._slots.items())
+            spilled = list(self._spill_slots.items())
+        out: "OrderedDict[str, tuple]" = OrderedDict()
+        # spilled sessions are by construction colder than resident ones:
+        # emit them first so the LRU-oldest-first ordering holds fleetwide
+        for sid, row in spilled:
+            out[sid] = (self._spill_h[row].copy(), self._spill_c[row].copy(),
+                        self._spill_la[row].copy(), self._spill_lr[row].copy())
+        if resident:
+            idx = jnp.asarray(np.array([s for _, s in resident], np.int32))
+            h_rows = np.asarray(jnp.take(self.h, idx, axis=0))
+            c_rows = np.asarray(jnp.take(self.c, idx, axis=0))
+            la_rows = np.asarray(jnp.take(self.last_action, idx, axis=0))
+            lr_rows = np.asarray(jnp.take(self.last_reward, idx, axis=0))
+            for j, (sid, _) in enumerate(resident):
+                out[sid] = (h_rows[j], c_rows[j], la_rows[j], lr_rows[j])
+        return out
+
+    def import_spilled(self, session_id: str, h, c, last_action,
+                       last_reward) -> bool:
+        """Admit a migrated session's carry into THIS cache's host slab
+        (bit-exact: rows are stored in the cache dtype verbatim, so the
+        session's next request promotes exactly the carry it left the dead
+        replica with). Returns False when there is no slab, no free row
+        (a migrant never evicts a session already here), or the session is
+        already tracked."""
+        with self._lock:
+            if self.spill_capacity == 0:
+                return False
+            if session_id in self._slots or session_id in self._spill_slots:
+                return False
+            if not self._spill_free:
+                return False
+            row = self._spill_free.pop()
+            self._spill_h[row] = h
+            self._spill_c[row] = c
+            self._spill_la[row] = last_action
+            self._spill_lr[row] = last_reward
+            self._spill_slots[session_id] = row
+            self._spill_slots.move_to_end(session_id)
+            self.imports += 1
+            return True
+
+    def shed_spill(self, keep_fraction: float) -> int:
+        """Pressure-shed the spill slab down to `keep_fraction` of its
+        capacity, dropping the LRU spilled sessions for good (they restart
+        fresh if they return) — the degrade ladder's host-memory relief
+        valve. Returns the number of sessions dropped."""
+        target = int(self.spill_capacity * max(min(keep_fraction, 1.0), 0.0))
+        dropped = 0
+        with self._lock:
+            while len(self._spill_slots) > target:
+                _, row = self._spill_slots.popitem(last=False)
+                self._spill_free.append(row)
+                self.spill_evictions += 1
+                self.spill_sheds += 1
+                dropped += 1
+        return dropped
+
     def reset(self, session_id: str) -> None:
         """Forget a session's state ENTIRELY — resident slot and any
         spilled copy: the next request re-runs admission-fresh semantics
@@ -331,6 +402,8 @@ class RecurrentStateCache:
                 "cache_spills": self.spills,
                 "cache_promotes": self.promotes,
                 "cache_spill_evictions": self.spill_evictions,
+                "cache_imports": self.imports,
+                "cache_spill_sheds": self.spill_sheds,
                 "spill_sessions": len(self._spill_slots),
                 "spill_capacity": self.spill_capacity,
                 "cache_dtype": self.dtype.name,

@@ -32,6 +32,17 @@ Registered sites (KNOWN_SITES below):
 - checkpoint.restore  — orbax read (utils/checkpoint.py)
 - snapshot.write      — replay snapshot npz write (replay/snapshot.py)
 - serve.reload        — serve-plane checkpoint hot-reload (serve/server.py)
+- serve.replica_stall — top of every serve-loop iteration: a "stall:S"
+                        action wedges ONE replica's serve loop for S
+                        seconds, the straggler-replica drill
+                        (serve/server.py)
+- serve.replica_kill  — the scenario engine's chaos tick: an "error"
+                        action at call N triggers a replica kill +
+                        session migration at exactly the N-th scenario
+                        event (serve/scenarios.py)
+- serve.slow_client   — the scenario engine's slow-client dispatch: a
+                        "stall:S" action adds straggler delay on top of
+                        the scenario's own (serve/scenarios.py)
 - reshard.gather      — elastic-resume slab regather (replay/reshard.py)
 - reshard.scatter     — elastic-resume re-deal/scatter (replay/reshard.py)
 """
@@ -62,6 +73,9 @@ KNOWN_SITES = (
     "snapshot.write",
     "serve.reload",
     "serve.client",
+    "serve.replica_stall",
+    "serve.replica_kill",
+    "serve.slow_client",
     "reshard.gather",
     "reshard.scatter",
 )
@@ -283,16 +297,35 @@ class Backoff:
     watcher): fail() escalates and returns the next delay, reset() on
     success. Keeps the loop's one-bounded-unit-of-work-per-call contract —
     the DELAY is returned, not slept, so callers wait on their own stop
-    event and stay responsive to shutdown."""
+    event and stay responsive to shutdown.
 
-    def __init__(self, base: float = 0.1, factor: float = 2.0, max_delay: float = 30.0):
+    `jitter` in (0, 1] de-synchronizes a fleet: after a replica kill,
+    every client/watcher that failed on the same event would otherwise
+    retry on the SAME escalation schedule and thundering-herd the
+    survivors. Jitter pulls each delay down by up to `jitter` of its
+    headroom above `base`, deterministically per (seed, failure number) —
+    the same crc32 derivation the FaultPlane rates use — so every delay
+    stays within [base, max_delay], a given seed reproduces its exact
+    delay sequence, and different seeds spread. jitter=0 (default) keeps
+    the exact legacy schedule."""
+
+    def __init__(self, base: float = 0.1, factor: float = 2.0,
+                 max_delay: float = 30.0, jitter: float = 0.0,
+                 seed: int = 0):
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError("jitter must be in [0, 1]")
         self.base = base
         self.factor = factor
         self.max_delay = max_delay
+        self.jitter = jitter
+        self.seed = seed
         self.failures = 0
 
     def fail(self) -> float:
         delay = min(self.base * (self.factor ** self.failures), self.max_delay)
+        if self.jitter > 0.0:
+            u = zlib.crc32(f"{self.seed}:{self.failures}".encode()) / 2**32
+            delay -= self.jitter * u * (delay - self.base)
         self.failures += 1
         return delay
 

@@ -1,0 +1,78 @@
+#!/bin/bash
+# Round-11 scenario x degradation-rung readiness chain: the measurement
+# side of the robustness PR (serve/scenarios.py traffic+chaos engine,
+# serve/degrade.py rung ladder). Three rungs, the matrix written to
+# BENCH_r11.json:
+#
+#   1. robustness gate — the scenario/ladder/faults/serve test files plus
+#      the full static-analysis CLI (AST lints, jaxpr gates, AND the
+#      interprocedural concurrency pass over the new controller/runner
+#      threads). A ladder or migration regression aborts the chain: a
+#      readiness matrix measured over a broken ladder is noise.
+#   2. serve baseline  — one open-loop serve row (per-class error
+#      breakdown now included) so the matrix has a ladder-off anchor.
+#   3. scenario matrix — bench.py --mode scenarios: every built-in
+#      scenario (steady / diurnal 3x / flash-crowd 8x / Pareto
+#      heavy-tail / slow clients / mid-scenario replica kill) x every
+#      rung (full / admit / bf16 / int8), controller pinned per cell on
+#      a fresh two-replica fleet, kill scenario last. Each cell: p99,
+#      slo_attainment, rejected/timeout/transport, q_drift_vs_fp32,
+#      sessions_lost.
+#
+# PRE-REGISTERED read: every replica_kill cell reports sessions_lost == 0
+# (the migration-through-spill acceptance criterion), q_drift_vs_fp32 is
+# 0 for full/admit and bounded small for bf16/int8 (the ladder's quality
+# price is measured, monotone, and attributable), and no cell's
+# slo_attainment degrades below the full rung's under the same scenario
+# without a corresponding shed/arm transition stamped in its stats.
+cd /root/repo
+
+. runs/lib.sh
+
+OUT=BENCH_r11.json
+
+echo "=== RUNG 1: robustness gate ==="
+python -m pytest tests/test_scenarios.py tests/test_faults.py \
+  tests/test_serve.py tests/test_serve_spill.py -q -p no:cacheprovider
+RC=$?
+echo "=== ROBUSTNESS_PYTEST EXIT: $RC ==="
+python -m r2d2_tpu.analysis.cli --jaxpr --concurrency
+RCA=$?
+echo "=== ANALYSIS EXIT: $RCA ==="
+if [ $RC -ne 0 ] || [ $RCA -ne 0 ]; then
+  echo "=== ABORT: robustness gate failed; the matrix would be noise ==="
+  exit 1
+fi
+
+echo "=== RUNG 2: serve baseline (ladder off) ==="
+python bench.py --mode serve --serve-seconds 10 --arrival-rate 60 \
+  | tee runs/bench_serve_r11_baseline.jsonl
+echo "=== SERVE_BASELINE EXIT: $? ==="
+
+echo "=== RUNG 3: scenario x rung matrix ==="
+python bench.py --mode scenarios --scenario-rate 30 --scenario-seconds 2 \
+  --scenario-sessions 16 --scenario-out "$OUT"
+RC=$?
+echo "=== SCENARIOS EXIT: $RC ==="
+if [ $RC -ne 0 ]; then
+  echo "=== ABORT: scenario matrix failed ==="
+  exit 1
+fi
+
+python - "$OUT" <<'PY'
+import json, sys
+report = json.load(open(sys.argv[1]))
+kills = [c for c in report["cells"] if c["scenario"] == "replica_kill"]
+assert len(kills) == len(report["rungs"]), "missing kill cells"
+lost = {c["rung"]: c["sessions_lost"] for c in kills}
+assert all(v == 0 for v in lost.values()), f"sessions lost: {lost}"
+drift = report["q_drift_vs_fp32"]
+assert drift["full"] == drift["admit"] == 0.0, drift
+assert 0.0 < drift["bf16"] < drift["int8"] < 0.1, drift
+print(f"readiness: sessions_lost==0 on every rung; drift ladder {drift}")
+PY
+RC=$?
+echo "=== READINESS_ASSERT EXIT: $RC ==="
+[ $RC -ne 0 ] && exit 1
+
+echo R11_SCENARIOS_ALL_DONE

@@ -244,6 +244,30 @@ def test_dynamic_fault_site_fires():
     assert rules_of(findings) == ["dynamic-fault-site"]
 
 
+def test_serve_chaos_sites_are_known_to_lint():
+    """The scenario engine's chaos verbs (replica stall/kill, slow client)
+    are registered sites: referencing them lints clean, and a typo'd
+    variant is flagged like any other unknown site."""
+    src = """
+    from r2d2_tpu.utils.faults import fault_point
+    def f():
+        fault_point("serve.replica_stall")
+        fault_point("serve.replica_kill")
+        fault_point("serve.slow_client")
+    """
+    findings, _ = lint(src, path="serve/scenarios.py")
+    assert findings == []
+
+    typo = """
+    from r2d2_tpu.utils.faults import fault_point
+    def f():
+        fault_point("serve.replica_kil")
+    """
+    findings, _ = lint(typo, path="serve/scenarios.py")
+    assert rules_of(findings) == ["unknown-fault-site"]
+    assert "serve.replica_kil" in findings[0].message
+
+
 def test_snapshot_missing_topology_fires_and_clean():
     src = """
     from r2d2_tpu.replay.snapshot import save_replay
@@ -1040,8 +1064,14 @@ def test_thread_root_inventory_repo_wide():
     assert {"thread", "spawn", "handler", "main"} <= kinds
     spawn_names = {r.name for r in roots if r.kind == "spawn"}
     assert "ckpt-watcher-multi" in spawn_names  # the fleet watcher
+    # the PR 11 degradation controller is a supervised worker like every
+    # other serve-plane thread — it must be inventoried, not invisible
+    assert any(n.startswith("degrade-controller") for n in spawn_names), (
+        sorted(spawn_names)
+    )
     paths = {os.path.relpath(r.path, PKG_DIR) for r in roots if r.path}
     for mod in ("serve/server.py", "serve/multi.py", "serve/client.py",
+                "serve/scenarios.py",
                 "utils/supervision.py", "replay/tiered_store.py", "train.py"):
         assert mod in paths, f"no thread root found in {mod}"
 

@@ -187,6 +187,60 @@ def test_backoff_escalates_and_resets():
     assert b.fail() == 0.1
 
 
+def test_backoff_jitter_bounded_and_reproducible():
+    """Property sweep over seeds: every jittered delay stays within
+    [base, max_delay], the same seed replays the exact same sequence, and
+    different seeds actually spread (the anti-thundering-herd point)."""
+
+    def delays(seed, jitter=1.0, n=12):
+        b = Backoff(base=0.01, factor=2.0, max_delay=0.5,
+                    jitter=jitter, seed=seed)
+        return [b.fail() for _ in range(n)]
+
+    sequences = {seed: delays(seed) for seed in range(16)}
+    for seed, seq in sequences.items():
+        assert all(0.01 <= d <= 0.5 for d in seq), (seed, seq)
+        assert seq == delays(seed)  # deterministic per seed
+    assert len({tuple(s) for s in sequences.values()}) > 1  # seeds spread
+
+
+def test_backoff_jitter_zero_keeps_legacy_schedule():
+    plain = Backoff(base=0.1, factor=2.0, max_delay=0.5)
+    seeded = Backoff(base=0.1, factor=2.0, max_delay=0.5, jitter=0.0, seed=99)
+    assert [plain.fail() for _ in range(4)] == [seeded.fail() for _ in range(4)]
+
+
+def test_backoff_jitter_validated():
+    with pytest.raises(ValueError, match="jitter"):
+        Backoff(jitter=1.5)
+    with pytest.raises(ValueError, match="jitter"):
+        Backoff(jitter=-0.1)
+
+
+def test_serve_chaos_sites_registered():
+    """The scenario engine's chaos verbs are first-class fault sites: the
+    sweep in test_chaos.py and the lint fixtures both enumerate
+    KNOWN_SITES, so the serve-plane verbs must be in it."""
+    for site in ("serve.replica_stall", "serve.replica_kill",
+                 "serve.slow_client"):
+        assert site in faults.KNOWN_SITES, site
+
+
+def test_serve_chaos_sites_fire_on_schedule():
+    faults.install(FaultPlane(schedule={
+        "serve.replica_stall": {1: "error"},
+        "serve.replica_kill": {2: "error"},
+        "serve.slow_client": {1: "error"},
+    }))
+    with pytest.raises(InjectedFault):
+        faults.fault_point("serve.replica_stall")
+    faults.fault_point("serve.replica_kill")  # call 1: not scheduled
+    with pytest.raises(InjectedFault):
+        faults.fault_point("serve.replica_kill")
+    with pytest.raises(InjectedFault):
+        faults.fault_point("serve.slow_client")
+
+
 # ------------------------------------------------------------------- wiring
 
 

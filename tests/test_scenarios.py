@@ -1,0 +1,477 @@
+"""Scenario engine + graceful-degradation ladder tests (PR: robustness
+suite). Pins the acceptance criteria: arrival traces are pure functions of
+their seeded spec, the rung ladder steps with hysteresis and never flaps
+on an oscillating signal, admission control sheds within its bounded
+budget, arm fallback republishes without touching checkpoint provenance,
+a mid-traffic replica kill migrates every session through the spill tier
+(`sessions_lost == 0`) with bitwise carry continuity for the survivors,
+and the overload client classifies every give-up."""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from dataclasses import replace as dataclasses_replace
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import tiny_test
+from r2d2_tpu.serve import (
+    DegradeConfig,
+    DegradeController,
+    LocalClient,
+    MicroBatcher,
+    MultiDeviceServer,
+    PolicyClient,
+    PolicyServer,
+    QueueFullError,
+    ScenarioRunner,
+    ScenarioSpec,
+    ServeConfig,
+    ServeResult,
+    arrival_trace,
+    builtin_scenarios,
+)
+from r2d2_tpu.serve.client import serve_tcp
+from tests.test_serve import SessionReference
+from tests.test_serve_spill import needs_dp2
+
+
+# ------------------------------------------------------------ arrival traces
+
+
+def test_arrival_trace_deterministic():
+    """The trace is a PURE function of the spec: same seed bit-identical,
+    different seed different — chaos replays exactly, like every other
+    seeded plane in the repo."""
+    spec = ScenarioSpec(name="t", duration_s=2.0, base_rate=200.0, seed=7)
+    a, b = arrival_trace(spec), arrival_trace(spec)
+    assert a == b and len(a) > 100
+    c = arrival_trace(ScenarioSpec(name="t", duration_s=2.0, base_rate=200.0,
+                                   seed=8))
+    assert c != a
+
+
+def test_arrival_trace_times_and_resets():
+    spec = ScenarioSpec(name="t", duration_s=1.0, base_rate=300.0,
+                        sessions=8, session_mean_requests=4.0, seed=3)
+    trace = arrival_trace(spec)
+    assert all(0.0 <= ev.t < spec.duration_s for ev in trace)
+    assert all(trace[i].t <= trace[i + 1].t for i in range(len(trace) - 1))
+    # every session's FIRST arrival resets, no later one does
+    seen = set()
+    for ev in trace:
+        assert ev.reset == (ev.session not in seen)
+        seen.add(ev.session)
+    # mean-4 sessions over ~300 arrivals: slots must recycle many times
+    assert len(seen) > spec.sessions
+
+
+def test_arrival_trace_profiles_shape_the_rate():
+    """Thinning really follows the profile: the flash window carries a
+    rate-proportional share of arrivals, and the diurnal crest outweighs
+    the edges."""
+    flash = ScenarioSpec(name="f", duration_s=4.0, base_rate=100.0,
+                         rate_profile="flash", peak_mult=8.0, flash_at=0.4,
+                         flash_len=0.2, seed=1)
+    trace = arrival_trace(flash)
+    start, end = 0.4 * 4.0, 0.6 * 4.0
+    inside = sum(start <= ev.t < end for ev in trace)
+    # flash window: 20% of the time at 8x rate ~= 2/3 of all arrivals
+    assert inside / len(trace) > 0.5
+    diurnal = ScenarioSpec(name="d", duration_s=4.0, base_rate=100.0,
+                           rate_profile="diurnal", peak_mult=4.0, seed=1)
+    assert diurnal.rate_at(2.0) == pytest.approx(400.0)
+    assert diurnal.rate_at(0.0) == pytest.approx(100.0)
+    mid = sum(1.0 <= ev.t < 3.0 for ev in arrival_trace(diurnal))
+    assert mid > len(arrival_trace(diurnal)) / 2
+    with pytest.raises(ValueError, match="rate_profile"):
+        ScenarioSpec(name="x", rate_profile="square").rate_at(0.0)
+
+
+def test_arrival_trace_pareto_tail_and_slow_membership():
+    spec = ScenarioSpec(name="p", duration_s=2.0, base_rate=400.0,
+                        sessions=16, session_tail="pareto", pareto_alpha=1.3,
+                        slow_frac=0.5, seed=5)
+    trace = arrival_trace(spec)
+    slow_flags: dict = {}
+    for ev in trace:
+        # slow-client membership is a SESSION property, drawn once at open
+        assert slow_flags.setdefault(ev.session, ev.slow) == ev.slow
+    assert any(slow_flags.values()) and not all(slow_flags.values())
+    # the tail property itself, at the draw level: the Pareto session
+    # lengths are far more dispersed than geometric at the same mean
+    from r2d2_tpu.serve.scenarios import _draw_session_length
+
+    def draws(tail):
+        rng = np.random.default_rng(5)
+        s = dataclasses_replace(spec, session_tail=tail)
+        return np.asarray([_draw_session_length(rng, s) for _ in range(2000)])
+
+    pareto, geom = draws("pareto"), draws("geometric")
+    assert pareto.min() >= 1
+    assert np.percentile(pareto, 99) / np.median(pareto) \
+        > 2 * np.percentile(geom, 99) / np.median(geom)
+    with pytest.raises(ValueError, match="session_tail"):
+        arrival_trace(ScenarioSpec(name="x", session_tail="zipf"))
+
+
+def test_arrival_trace_event_cap():
+    with pytest.raises(ValueError, match="events"):
+        arrival_trace(ScenarioSpec(name="x", duration_s=10.0,
+                                   base_rate=1e6))
+
+
+def test_builtin_scenarios_cover_the_failure_modes():
+    specs = builtin_scenarios(base_rate=50.0, duration_s=1.0, seed=4)
+    assert [s.name for s in specs] == [
+        "steady", "diurnal", "flash_crowd", "heavy_tail", "slow_clients",
+        "replica_kill",
+    ]
+    assert len({s.seed for s in specs}) == len(specs)  # independent traces
+    assert specs[-1].kill_at == 0.5  # the chaos scenario kills mid-trace
+    for s in specs:
+        assert arrival_trace(s)  # every spec generates
+
+
+# -------------------------------------------------------------- rung ladder
+
+
+class _StubServer:
+    """Degrade surface double: records every rung action."""
+
+    def __init__(self, queue_bound: int = 100):
+        self.depth = 0
+        self.queue_bound = queue_bound
+        self.admissions: list = []
+        self.arms: list = []
+        self.spill_sheds: list = []
+
+    def queue_depth(self) -> int:
+        return self.depth
+
+    def set_admission(self, limit, budget=0) -> None:
+        self.admissions.append((limit, budget))
+
+    def set_arm(self, arm, params=None) -> bool:
+        self.arms.append(arm)
+        return True
+
+    def shed_spill(self, keep_fraction) -> int:
+        self.spill_sheds.append(keep_fraction)
+        return 0
+
+
+def _controller(**kw):
+    stub = _StubServer()
+    defaults = dict(dwell_up=2, dwell_down=3, min_samples=4,
+                    eval_interval_s=0.01)
+    defaults.update(kw)
+    return stub, DegradeController(stub, DegradeConfig(**defaults))
+
+
+def test_ladder_steps_up_and_recovers_with_hysteresis():
+    stub, ctl = _controller()
+    stub.depth = 90  # queue_frac 0.9 >= queue_high: pressured
+    steps = [ctl.evaluate_once() for _ in range(6)]
+    # dwell_up=2: a step lands every SECOND pressured tick, one rung each
+    assert steps == [None, "admit", None, "bf16", None, "int8"]
+    assert ctl.rung_name == "int8"
+    assert ctl.evaluate_once() is None  # top rung: parked, not wrapped
+    assert stub.arms[-1] == "int8" and stub.spill_sheds  # int8 sheds slab
+    stub.depth = 0  # healthy
+    steps = [ctl.evaluate_once() for _ in range(9)]
+    # dwell_down=3: recovery is deliberately slower than escalation
+    assert [s for s in steps if s] == ["bf16", "admit", "full"]
+    assert ctl.rung_name == "full"
+    # rung 0 clears admission control entirely
+    assert stub.admissions[-1][0] is None
+    st = ctl.stats()
+    assert st["degrade_rung_ups"] == 3 and st["degrade_rung_downs"] == 3
+    reasons = [t["reason"] for t in st["degrade_transitions"]]
+    assert reasons == ["pressured"] * 3 + ["recovered"] * 3
+
+
+def test_ladder_does_not_flap_on_oscillating_signal():
+    """Strict pressure/health alternation: each flips the other's dwell
+    counter back to zero, so neither dwell is ever satisfied and the rung
+    never moves — the no-flapping acceptance criterion."""
+    stub, ctl = _controller()
+    for i in range(20):
+        stub.depth = 90 if i % 2 == 0 else 0
+        assert ctl.evaluate_once() is None
+    assert ctl.rung == 0 and ctl.stats()["degrade_transitions"] == []
+
+
+def test_ladder_dead_band_parks():
+    """Signals between the bands (neither pressured nor healthy) hold the
+    ladder where it is indefinitely."""
+    stub, ctl = _controller()
+    stub.depth = 90
+    ctl.evaluate_once()
+    ctl.evaluate_once()
+    assert ctl.rung_name == "admit"
+    stub.depth = 20  # frac 0.2: above queue_low, below queue_high
+    for _ in range(20):
+        assert ctl.evaluate_once() is None
+    assert ctl.rung_name == "admit"
+
+
+def test_ladder_latency_signal_pressures_without_queue():
+    """A drained queue with SLO-violating latencies still escalates: the
+    p99/attainment signals are independent of queue depth."""
+    stub, ctl = _controller(slo_ms=10.0)
+    for _ in range(8):
+        ctl.observe(0.05)  # 50ms >> 10ms SLO
+    sig = ctl.signals()
+    assert sig["p99_ms"] > 10.0 and sig["attainment"] == 0.0
+    assert [ctl.evaluate_once() for _ in range(2)] == [None, "admit"]
+    ctl.reset_window()
+    assert ctl.signals()["samples"] == 0.0
+
+
+def test_ladder_pin_and_rearm():
+    stub, ctl = _controller()
+    ctl.pin("bf16")
+    assert ctl.rung_name == "bf16" and ctl.pinned
+    assert stub.arms[-1] == "bf16"
+    stub.depth = 100
+    n = len(stub.admissions)
+    for _ in range(5):
+        assert ctl.evaluate_once() is None  # pinned: never auto-steps
+    assert ctl.rung_name == "bf16"
+    # ...but every tick re-arms the pinned rung's bounded shed allowance
+    assert len(stub.admissions) > n
+    assert all(a[0] is not None for a in stub.admissions[n:])
+
+
+# -------------------------------------------------------- admission control
+
+
+def test_batcher_bounded_shed_budget():
+    b = MicroBatcher(buckets=(2, 4), max_wait_s=0.0, queue_depth=64)
+    obs = np.zeros(4, np.uint8)
+    for i in range(6):
+        assert not b.submit(f"s{i}", obs).done()  # admitted: pending
+    b.set_admission(4, budget=3)  # depth 6 >= 4: shedding, 3 allowed
+    outcomes = [b.submit(f"t{i}", obs) for i in range(5)]
+    assert all(isinstance(f.exception(timeout=0), QueueFullError)
+               for f in outcomes[:3])
+    # budget spent: the bounded-shed contract admits again
+    assert not outcomes[3].done() and not outcomes[4].done()
+    st = b.stats()
+    assert st["shed"] == 3 and st["rejected"] == 3 and st["admit_limit"] == 4
+    b.set_admission(None)
+    assert not b.submit("u", obs).done()
+    b.close()
+    exc = b.submit("v", obs).exception(timeout=0)
+    assert isinstance(exc, QueueFullError) and "closed" in str(exc)
+
+
+# ------------------------------------------------------------- arm fallback
+
+
+def test_set_arm_republishes_without_touching_provenance():
+    cfg = tiny_test()
+    srv = PolicyServer(cfg, ServeConfig(buckets=(2,), max_wait_ms=1.0,
+                                        cache_capacity=4))
+    params0, step0, version0, arm0 = srv._published
+    assert arm0 == "full"
+    assert srv.set_arm("bf16")
+    _, step1, version1, arm1 = srv._published
+    assert (step1, arm1) == (step0, "bf16")  # ckpt provenance untouched
+    assert version1 == version0 + 1 and srv.arm_switches == 1
+    assert not srv.set_arm("bf16")  # same arm: no republish
+    assert srv._published[2] == version1
+    # falling back restores the RAW params bit-for-bit — "full" is not a
+    # round trip through the degraded representation
+    assert srv.set_arm("full")
+    trees = (srv._published[0], params0)
+    np.testing.assert_array_equal(
+        *[np.asarray(list(_leaves(t))[0]) for t in trees]
+    )
+    st = srv.stats()
+    assert st["serve_arm"] == "full" and st["arm_switches"] == 2
+    with pytest.raises(ValueError, match="arm"):
+        srv.set_arm("fp8")
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
+def test_bf16_arm_serves_close_to_fp32():
+    """The bf16 rung's quality contract: weight-only rounding, so served
+    Q-values stay close to the fp32 arm's (and the response stream keeps
+    flowing across the mid-traffic switch)."""
+    cfg = tiny_test()
+    srv = PolicyServer(cfg, ServeConfig(buckets=(2,), max_wait_ms=1.0,
+                                        cache_capacity=4))
+    srv.warmup()
+    srv.start()
+    client = LocalClient(srv)
+    rng = np.random.default_rng(2)
+    obs = rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8)
+    try:
+        q_full = np.asarray(client.act("a", obs, reset=True).q)
+        assert srv.set_arm("bf16")
+        q_bf16 = np.asarray(client.act("b", obs, reset=True).q)
+    finally:
+        srv.stop()
+    scale = max(float(np.max(np.abs(q_full))), 1e-9)
+    assert float(np.max(np.abs(q_bf16 - q_full))) / scale < 0.05
+    assert srv.stats()["serve_arm"] == "bf16"
+
+
+# --------------------------------------------------------- kill + migration
+
+
+@needs_dp2
+def test_replica_kill_migrates_every_session_bit_exact():
+    """The acceptance criterion: kill a replica mid-traffic — every one of
+    its sessions migrates through the spill tier (`sessions_lost == 0`)
+    and every survivor's post-kill responses continue its carry stream
+    BITWISE, as if the kill never happened."""
+    cfg = tiny_test().replace(serve_devices=2, serve_spill=64)
+    srv = MultiDeviceServer(
+        cfg, ServeConfig(buckets=(2, 4), max_wait_ms=1.0, cache_capacity=8)
+    )
+    srv.warmup()
+    srv.start()
+    client = LocalClient(srv)
+    rng = np.random.default_rng(9)
+    n_sessions, pre_steps, post_steps = 8, 3, 3
+    refs = [SessionReference(srv.net, cfg.hidden_dim)
+            for _ in range(n_sessions)]
+
+    def step_all(first: bool) -> None:
+        for s in range(n_sessions):
+            obs = rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8)
+            reward = float(rng.normal())
+            res = client.act(f"kc-{s}", obs, reward=reward, reset=first)
+            q_ref, a_ref = refs[s].step(srv._params_host, obs, reward, first)
+            np.testing.assert_array_equal(q_ref, np.asarray(res.q))
+            assert a_ref == res.action
+
+    try:
+        step_all(True)
+        for _ in range(pre_steps - 1):
+            step_all(False)
+        counts = srv.router.counts()
+        victim = int(np.argmax(counts))
+        assert counts[victim] > 0  # the kill actually orphans sessions
+        outcome = srv.kill_replica(victim)
+        assert outcome["lost"] == 0
+        assert outcome["migrated"] == counts[victim]
+        # every post-kill request promotes the migrated carry from the
+        # survivor's slab and continues the stream bit-for-bit
+        for _ in range(post_steps):
+            step_all(False)
+    finally:
+        srv.stop()
+    st = srv.stats()
+    assert st["sessions_lost"] == 0
+    assert st["sessions_migrated"] == outcome["migrated"]
+    assert st["replicas_killed"] == 1
+    assert st["router_active"].count(True) == 1
+    assert st["cache_imports"] == outcome["migrated"]
+
+
+@needs_dp2
+def test_replica_kill_scenario_end_to_end():
+    """The chaos scenario through the declarative engine: the scheduled
+    kill fires at its exact event, the fleet keeps answering, and the
+    readiness row reports zero lost sessions."""
+    cfg = tiny_test().replace(serve_devices=2, serve_spill=64)
+    srv = MultiDeviceServer(
+        cfg, ServeConfig(buckets=(2, 4), max_wait_ms=1.0, cache_capacity=8)
+    )
+    srv.warmup()
+    srv.start()
+    spec = ScenarioSpec(name="kill", duration_s=1.0, base_rate=60.0,
+                        sessions=8, kill_at=0.5, seed=6)
+    try:
+        row = ScenarioRunner(srv, spec, slo_ms=200.0).run()
+    finally:
+        srv.stop()
+    assert row["replica_kills"] == 1
+    assert row["ok"] > 0
+    st = srv.stats()
+    assert st["sessions_lost"] == 0 and st["replicas_killed"] == 1
+
+
+# ----------------------------------------------------------- client budget
+
+
+class _SheddingStub:
+    """submit() double: rejects the first `reject_first` calls with
+    QueueFullError, then answers."""
+
+    def __init__(self, reject_first: int, error: Exception = None):
+        self.reject_first = reject_first
+        self.error = error
+        self.calls = 0
+
+    def submit(self, session_id, obs, reward=0.0, reset=False) -> Future:
+        fut: Future = Future()
+        self.calls += 1
+        if self.calls <= self.reject_first:
+            fut.set_exception(QueueFullError("serve queue full (stub)"))
+        elif self.error is not None:
+            fut.set_exception(self.error)
+        else:
+            fut.set_result(ServeResult(1, np.zeros(3, np.float32), 0, 0))
+        return fut
+
+
+def _tcp_client(stub, **kw) -> PolicyClient:
+    tcp, _ = serve_tcp(stub, port=0)
+    host, port = tcp.server_address
+    client = PolicyClient(host=host, port=port, timeout=5.0, **kw)
+    client._tcp = tcp  # keep the server alive with the client
+    return client
+
+
+def test_client_queue_budget_retries_then_succeeds():
+    stub = _SheddingStub(reject_first=2)
+    client = _tcp_client(stub, queue_retries=3)
+    try:
+        resp = client.act("s", [1, 2], reset=True)
+        assert resp["action"] == 1
+        assert stub.calls == 3  # two rejections absorbed by the budget
+        assert client.error_counts == {"rejected": 0, "timeout": 0,
+                                       "transport": 0}
+    finally:
+        client.close()
+        client._tcp.shutdown()
+        client._tcp.server_close()
+
+
+def test_client_queue_budget_exhausts_and_classifies():
+    stub = _SheddingStub(reject_first=10)
+    client = _tcp_client(stub, queue_retries=2)
+    try:
+        with pytest.raises(QueueFullError):
+            client.act("s", [1, 2])
+        assert stub.calls == 2  # the budget bounds the re-offers
+        assert client.error_counts["rejected"] == 1
+    finally:
+        client.close()
+        client._tcp.shutdown()
+        client._tcp.server_close()
+
+
+def test_client_classifies_transport_errors():
+    stub = _SheddingStub(reject_first=0, error=ValueError("exploded"))
+    client = _tcp_client(stub)
+    try:
+        with pytest.raises(RuntimeError, match="exploded"):
+            client.act("s", [1, 2])
+        assert client.error_counts["transport"] == 1
+        assert client.error_counts["rejected"] == 0
+    finally:
+        client.close()
+        client._tcp.shutdown()
+        client._tcp.server_close()
