@@ -1206,6 +1206,236 @@ def scenarios_main(
     print(json.dumps(report))
 
 
+def liveloop_main(
+    core: str = "lstm",
+    lru_chunk: int = 0,
+    sessions: int = 8,
+    seconds: float = 30.0,
+    arrival_rate: float = 60.0,
+    seed: int = 0,
+    out_path: str = "",
+):
+    """Live-loop learning bench: the full serve -> replay -> learn ->
+    publish circle in one process (liveloop/). A two-replica fleet serves
+    catch sessions; the TransitionTap feeds every served transition
+    through the ingestion bridge into host replay; a LiveLoopTrainer runs
+    continuous updates off that store in the main thread; and every
+    save_interval crossing writes a checkpoint that the fleet's stock
+    ckpt watcher hot-reloads mid-run — so the headline row certifies the
+    loop actually closes: >= 1 reload of SELF-TRAINED params with
+    params_version advancing, sessions_lost == 0.
+
+    Traffic is Poisson-paced per session thread at a FIXED aggregate
+    arrival rate; each session runs its own CatchHostEnv closed-loop and
+    ships the terminal reward on the reset=True request (the liveloop
+    client protocol — see liveloop/tap.py). The report is return per
+    session over wall-clock: per-quarter mean episode return, first- vs
+    second-half means, and per-session rows carrying the assigned
+    exploration epsilon (the off-policy audit surface)."""
+    import tempfile
+
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.envs.catch import CatchHostEnv
+    from r2d2_tpu.liveloop import LiveLoopPlane, LiveLoopTrainer
+    from r2d2_tpu.serve import LocalClient, MultiDeviceServer, ServeConfig
+
+    ckpt_dir = tempfile.mkdtemp(prefix="liveloop_bench_")
+    cfg = tiny_test().replace(
+        env_name="catch",
+        action_dim=3,
+        liveloop=True,
+        checkpoint_dir=ckpt_dir,
+        # cadences sized so several publish->reload cycles land inside
+        # the window: learning starts after ~2s of traffic at the default
+        # rate, and every 20 updates cuts a checkpoint for the watcher
+        save_interval=20,
+        learning_starts=128,
+        buffer_capacity=4096,
+        training_steps=1_000_000,  # wall clock, not step count, ends the run
+        serve_spill=4 * sessions,
+        **_core_overrides(core, lru_chunk),
+    ).validate()
+    serve_cfg = ServeConfig(
+        buckets=(2, 4, 8),
+        max_wait_ms=2.0,
+        cache_capacity=max(16, sessions),
+        poll_interval_s=0.25,  # tight watcher cadence: reloads land mid-run
+        seed=seed,
+    )
+    trainer = LiveLoopTrainer(cfg)
+    d0 = jax.local_devices()[0]
+    server = MultiDeviceServer(
+        cfg, serve_cfg, checkpoint_dir=ckpt_dir, devices=[d0, d0]
+    )
+    plane = LiveLoopPlane(cfg, server, trainer.replay, seed=seed)
+    t0 = time.perf_counter()
+    server.warmup()
+    print(f"[liveloop] warmup in {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+    server.start(watch_checkpoints=True)
+    plane.start()
+    version0 = server.stats()["params_version"]
+
+    stop = threading.Event()
+    rec_lock = threading.Lock()
+    latencies: list = []  # submit -> action, seconds
+    episodes: list = []  # (t_end_rel_s, session_idx, return, length)
+    t0 = time.perf_counter()
+    per_session_rate = max(arrival_rate / max(sessions, 1), 1e-6)
+
+    def session_body(idx: int) -> None:
+        # one live session: closed-loop catch, Poisson-paced requests.
+        # After a terminal step the NEXT request carries reset=True, the
+        # terminal reward, and the fresh episode's first frame — the tap
+        # closes the episode off that one request.
+        rng = np.random.default_rng(seed * 1009 + idx)
+        env = CatchHostEnv(
+            height=cfg.obs_shape[0], width=cfg.obs_shape[1],
+            seed=seed * 1009 + idx,
+        )
+        client = LocalClient(server)
+        sid = f"live-{idx}"
+        obs, reward, reset = env.reset(), 0.0, True
+        ep_ret, ep_len = 0.0, 0
+        while not stop.is_set():
+            t_req = time.perf_counter()
+            try:
+                res = client.act(sid, obs, reward=reward, reset=reset)
+            except Exception:
+                # shed/transient: abandon the episode, restart the stream
+                obs, reward, reset = env.reset(), 0.0, True
+                ep_ret, ep_len = 0.0, 0
+                time.sleep(rng.exponential(1.0 / per_session_rate))
+                continue
+            with rec_lock:
+                latencies.append(time.perf_counter() - t_req)
+            reset = False
+            obs, reward, done, _ = env.step(res.action)
+            ep_ret += reward
+            ep_len += 1
+            if done:
+                with rec_lock:
+                    episodes.append(
+                        (time.perf_counter() - t0, idx, ep_ret, ep_len)
+                    )
+                # terminal reward stays in `reward` for the next request
+                obs, reset = env.reset(), True
+                ep_ret, ep_len = 0.0, 0
+            time.sleep(rng.exponential(1.0 / per_session_rate))
+
+    threads = [
+        threading.Thread(target=session_body, args=(i,),
+                         name=f"live-session-{i}", daemon=True)
+        for i in range(sessions)
+    ]
+    for t in threads:
+        t.start()
+
+    deadline = time.monotonic() + seconds
+    updates = 0
+    first_reload_s = None
+    while time.monotonic() < deadline:
+        plane.check()  # liveloop workers must be alive, not just present
+        if trainer.can_train():
+            updates += trainer.train(8, deadline=deadline)
+        else:
+            time.sleep(0.05)
+        if first_reload_s is None and server.stats()["reloads"] > 0:
+            first_reload_s = round(time.perf_counter() - t0, 2)
+
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    wall = time.perf_counter() - t0
+    plane.stop()  # final drains: queued records/blocks land in replay
+    trainer.finish()
+    loop_stats = plane.stats()
+    learn_stats = trainer.stats()
+    stats = server.stats()
+    server.stop()
+
+    lat_ms = np.sort(np.asarray(latencies, np.float64)) * 1e3
+    n_q = 4
+    timeline = []
+    for q in range(n_q):
+        lo, hi = seconds * q / n_q, seconds * (q + 1) / n_q
+        rs = [r for (t, _, r, _) in episodes if lo <= t < hi]
+        timeline.append({
+            "window_s": [round(lo, 2), round(hi, 2)],
+            "episodes": len(rs),
+            "mean_return": round(float(np.mean(rs)), 4) if rs else None,
+        })
+    half1 = [r for (t, _, r, _) in episodes if t < seconds / 2]
+    half2 = [r for (t, _, r, _) in episodes if t >= seconds / 2]
+    by_session: dict = {}
+    for (_, idx, r, _) in episodes:
+        by_session.setdefault(idx, []).append(r)
+    session_rows = [
+        {
+            "session": f"live-{i}",
+            "episodes": len(rs),
+            "mean_return": round(float(np.mean(rs)), 4),
+            "epsilon": plane.assigner.epsilon_of(f"live-{i}"),
+        }
+        for i, rs in sorted(by_session.items())
+    ]
+    row = {
+        "metric": "liveloop_return_per_session",
+        # headline: mean episode return over the window's second half —
+        # the policy the loop trained and hot-reloaded mid-run
+        "value": round(float(np.mean(half2)), 4) if half2 else None,
+        "unit": "return/episode",
+        "vs_baseline": None,
+        "first_half_mean_return": (
+            round(float(np.mean(half1)), 4) if half1 else None
+        ),
+        "return_timeline": timeline,
+        "episodes_total": len(episodes),
+        "sessions": sessions,
+        "per_session": session_rows,
+        "arrival_rate_target": arrival_rate,
+        "arrival_rate_achieved": round(len(latencies) / wall, 2),
+        "duration_s": round(wall, 2),
+        "seed": seed,
+        "p50_latency_ms": round(float(np.percentile(lat_ms, 50)), 3),
+        "p95_latency_ms": round(float(np.percentile(lat_ms, 95)), 3),
+        "p99_latency_ms": round(float(np.percentile(lat_ms, 99)), 3),
+        "learner_updates": updates,
+        "learner_step": learn_stats["learner_step"],
+        "reloads": stats["reloads"],
+        "first_reload_s": first_reload_s,
+        "params_version_start": version0,
+        "params_version_final": stats["params_version"],
+        "sessions_lost": stats["sessions_lost"],
+        **{k: v for k, v in loop_stats.items() if k != "eps_ladder"},
+        "core": cfg.recurrent_core
+        + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
+    }
+    print(
+        f"[liveloop] {len(episodes)} episodes / {len(latencies)} requests "
+        f"in {wall:.1f}s; updates={updates} reloads={row['reloads']} "
+        f"version {version0}->{row['params_version_final']} "
+        f"return {row['first_half_mean_return']} -> {row['value']} "
+        f"lost={row['sessions_lost']}",
+        file=sys.stderr,
+    )
+    if row["reloads"] < 1 or row["params_version_final"] <= version0:
+        raise SystemExit(
+            "[liveloop] FAIL: no mid-run hot reload of self-trained params "
+            f"(reloads={row['reloads']}, version {version0}->"
+            f"{row['params_version_final']}) — the loop did not close"
+        )
+    if row["sessions_lost"]:
+        raise SystemExit(
+            f"[liveloop] FAIL: sessions_lost={row['sessions_lost']} != 0"
+        )
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(row, f, indent=1)
+        print(f"[liveloop] report -> {out_path}", file=sys.stderr)
+    print(json.dumps(row))
+
+
 def serve_main(
     core: str = "lstm",
     lru_chunk: int = 0,
@@ -1575,7 +1805,7 @@ if __name__ == "__main__":
     p.add_argument(
         "--mode", default="learner",
         choices=["learner", "system", "fused", "long_context", "serve",
-                 "recovery", "breakdown", "scenarios"],
+                 "recovery", "breakdown", "scenarios", "liveloop"],
         help="learner: fused-update throughput on synthetic replay (the "
              "driver's default metric). system: concurrent on-device "
              "collection + learning via threads. fused: the same full "
@@ -1593,7 +1823,11 @@ if __name__ == "__main__":
              "against every rung of the graceful-degradation ladder "
              "(serve/degrade.py) on a two-replica fleet, reporting p99, "
              "SLO attainment, error breakdown, q_drift_vs_fp32 and "
-             "sessions_lost per cell.",
+             "sessions_lost per cell. liveloop: the closed learning loop "
+             "(liveloop/) — served catch traffic feeds replay through the "
+             "transition tap, a continuous learner trains off it, and its "
+             "checkpoints hot-reload the fleet mid-run; reports return "
+             "per session over wall-clock at a fixed arrival rate.",
     )
     p.add_argument(
         "--collect-every", type=int, default=6,
@@ -1700,6 +1934,31 @@ if __name__ == "__main__":
         help="scenarios mode: also write the readiness report JSON here "
              "(e.g. BENCH_r11.json)",
     )
+    p.add_argument(
+        "--liveloop-rate", type=float, default=60.0,
+        help="liveloop mode: fixed aggregate arrival rate in requests/s "
+             "(Poisson-paced per session)",
+    )
+    p.add_argument(
+        "--liveloop-seconds", type=float, default=30.0,
+        help="liveloop mode: wall-clock window for the closed loop "
+             "(long enough for learning_starts + >= 1 checkpoint reload)",
+    )
+    p.add_argument(
+        "--liveloop-sessions", type=int, default=8,
+        help="liveloop mode: concurrent live sessions (each a closed-loop "
+             "catch episode stream)",
+    )
+    p.add_argument(
+        "--liveloop-seed", type=int, default=0,
+        help="liveloop mode: seed for traffic pacing, envs, and the "
+             "per-session exploration assignment",
+    )
+    p.add_argument(
+        "--liveloop-out", default="",
+        help="liveloop mode: also write the report JSON here "
+             "(e.g. BENCH_r12.json)",
+    )
     args = p.parse_args()
     enable_compilation_cache(args.compile_cache)
     precision = args.precision or (
@@ -1714,6 +1973,13 @@ if __name__ == "__main__":
                    args.serve_seconds, precision,
                    arrival_rate=args.arrival_rate, slo_ms=args.slo_ms,
                    devices=args.serve_devices)
+    elif args.mode == "liveloop":
+        liveloop_main(args.core, args.lru_chunk,
+                      sessions=args.liveloop_sessions,
+                      seconds=args.liveloop_seconds,
+                      arrival_rate=args.liveloop_rate,
+                      seed=args.liveloop_seed,
+                      out_path=args.liveloop_out)
     elif args.mode == "scenarios":
         scenarios_main(args.core, args.lru_chunk,
                        sessions=args.scenario_sessions,

@@ -968,6 +968,58 @@ def scan_serve_step(precision: str) -> List[Finding]:
     return out
 
 
+def _liveloop_gather_shapes(precision: str):
+    import jax
+    import jax.numpy as jnp
+
+    cfg = _cfg(precision)
+    H = cfg.hidden_dim
+    dt = jnp.bfloat16 if "bfloat16" in str(cfg.state_dtype) else jnp.float32
+    sds = jax.ShapeDtypeStruct
+    # capacity+1 rows (scratch slot included), a 2-row batch gather
+    return (sds((5, H), dt), sds((5, H), dt), sds((2,), jnp.int32))
+
+
+def liveloop_gather_jaxpr(precision: str) -> str:
+    import jax
+
+    from r2d2_tpu.liveloop.tap import gather_carry_rows
+
+    return str(jax.make_jaxpr(gather_carry_rows)(*_liveloop_gather_shapes(precision)))
+
+
+def scan_liveloop_gather(precision: str) -> List[Finding]:
+    """The live-loop tap's only device program: the per-batch carry-row
+    gather off the committed session stores (liveloop/tap.py). It runs on
+    the serve loop, so it inherits the serve step's hygiene bar — no f64
+    upcasts, no host callbacks — and must hand the accumulators float32
+    carries regardless of the cache dtype (the stored-state contract)."""
+    import jax
+
+    from r2d2_tpu.liveloop.tap import gather_carry_rows
+
+    label = f"liveloop_gather[{precision}]"
+    text = liveloop_gather_jaxpr(precision)
+    out = check_no_float64(text, label)
+    out += check_no_host_callback(text, label)
+    h_rows, c_rows = jax.eval_shape(
+        gather_carry_rows, *_liveloop_gather_shapes(precision)
+    )
+    for name, leaf in (("h", h_rows), ("c", c_rows)):
+        if str(leaf.dtype) != "float32":
+            out.append(
+                _finding(
+                    "jaxpr-output-dtype", label,
+                    f"tap {name}-carry rows leave the gather as "
+                    f"{leaf.dtype}, expected float32 (SequenceAccumulator "
+                    "stores (2, H) f32 hidden state)",
+                    hint="gather_carry_rows must .astype(float32) after "
+                    "the take — the cache may hold bf16",
+                )
+            )
+    return out
+
+
 def scan_donation(precision: str) -> List[Finding]:
     return check_train_state_donation(precision) + check_store_field_dtypes(precision)
 
@@ -988,6 +1040,7 @@ def scan_entry_points(
         out += scan_superstep(p)
         out += scan_serve_step(p)
         out += scan_multi_serve_step(p)
+        out += scan_liveloop_gather(p)
         out += scan_donation(p)
     # the quantized arm composes with precision the same way everywhere;
     # one trace on the golden path keeps the gate's runtime bounded
@@ -1017,6 +1070,7 @@ _ENTRY_POINT_SOURCES = (
     "serve/multi.py",
     "serve/server.py",
     "serve/state_cache.py",
+    "liveloop/tap.py",
     "analysis/jaxpr_rules.py",  # the checkers are inputs too
 )
 

@@ -179,6 +179,35 @@ class R2D2Config:
     # controller's low-water band) counts as a pressured evaluation.
     serve_degrade_slo_ms: float = 50.0
 
+    # Live-loop learning plane (liveloop/). When True the serve plane
+    # grows a TransitionTap: every served step's (obs, action, reward,
+    # carry-seam, epsilon, params_version) is captured off the hot path
+    # into per-session SequenceAccumulators, finished Blocks drain
+    # through a bounded ingestion bridge into the configured replay
+    # plane, and a LiveLoopTrainer trains continuously against the live
+    # store — checkpoints land where the serve watcher hot-reloads them,
+    # closing serve -> replay -> learn -> publish into one
+    # self-improving service. Default False: NO tap is installed, no
+    # liveloop threads exist, and the serve/train paths are byte-for-
+    # byte the pre-liveloop behavior (the golden rows stay bit-exact).
+    liveloop: bool = False
+    # Fraction of admitted sessions assigned an exploring epsilon from
+    # the Ape-X ladder (ops/epsilon.py over base_eps/eps_alpha) at
+    # session admission; the rest serve greedy (eps = 0). The assigned
+    # epsilon is stamped into every captured transition for off-policy
+    # audit and surfaced in stats().
+    liveloop_explore_fraction: float = 0.5
+    # Rungs of the per-session exploration ladder (epsilon_ladder's
+    # num_actors argument): rung i gets base_eps ** (1 + i/(N-1)*alpha).
+    liveloop_eps_rungs: int = 8
+    # Bounded depths for the two liveloop hand-off queues, in items.
+    # Both shed drop-oldest (counted in stats) under pressure so the
+    # serve loop is never blocked by the learner: tap depth is batch
+    # records awaiting accumulation, queue depth is finished Blocks
+    # awaiting replay ingestion.
+    liveloop_tap_depth: int = 256
+    liveloop_queue_depth: int = 64
+
     # Fused-sequence training semantics for the LSTM core: the T-step
     # unroll treats each row's burn-in prefix as state-refresh only — a
     # stop-gradient seam at burn_in[b] cuts the backward pass so burn-in
@@ -412,6 +441,22 @@ class R2D2Config:
                 "serve_degrade_slo_ms is the degradation ladder's p99 "
                 "latency target in milliseconds (serve/degrade.py); it "
                 "must be > 0"
+            )
+        if not 0.0 <= self.liveloop_explore_fraction <= 1.0:
+            raise ValueError(
+                "liveloop_explore_fraction is the share of live sessions "
+                "assigned an exploring epsilon from the ladder; it must "
+                "be in [0, 1]"
+            )
+        if self.liveloop_eps_rungs < 1:
+            raise ValueError(
+                "liveloop_eps_rungs must be >= 1 (rungs of the per-"
+                "session exploration ladder, ops/epsilon.py)"
+            )
+        if self.liveloop_tap_depth < 1 or self.liveloop_queue_depth < 1:
+            raise ValueError(
+                "liveloop_tap_depth and liveloop_queue_depth are bounded "
+                "hand-off queue depths; both must be >= 1"
             )
         if self.lstm_backend not in ("auto", "scan", "pallas"):
             raise ValueError(f"unknown lstm_backend {self.lstm_backend!r}")

@@ -1,0 +1,23 @@
+"""Live-loop learning plane: serve -> replay -> learn -> publish.
+
+Served traffic feeds replay through a TransitionTap + IngestBridge, a
+LiveLoopTrainer trains continuously against the live store, and the serve
+plane's checkpoint watcher hot-reloads the improved params fleet-wide.
+See ARCHITECTURE.md (live-loop section) for the dataflow and the
+off-policy stamping / backpressure semantics.
+"""
+
+from r2d2_tpu.liveloop.bridge import IngestBridge
+from r2d2_tpu.liveloop.explore import EpsilonAssigner
+from r2d2_tpu.liveloop.loop import LiveLoopPlane
+from r2d2_tpu.liveloop.tap import TransitionTap, gather_carry_rows
+from r2d2_tpu.liveloop.trainer import LiveLoopTrainer
+
+__all__ = [
+    "EpsilonAssigner",
+    "IngestBridge",
+    "LiveLoopPlane",
+    "LiveLoopTrainer",
+    "TransitionTap",
+    "gather_carry_rows",
+]

@@ -1,0 +1,79 @@
+"""EpsilonAssigner — per-session exploration over live traffic.
+
+Ape-X runs a ladder of epsilons across its actor fleet (Horgan et al.,
+2018); ops/epsilon.py already builds that ladder for the training actors.
+Serving has no fixed fleet — sessions come and go — so the assigner maps
+the ladder onto traffic instead: at session admission (first sight), a
+seeded coin decides whether the session explores at all
+(`liveloop_explore_fraction`), and exploring sessions draw a uniform rung
+of `epsilon_ladder(liveloop_eps_rungs, base_eps, eps_alpha)`. The
+assignment is sticky for the session's lifetime, stamped into every
+captured transition by the tap (off-policy audit), and surfaced in
+stats(). Non-exploring sessions serve greedy (epsilon = 0) — end users
+get the best policy while a controlled slice of traffic keeps the replay
+distribution exploratory.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import numpy as np
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.ops.epsilon import epsilon_ladder
+
+
+class EpsilonAssigner:
+    def __init__(self, cfg: R2D2Config, seed: int = 0):
+        self.fraction = float(cfg.liveloop_explore_fraction)
+        self.ladder = np.asarray(
+            epsilon_ladder(cfg.liveloop_eps_rungs, cfg.base_eps, cfg.eps_alpha),
+            np.float32,
+        )
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+        self._eps: Dict[str, float] = {}
+        self._rung_counts = np.zeros(len(self.ladder), np.int64)
+        self.greedy_sessions = 0
+
+    def epsilon_for(self, session_id: str) -> float:
+        """Sticky per-session epsilon; first sight draws the assignment."""
+        with self._lock:
+            eps = self._eps.get(session_id)
+            if eps is None:
+                if self._rng.random() < self.fraction:
+                    rung = int(self._rng.integers(len(self.ladder)))
+                    eps = float(self.ladder[rung])
+                    self._rung_counts[rung] += 1
+                else:
+                    eps = 0.0
+                    self.greedy_sessions += 1
+                self._eps[session_id] = eps
+            return eps
+
+    def epsilon_of(self, session_id: str):
+        """The assignment if one exists (no draw) — for stats/audit."""
+        with self._lock:
+            return self._eps.get(session_id)
+
+    def forget(self, session_id: str) -> None:
+        """Session disconnected; a returning id draws a fresh assignment."""
+        with self._lock:
+            self._eps.pop(session_id, None)
+
+    def stats(self) -> dict:
+        with self._lock:
+            explorers = int(self._rung_counts.sum())
+            assigned = explorers + self.greedy_sessions
+            return {
+                "eps_sessions_assigned": assigned,
+                "eps_sessions_exploring": explorers,
+                "eps_sessions_greedy": self.greedy_sessions,
+                "eps_ladder": [float(e) for e in self.ladder],
+                "eps_rung_counts": [int(c) for c in self._rung_counts],
+                "eps_mean_assigned": (
+                    float(np.mean(list(self._eps.values()))) if self._eps else 0.0
+                ),
+            }

@@ -1,0 +1,92 @@
+"""LiveLoopPlane — wire tap + bridge + exploration onto a running server.
+
+One coordinator owns the liveloop side-threads and installs the capture
+hooks on a serve stack (a single `PolicyServer` or every replica of a
+`MultiDeviceServer` — the tap and assigner are shared; session affinity
+means one session's records always come from one replica's serve loop,
+and concurrent replicas only ever append to the tap's lock-guarded
+queue). Two supervised workers run under the same supervision contract
+as the serve plane (utils/supervision.py — bounded work per iteration,
+crash restart, stall detection):
+
+    liveloop-tap     drains batch records into per-session accumulators
+                     (fault site "liveloop.tap")
+    liveloop-ingest  drains finished Blocks into the replay plane
+                     (fault site "liveloop.ingest")
+
+`config.liveloop` off (the default) means none of this is constructed:
+no tap is installed on any server, no threads exist, and the serve and
+train paths are byte-for-byte their pre-liveloop behavior.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.liveloop.bridge import IngestBridge
+from r2d2_tpu.liveloop.explore import EpsilonAssigner
+from r2d2_tpu.liveloop.tap import TransitionTap
+from r2d2_tpu.utils.faults import fault_point
+from r2d2_tpu.utils.supervision import Supervisor
+
+
+class LiveLoopPlane:
+    def __init__(self, cfg: R2D2Config, server, replay, seed: int = 0):
+        self.cfg = cfg
+        self.tap = TransitionTap(cfg, depth=cfg.liveloop_tap_depth)
+        self.bridge = IngestBridge(replay, depth=cfg.liveloop_queue_depth)
+        self.tap.set_emit(self.bridge.offer)
+        self.assigner = EpsilonAssigner(cfg, seed=seed)
+        self.supervisor: Optional[Supervisor] = None
+        # install the capture hooks on every serve loop in the stack
+        self._servers: List = list(getattr(server, "replicas", None) or [server])
+        for s in self._servers:
+            s.tap = self.tap
+            s.eps_assigner = self.assigner
+
+    # --------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self.supervisor = Supervisor()
+        self.supervisor.spawn("liveloop-tap", self._tap_iteration)
+        self.supervisor.spawn("liveloop-ingest", self._ingest_iteration)
+
+    def _tap_iteration(self) -> None:
+        # chaos drill: an "error" here exercises supervised restart; the
+        # record queue is the crash boundary (un-drained records survive)
+        fault_point("liveloop.tap")
+        self.tap.process_pending(timeout=0.25)
+
+    def _ingest_iteration(self) -> None:
+        fault_point("liveloop.ingest")
+        self.bridge.drain_once(timeout=0.25)
+
+    def stop(self) -> None:
+        """Detach the hooks, stop the workers, then run the final drains
+        inline: queued records are accumulated, in-flight partial blocks
+        are cut (bootstrapped from their pending Q), and everything
+        emitted lands in replay before this returns."""
+        for s in self._servers:
+            s.tap = None
+            s.eps_assigner = None
+        if self.supervisor is not None:
+            self.supervisor.stop.set()
+            for w in self.supervisor.workers:
+                w.join(timeout=5.0)
+            self.supervisor = None
+        self.tap.process_pending(timeout=0.0)
+        self.tap.flush()
+        self.bridge.drain_once(timeout=0.0)
+
+    def check(self) -> dict:
+        """Surface worker restart/stall counters (raises if a liveloop
+        worker died for good — same loud-failure contract as the learner)."""
+        return self.supervisor.check() if self.supervisor is not None else {}
+
+    def stats(self) -> dict:
+        out = {}
+        out.update(self.tap.stats())
+        out.update(self.bridge.stats())
+        out.update(self.assigner.stats())
+        return out

@@ -1,0 +1,71 @@
+"""LiveLoopTrainer — continuous learning against the live replay store.
+
+Deliberately thin: construction builds a full `Trainer` (same jitted
+update step, same replay plane, same publish/checkpoint cadences), but
+the actor/collector it comes with is never stepped — the store fills from
+served traffic via the tap + ingestion bridge instead. `train()` then
+drives the stock `_one_update(plane.sample())` loop, so every crossing of
+`save_interval` writes a checkpoint into `cfg.checkpoint_dir` through
+utils/checkpoint.py — exactly the directory the serve plane's ckpt
+watcher polls, which is what closes the loop: the fleet hot-reloads the
+policy its own traffic just trained, params_version advances on every
+replica, and subsequent captured transitions carry the new stamp.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.train import Trainer
+
+
+class LiveLoopTrainer:
+    def __init__(self, cfg: R2D2Config, trainer: Optional[Trainer] = None):
+        self.cfg = cfg
+        self.trainer = trainer if trainer is not None else Trainer(cfg)
+        # _cadences stamps wall-minutes into checkpoints relative to
+        # _start_time, which only the run modes set; the live loop is its
+        # own run mode
+        self.trainer._start_time = time.time()
+        self.updates_done = 0
+
+    @property
+    def replay(self):
+        return self.trainer.replay
+
+    def can_train(self) -> bool:
+        return self.trainer.replay.can_sample()
+
+    def train(self, max_updates: int, deadline: Optional[float] = None) -> int:
+        """Run up to `max_updates` updates (stopping at `deadline`,
+        time.monotonic-based, if given); returns updates performed. Bounded
+        work per call so callers can interleave training with stats polls
+        and stop checks — the live-loop analog of one superstep."""
+        done = 0
+        tr = self.trainer
+        while done < max_updates and tr.replay.can_sample():
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            tr._one_update(tr.plane.sample())
+            done += tr.plane.steps_per_update
+        self.updates_done += done
+        return done
+
+    @property
+    def step(self) -> int:
+        return self.trainer._step
+
+    def finish(self) -> None:
+        """Drain deferred per-plane work (stock contract for any external
+        update-driving loop)."""
+        self.trainer.finish_updates()
+
+    def stats(self) -> dict:
+        return {
+            "learner_step": self.trainer._step,
+            "learner_updates": self.updates_done,
+            "replay_env_steps": self.trainer.replay.env_steps,
+            "replay_can_sample": bool(self.trainer.replay.can_sample()),
+        }

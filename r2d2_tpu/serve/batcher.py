@@ -46,6 +46,9 @@ class ServeRequest:
     reset: bool
     future: Future
     t_enqueue: float
+    # per-request exploration override: None defers to the server's
+    # per-session assignment (liveloop) or ServeConfig.epsilon
+    epsilon: Optional[float] = None
 
 
 class MicroBatcher:
@@ -89,7 +92,7 @@ class MicroBatcher:
 
     def submit(
         self, session_id: str, obs: np.ndarray, reward: float = 0.0,
-        reset: bool = False,
+        reset: bool = False, epsilon: Optional[float] = None,
     ) -> Future:
         """Enqueue one request; the returned Future resolves to the serve
         loop's ServeResult. A full queue fails the future immediately with
@@ -122,6 +125,7 @@ class MicroBatcher:
             reset=bool(reset),
             future=fut,
             t_enqueue=time.monotonic(),
+            epsilon=None if epsilon is None else float(epsilon),
         )
         try:
             self._q.put_nowait(req)

@@ -48,11 +48,14 @@ class LocalClient:
         self.timeout = timeout
 
     def act(self, session_id: str, obs, reward: float = 0.0,
-            reset: bool = False) -> ServeResult:
+            reset: bool = False, epsilon: Optional[float] = None) -> ServeResult:
         """Submit one request and block for its result. Raises what the
         server failed the future with (QueueFullError on overload,
-        RuntimeError on a crashed iteration)."""
-        fut = self.server.submit(session_id, obs, reward=reward, reset=reset)
+        RuntimeError on a crashed iteration). `epsilon` overrides the
+        session's exploration for THIS request (None = server default)."""
+        fut = self.server.submit(
+            session_id, obs, reward=reward, reset=reset, epsilon=epsilon
+        )
         return fut.result(timeout=self.timeout)
 
     def reset(self, session_id: str) -> None:
@@ -77,10 +80,16 @@ class _RequestHandler(socketserver.StreamRequestHandler):
                 else:
                     # host-side JSON decode, no device values in sight
                     obs = np.asarray(req["obs"], np.uint8)  # r2d2: disable=host-sync-in-hot-path
+                    eps = req.get("epsilon")
+                    # epsilon only when the request carries one: requests
+                    # without the field make the exact pre-override call,
+                    # so servers exposing the old submit surface still work
+                    kwargs = {} if eps is None else {"epsilon": float(eps)}  # r2d2: disable=host-sync-in-hot-path
                     fut = server.submit(
                         str(req["session"]), obs,
                         reward=float(req.get("reward", 0.0)),  # r2d2: disable=host-sync-in-hot-path
                         reset=bool(req.get("reset", False)),  # r2d2: disable=host-sync-in-hot-path
+                        **kwargs,
                     )
                     result = fut.result(timeout=30.0)
                     resp = {
@@ -234,7 +243,8 @@ class PolicyClient:
                 raise
 
     def act(self, session_id: str, obs, reward: float = 0.0,
-            reset: bool = False, want_q: bool = False) -> dict:
+            reset: bool = False, want_q: bool = False,
+            epsilon: Optional[float] = None) -> dict:
         payload = {
             "session": session_id,
             "obs": np.asarray(obs).tolist(),
@@ -243,6 +253,8 @@ class PolicyClient:
         }
         if want_q:
             payload["want_q"] = True
+        if epsilon is not None:
+            payload["epsilon"] = float(epsilon)
         return self._round_trip(payload)
 
     def evict(self, session_id: str) -> None:

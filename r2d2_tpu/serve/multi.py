@@ -258,12 +258,12 @@ class MultiDeviceServer:
     # ------------------------------------------------------------- serving
 
     def submit(self, session_id: str, obs, reward: float = 0.0,
-               reset: bool = False) -> Future:
+               reset: bool = False, epsilon: Optional[float] = None) -> Future:
         """Route to the session's replica (placing a new session on the
         least-loaded one) and enqueue on that replica's batcher."""
         replica = self.router.route(session_id)
         return self.replicas[replica].submit(
-            session_id, obs, reward=reward, reset=reset
+            session_id, obs, reward=reward, reset=reset, epsilon=epsilon
         )
 
     def replica_for(self, session_id: str) -> Optional[PolicyServer]:
@@ -283,7 +283,10 @@ class MultiDeviceServer:
         affinity entry)."""
         idx = self.router.forget(session_id)
         if idx is not None:
-            self.replicas[idx].cache.evict(session_id)
+            # replica.evict (not cache.evict): the liveloop hooks — the
+            # epsilon assignment and the tap's partial block — must be
+            # released along with the HBM slot
+            self.replicas[idx].evict(session_id)
 
     # ---------------------------------------------------------- chaos plane
 
@@ -534,6 +537,11 @@ class MultiDeviceServer:
         out["cache_capacity"] = cache0.capacity * len(self.replicas)
         out["spill_capacity"] = cache0.spill_capacity * len(self.replicas)
         out.update(self.router.stats())
+        # liveloop tap/assigner are SHARED across replicas (one instance
+        # installed on all), so their stats pass through once, not summed
+        for key, val in per_replica[0].items():
+            if key.startswith(("eps_", "tap_")):
+                out[key] = val
         if self.degrade is not None:
             out.update(self.degrade.stats())
         out["replicas"] = per_replica

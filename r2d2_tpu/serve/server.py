@@ -207,6 +207,12 @@ class PolicyServer:
             queue_depth=serve_cfg.queue_depth,
         )
         self._rng = np.random.default_rng(serve_cfg.seed)
+        # live-loop capture hooks (liveloop/loop.py installs both; None —
+        # the default — keeps _run_batch byte-for-byte the pre-liveloop
+        # path): tap records served batches, eps_assigner maps sessions
+        # to sticky exploration epsilons
+        self.tap = None
+        self.eps_assigner = None
         self.trace_count = 0  # python-body counter: +1 per jit trace
         self.reloads = 0
         self.reload_errors = 0
@@ -409,8 +415,10 @@ class PolicyServer:
     # ------------------------------------------------------------- serving
 
     def submit(self, session_id: str, obs, reward: float = 0.0,
-               reset: bool = False) -> Future:
-        return self.batcher.submit(session_id, obs, reward=reward, reset=reset)
+               reset: bool = False, epsilon: Optional[float] = None) -> Future:
+        return self.batcher.submit(
+            session_id, obs, reward=reward, reset=reset, epsilon=epsilon
+        )
 
     def reset_session(self, session_id: str) -> None:
         self.cache.reset(session_id)
@@ -420,6 +428,10 @@ class PolicyServer:
         Same surface as MultiDeviceServer.evict so clients (LocalClient,
         the TCP handler) work against either server unchanged."""
         self.cache.evict(session_id)
+        if self.eps_assigner is not None:
+            self.eps_assigner.forget(session_id)
+        if self.tap is not None:
+            self.tap.observe_evict(session_id)
 
     def _run_batch(self, batch: List[ServeRequest]) -> None:
         with self._state_lock:
@@ -449,9 +461,23 @@ class PolicyServer:
         slots_full = np.concatenate(
             [slots, np.full(pad, self.cache.pad_slot, np.int32)]
         )
-        eps = self.serve_cfg.epsilon
-        if eps > 0.0:
-            explore = self._rng.random(bucket) < eps
+        # per-row exploration: request override > per-session assignment
+        # (liveloop's ladder) > the ServeConfig.epsilon fleet default.
+        # RNG discipline keeps the legacy stream bit-exact: the coin and
+        # random-action draws happen iff ANY row explores, in the same
+        # order and count as the old scalar path — all-zero rows (the
+        # default config) draw nothing, a uniform fleet epsilon draws
+        # exactly what it used to.
+        eps_row = np.full(bucket, self.serve_cfg.epsilon, np.float32)
+        assigner = self.eps_assigner
+        if assigner is not None or any(r.epsilon is not None for r in batch):
+            for i, r in enumerate(batch):
+                if r.epsilon is not None:
+                    eps_row[i] = r.epsilon
+                elif assigner is not None:
+                    eps_row[i] = assigner.epsilon_for(r.session_id)
+        if float(eps_row.max()) > 0.0:
+            explore = self._rng.random(bucket) < eps_row
             randoms = self._rng.integers(0, self.cfg.action_dim, bucket)
         else:
             explore = np.zeros(bucket, bool)
@@ -477,6 +503,16 @@ class PolicyServer:
             )
         with self._state_lock:
             self._inflight = []
+        if self.tap is not None:
+            # live-loop capture, after the clients have their answers: one
+            # fused gather of the batch rows' committed carries + a bounded
+            # (drop-oldest) append; accumulation runs on the liveloop-tap
+            # thread, never here
+            self.tap.observe_batch(
+                [r.session_id for r in batch], obs, act_np, q_np,
+                rewards, reset_mask, eps_row, ckpt_step, version,
+                h, c, slots_full,
+            )
         if self.degrade is not None:
             # feed the ladder's latency window (per answered request, the
             # same queue-to-resolve latency clients experience)
@@ -654,6 +690,10 @@ class PolicyServer:
         }
         out.update(self.batcher.stats())
         out.update(self.cache.stats())
+        if self.eps_assigner is not None:
+            out.update(self.eps_assigner.stats())
+        if self.tap is not None:
+            out.update(self.tap.stats())
         if self.degrade is not None and self._degrade_owner:
             out.update(self.degrade.stats())
         return out

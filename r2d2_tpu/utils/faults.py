@@ -45,6 +45,15 @@ Registered sites (KNOWN_SITES below):
                         the scenario's own (serve/scenarios.py)
 - reshard.gather      — elastic-resume slab regather (replay/reshard.py)
 - reshard.scatter     — elastic-resume re-deal/scatter (replay/reshard.py)
+- liveloop.tap        — top of every liveloop-tap iteration: served batch
+                        records -> per-session accumulators; an "error"
+                        exercises supervised restart with the bounded
+                        record queue as the crash boundary
+                        (liveloop/loop.py)
+- liveloop.ingest     — top of every liveloop-ingest iteration AND the
+                        retry site for the replay add itself: finished
+                        Blocks -> replay plane (liveloop/loop.py,
+                        liveloop/bridge.py)
 """
 
 from __future__ import annotations
@@ -78,6 +87,8 @@ KNOWN_SITES = (
     "serve.slow_client",
     "reshard.gather",
     "reshard.scatter",
+    "liveloop.tap",
+    "liveloop.ingest",
 )
 
 

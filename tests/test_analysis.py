@@ -1069,9 +1069,13 @@ def test_thread_root_inventory_repo_wide():
     assert any(n.startswith("degrade-controller") for n in spawn_names), (
         sorted(spawn_names)
     )
+    # the PR 12 live-loop workers (tap drain + replay ingest) run under the
+    # same supervision contract and must be inventoried with the fleet
+    assert "liveloop-tap" in spawn_names, sorted(spawn_names)
+    assert "liveloop-ingest" in spawn_names, sorted(spawn_names)
     paths = {os.path.relpath(r.path, PKG_DIR) for r in roots if r.path}
     for mod in ("serve/server.py", "serve/multi.py", "serve/client.py",
-                "serve/scenarios.py",
+                "serve/scenarios.py", "liveloop/loop.py",
                 "utils/supervision.py", "replay/tiered_store.py", "train.py"):
         assert mod in paths, f"no thread root found in {mod}"
 
