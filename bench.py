@@ -1723,6 +1723,83 @@ def breakdown_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0,
     )
 
 
+def multitask_main(
+    updates: int = 1500,
+    collect_per_update: int = 4,
+    eval_episodes: int = 16,
+    eval_horizon: int = 48,
+    seed: int = 0,
+    out_path: str = "BENCH_r13.json",
+) -> dict:
+    """Multi-task plane acceptance matrix (multitask/MultiTaskTrainer):
+    ONE task-conditioned learner over the grown env family, then a
+    PER-TASK trained-vs-seeded-random return comparison plus collection
+    frames/sec. The bar is per-task — every task must beat its own random
+    baseline; an average would let one dense-reward task mask a dead one.
+
+    CPU-budget sizing: tiny_test geometry, a small keydoor variant
+    (keydoor:4:2 — length-4 corridor, 2 colors) so the walk-right+open
+    policy is reachable in a few hundred updates without an accelerator.
+    """
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.multitask import MultiTaskTrainer
+    from r2d2_tpu.multitask.trainer import rollout_returns
+
+    tasks = ["keydoor:4:2", "drift", "banditgrid", "catch"]
+    cfg = tiny_test().replace(
+        seed=seed,
+        num_actors=16,          # 4 per task
+        batch_size=16,
+        buffer_capacity=5120,
+        learning_starts=256,
+        training_steps=updates,
+        target_net_update_interval=40,
+        lr=1e-3,                # tiny envs + tiny net: converge in minutes on CPU
+    )
+    trainer = MultiTaskTrainer(cfg, tasks)
+    t0 = time.time()
+    trainer.warmup()
+    trainer.train(updates, collect_steps_per_update=collect_per_update)
+    wall = time.time() - t0
+
+    params, _ = trainer.param_store.latest()
+    rows = []
+    for spec in trainer.specs:
+        ev_seed = 10_000 + 17 * spec.task_id  # seeded: same envs/noise both arms
+        trained = rollout_returns(
+            trainer.cfg, trainer.net, params, spec, episodes=eval_episodes,
+            horizon=eval_horizon, seed=ev_seed, policy="greedy",
+        )
+        rand = rollout_returns(
+            trainer.cfg, None, None, spec, episodes=eval_episodes,
+            horizon=eval_horizon, seed=ev_seed, policy="random",
+        )
+        frames = trainer.replays[spec.task_id].env_steps
+        rows.append({
+            "task": spec.task_id,
+            "env": spec.env_name,
+            "trained_return": float(np.mean(trained)),
+            "random_return": float(np.mean(rand)),
+            "beats_random": bool(np.mean(trained) > np.mean(rand)),
+            "frames": int(frames),
+            "frames_per_sec": float(frames / wall),
+        })
+    report = {
+        "metric": "multitask_matrix",
+        "updates": updates,
+        "eval_episodes": eval_episodes,
+        "eval_horizon": eval_horizon,
+        "wall_seconds": wall,
+        "all_beat_random": bool(all(r["beats_random"] for r in rows)),
+        "tasks": rows,
+    }
+    print(json.dumps(report))
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(report, fh, indent=2)
+    return report
+
+
 def _priority_host_ms(cfg, B: int, iters: int = 200) -> dict:
     """Host milliseconds per update spent on the priority plane, for
     priority_plane=host (numpy sum-tree sample + write-back, synchronous
@@ -1805,7 +1882,8 @@ if __name__ == "__main__":
     p.add_argument(
         "--mode", default="learner",
         choices=["learner", "system", "fused", "long_context", "serve",
-                 "recovery", "breakdown", "scenarios", "liveloop"],
+                 "recovery", "breakdown", "scenarios", "liveloop",
+                 "multitask"],
         help="learner: fused-update throughput on synthetic replay (the "
              "driver's default metric). system: concurrent on-device "
              "collection + learning via threads. fused: the same full "
@@ -1827,7 +1905,22 @@ if __name__ == "__main__":
              "(liveloop/) — served catch traffic feeds replay through the "
              "transition tap, a continuous learner trains off it, and its "
              "checkpoints hot-reload the fleet mid-run; reports return "
-             "per session over wall-clock at a fixed arrival rate.",
+             "per session over wall-clock at a fixed arrival rate. "
+             "multitask: one task-conditioned learner over the pure-JAX "
+             "env family (multitask/); per-task trained-vs-random return "
+             "matrix + frames/sec, written to BENCH_r13.json.",
+    )
+    p.add_argument(
+        "--mt-updates", type=int, default=600,
+        help="multitask mode: learner updates after warmup",
+    )
+    p.add_argument(
+        "--mt-eval-episodes", type=int, default=16,
+        help="multitask mode: eval episodes per task per arm",
+    )
+    p.add_argument(
+        "--mt-out", default="BENCH_r13.json",
+        help="multitask mode: report JSON path ('' to skip the file)",
     )
     p.add_argument(
         "--collect-every", type=int, default=6,
@@ -1964,7 +2057,13 @@ if __name__ == "__main__":
     precision = args.precision or (
         "fp32" if args.mode == "recovery" else "bf16"
     )
-    if args.mode == "recovery":
+    if args.mode == "multitask":
+        multitask_main(
+            updates=args.mt_updates,
+            eval_episodes=args.mt_eval_episodes,
+            out_path=args.mt_out,
+        )
+    elif args.mode == "recovery":
         recovery_main(precision)
     elif args.mode == "breakdown":
         breakdown_main(args.core, args.lru_chunk, args.batch, precision)

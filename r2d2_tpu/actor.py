@@ -148,6 +148,9 @@ class VectorizedActor:
         epsilons: np.ndarray,  # (E,) per-env ε (the ladder)
         push_block: Callable,  # (block, priorities, episode_reward) -> None
         seed: int = 0,
+        task_id: int = 0,              # stamped into every pushed Block
+        action_dim: Optional[int] = None,  # task's NATIVE action count
+        gamma: Optional[float] = None,     # per-task discount (Agent57)
     ):
         E = env.num_envs
         assert len(epsilons) == E
@@ -158,15 +161,23 @@ class VectorizedActor:
         self.epsilons = np.asarray(epsilons, np.float32)
         self.push_block = push_block
         self.rng = np.random.default_rng(seed)
-        self.action_dim = cfg.action_dim
+        # random exploration draws stay inside the task's native action
+        # range; greedy picks are already confined by the model's task mask
+        self.action_dim = cfg.action_dim if action_dim is None else int(action_dim)
+        self.task_id = int(task_id)
+        self.gamma = gamma
 
         # fused act tail (ops/act_tail.py): core step + dueling + ε-greedy
         # select run as ONE jitted program; the ε coin and random draws are
         # inputs so the host numpy RNG stream (and host-vs-device action
         # parity) is unchanged.
+        task_vec = (
+            jnp.full((E,), self.task_id, jnp.int32) if cfg.num_tasks > 1 else None
+        )
         self._policy = jax.jit(
             lambda params, obs, la, lr, carry, explore, rand_a: net.apply(
-                params, obs, la, lr, carry, explore, rand_a, method=net.act_select
+                params, obs, la, lr, carry, explore, rand_a,
+                task=task_vec, method=net.act_select,
             )
         )
         self.params, self.param_version = param_store.latest()
@@ -181,7 +192,10 @@ class VectorizedActor:
         __init__ and resync so restart recovery can never miss a field."""
         cfg = self.cfg
         E = self.env.num_envs
-        self.accs: List[SequenceAccumulator] = [SequenceAccumulator(cfg) for _ in range(E)]
+        self.accs: List[SequenceAccumulator] = [
+            SequenceAccumulator(cfg, task_id=self.task_id, gamma=self.gamma)
+            for _ in range(E)
+        ]
         for i in range(E):
             self.accs[i].reset(obs[i])
         self.obs = obs

@@ -113,6 +113,38 @@ def train_step_jaxpr(precision: str) -> str:
     return str(jax.make_jaxpr(step)(state, _stacked_batch_struct(precision, _NUM_STEPS)))
 
 
+def _multitask_cfg(precision: str):
+    """The multi-task trace config: 2 tasks over a union action space, so
+    the task leaf exists in the batch and the head carries the one-hot
+    task conditioning + per-task action masking."""
+    return _cfg(precision).replace(
+        num_tasks=2,
+        action_dim=5,
+        multitask_envs=("drift", "banditgrid"),
+        task_action_dims=(3, 5),
+        task_gammas=(0.997, 0.99),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def multitask_train_step_jaxpr(precision: str) -> str:
+    """Jaxpr text of the TASK-CONDITIONED stacked train step (num_tasks >
+    1): the multi-task plane's learner entry point — same _raw_train_step
+    body as the golden path plus the (K, B) task leaf driving the one-hot
+    head widening and the per-task valid-action mask."""
+    import jax
+
+    from r2d2_tpu.learner import init_train_state, make_stacked_batch_train_step
+
+    cfg = _multitask_cfg(precision)
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    step = make_stacked_batch_train_step(cfg, net, _NUM_STEPS, donate=False)
+    batch = _stacked_batch_struct(precision, _NUM_STEPS)._replace(
+        task=jax.ShapeDtypeStruct((_NUM_STEPS, cfg.batch_size), np.int32)
+    )
+    return str(jax.make_jaxpr(step)(state, batch))
+
+
 @functools.lru_cache(maxsize=None)
 def resharded_train_step_jaxpr(precision: str, dp: int = 2) -> str:
     """Jaxpr text of the sharded fused train step traced on a RESHARD-
@@ -711,6 +743,22 @@ def _check_train_outputs(precision: str) -> List[Finding]:
     return out
 
 
+def scan_multitask_train_step(precision: str) -> List[Finding]:
+    """The task-conditioned train step (num_tasks > 1) under the same
+    dtype contracts as the golden path: no f64, fp32 path bf16-free, bf16
+    path keeps its fp32 islands, no host callbacks. The task one-hot and
+    the valid-action mask must not smuggle in a wider dtype."""
+    label = f"multitask_train_step[{precision}]"
+    text = multitask_train_step_jaxpr(precision)
+    out = check_no_float64(text, label)
+    out += check_no_host_callback(text, label)
+    if precision == "fp32":
+        out += check_no_bf16(text, label)
+    else:
+        out += check_fp32_island(text, label)
+    return out
+
+
 def scan_resharded_train_step(precision: str, dp: int = 2) -> List[Finding]:
     """The train step on a resharded mesh shape: a regression visible only
     under the post-resume partitioning (a float64 creeping into the
@@ -1033,6 +1081,7 @@ def scan_entry_points(
     out: List[Finding] = []
     for p in precisions:
         out += scan_train_step(p)
+        out += scan_multitask_train_step(p)
         out += scan_resharded_train_step(p)
         out += scan_act(p)
         out += scan_act_select(p)

@@ -110,17 +110,22 @@ def initial_carry(cfg: R2D2Config, fn_env, num_envs: int, key) -> CollectCarry:
 
 def make_collect_fn(
     cfg: R2D2Config, net: R2D2Network, fn_env, num_envs: int, chunk_len: int,
-    carry_episodes: bool = False,
+    carry_episodes: bool = False, task_id: int = 0,
+    action_dim: Optional[int] = None, gamma: Optional[float] = None,
 ):
     """Jitted chunk collector (see make_collect_core for the contract)."""
     return jax.jit(
-        make_collect_core(cfg, net, fn_env, num_envs, chunk_len, carry_episodes)
+        make_collect_core(
+            cfg, net, fn_env, num_envs, chunk_len, carry_episodes,
+            task_id=task_id, action_dim=action_dim, gamma=gamma,
+        )
     )
 
 
 def make_collect_core(
     cfg: R2D2Config, net: R2D2Network, fn_env, num_envs: int, chunk_len: int,
-    carry_episodes: bool = False,
+    carry_episodes: bool = False, task_id: int = 0,
+    action_dim: Optional[int] = None, gamma: Optional[float] = None,
 ):
     """Build the (un-jitted) chunk collector — jit it directly
     (make_collect_fn) or compose it into a larger dispatch
@@ -142,12 +147,25 @@ def make_collect_core(
     end continue their episode next chunk (carried env/recurrent state),
     finished/idle slots restart fresh, and ep_rewards holds FULL episode
     returns (prefix + chunk), meaningful where dones is set.
+
+    Multi-task plane: task_id stamps every packed block's per-sequence
+    task field (present only when cfg.num_tasks > 1) and conditions the
+    policy; action_dim narrows RANDOM exploration draws to the task's
+    native action count (greedy picks stay safe because the task mask in
+    models/r2d2.py floors padded actions); gamma overrides cfg.gamma for
+    this task's stored n-step returns (Agent57-style per-task discount).
     """
     E, T = num_envs, chunk_len
     L, Bn, n = cfg.learning_steps, cfg.burn_in_steps, cfg.forward_steps
     S, bl, slot = cfg.seqs_per_block, cfg.block_length, cfg.block_slot_len
-    H, A = cfg.hidden_dim, cfg.action_dim
-    gamma, eps_h = cfg.gamma, cfg.value_rescale_eps
+    H = cfg.hidden_dim
+    A = cfg.action_dim if action_dim is None else int(action_dim)
+    gamma = cfg.gamma if gamma is None else float(gamma)
+    eps_h = cfg.value_rescale_eps
+    # (E,) task conditioning vector for the policy; None on the golden path
+    task_vec = (
+        jnp.full((E,), int(task_id), jnp.int32) if cfg.num_tasks > 1 else None
+    )
     if not (0 < T <= bl):
         raise ValueError(f"chunk_len {T} must be in (0, block_length={bl}]")
 
@@ -256,6 +274,9 @@ def make_collect_core(
             "learning": learn.astype(jnp.int32),
             "forward": fwd.astype(jnp.int32),
         }
+        if cfg.num_tasks > 1:
+            # per-sequence task ids, lockstep with store_field_specs
+            fields["task"] = jnp.full((S,), int(task_id), jnp.int32)
         return fields, prios, num_seq.astype(jnp.int32)
 
     def collect(params, env_state, epsilons, key):
@@ -279,7 +300,8 @@ def make_collect_core(
             # fused act tail (ops/act_tail.py): same math as the former
             # argmax/where pair, selection fused with the core step
             q, act, (h2, c2) = net.apply(
-                params, obs, la, lr, (h, c), explore, rand_a, method=net.act_select
+                params, obs, la, lr, (h, c), explore, rand_a,
+                task=task_vec, method=net.act_select,
             )
             # scan carry stays f32 regardless of compute dtype (bf16->f32
             # is exact, and act re-casts on use — same values as the host
@@ -312,7 +334,9 @@ def make_collect_core(
         (env_f, h_f, c_f, la_f, lr_f, alive_f), rec = jax.lax.scan(body, init, keys[:T])
 
         final_obs = vrender(env_f)
-        q_final, _ = net.apply(params, final_obs, la_f, lr_f, (h_f, c_f), method=net.act)
+        q_final, _ = net.apply(
+            params, final_obs, la_f, lr_f, (h_f, c_f), task=task_vec, method=net.act
+        )
 
         sizes = jnp.sum(rec["applied"].astype(jnp.int32), axis=0)  # (E,)
         dones = jnp.any(rec["done"], axis=0)
@@ -388,6 +412,9 @@ class DeviceCollector:
         epsilons: Optional[np.ndarray] = None,
         seed: int = 0,
         chunk_len: Optional[int] = None,
+        task_id: int = 0,
+        action_dim: Optional[int] = None,
+        gamma: Optional[float] = None,
     ):
         E = cfg.num_actors
         self.cfg = cfg
@@ -410,7 +437,8 @@ class DeviceCollector:
         assert len(eps) == E
         self.epsilons = jnp.asarray(eps, jnp.float32)
         self._collect = make_collect_fn(
-            cfg, net, fn_env, E, self.chunk, carry_episodes=self.carry_episodes
+            cfg, net, fn_env, E, self.chunk, carry_episodes=self.carry_episodes,
+            task_id=task_id, action_dim=action_dim, gamma=gamma,
         )
         self.key = jax.random.PRNGKey(seed)
         kr, self.key = jax.random.split(self.key)

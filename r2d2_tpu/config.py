@@ -48,6 +48,26 @@ class R2D2Config:
     gamma: float = 0.997  # reference config.py:11
     value_rescale_eps: float = 1e-3  # reference worker.py:455
 
+    # --- multi-task plane (multitask/, ROADMAP item 2) -------------------
+    # num_tasks = 1 keeps every golden path bit-exact: no task field in
+    # replay, no task input to the network, no head widening. > 1 turns on
+    # the task-conditioned dueling head (one-hot task embedding into the
+    # heads), the per-block task stamp through replay, and the task pass
+    # through the train step — Agent57-style one-learner-many-tasks
+    # (Badia et al. 2020) over the pure-JAX env family.
+    num_tasks: int = 1
+    # env name per task id (the registry order); empty outside multi-task
+    multitask_envs: Tuple[str, ...] = ()
+    # native action count per task. action_dim is the UNION width; tasks
+    # with fewer actions get their invalid tail masked out of the dueling
+    # head (argmax and bootstrap max can never pick them). Empty = every
+    # task uses the full union.
+    task_action_dims: Tuple[int, ...] = ()
+    # per-task discount ladder (Agent57's gamma ladder). Empty = cfg.gamma
+    # for every task. Discounts travel through the STORED per-step gamma
+    # field, so only collection reads this — the learner is unchanged.
+    task_gammas: Tuple[float, ...] = ()
+
     # --- prioritized replay ----------------------------------------------
     prio_exponent: float = 0.9  # alpha, reference config.py:12
     is_exponent: float = 0.6  # beta, reference config.py:13
@@ -399,6 +419,68 @@ class R2D2Config:
         with the carry at worker.py:640-647)."""
         return self.block_length + self.burn_in_steps + 1
 
+    def _validate_env_geometry(self, env_name: str, obs_shape) -> None:
+        """Episode-cap/obs-shape sanity for every name-parameterized
+        functional family (catch, keydoor, drift, banditgrid). Unknown
+        names (atari, scripted, procmaze — the latter validates in its own
+        geometry builder) pass through."""
+        from r2d2_tpu.envs.catch import catch_params, is_catch_name
+
+        if is_catch_name(env_name):
+            p = catch_params(env_name)
+            need = (
+                (obs_shape[0] - 2)
+                * p.get("fall_every", 1)
+                * p.get("balls", 1)
+            )
+            if self.max_episode_steps < need:
+                raise ValueError(
+                    f"max_episode_steps={self.max_episode_steps} truncates "
+                    f"{env_name!r} at obs {obs_shape} before the "
+                    f"last ball lands (needs >= {need}): every episode "
+                    "would end reward-free"
+                )
+            return
+        from r2d2_tpu.envs.banditgrid import banditgrid_params, is_banditgrid_name
+        from r2d2_tpu.envs.drift import drift_params, is_drift_name
+        from r2d2_tpu.envs.keydoor import keydoor_params, is_keydoor_name
+
+        if is_keydoor_name(env_name):
+            p = keydoor_params(env_name)
+            if self.max_episode_steps < p["length"]:
+                raise ValueError(
+                    f"max_episode_steps={self.max_episode_steps} ends "
+                    f"{env_name!r} before the door (corridor length "
+                    f"{p['length']}) is reachable: every episode would "
+                    "end reward-free"
+                )
+            if obs_shape[0] < 3 or obs_shape[1] < max(p["length"], p["num_colors"]):
+                raise ValueError(
+                    f"obs {obs_shape} cannot render {env_name!r}: needs "
+                    f"height >= 3 and width >= "
+                    f"{max(p['length'], p['num_colors'])} (corridor + cue row)"
+                )
+        elif is_drift_name(env_name):
+            drift_params(env_name)  # value errors on bad :EVERY suffixes
+            if obs_shape[0] < 2 or obs_shape[1] < 3:
+                raise ValueError(
+                    f"obs {obs_shape} cannot render {env_name!r}: needs "
+                    "height >= 2 (target + agent rows) and width >= 3"
+                )
+        elif is_banditgrid_name(env_name):
+            p = banditgrid_params(env_name)
+            if obs_shape[0] < p["grid"] or obs_shape[1] < p["grid"]:
+                raise ValueError(
+                    f"obs {obs_shape} cannot render {env_name!r}: the "
+                    f"{p['grid']}x{p['grid']} arm grid needs height and "
+                    "width >= grid"
+                )
+            if self.max_episode_steps < 2:
+                raise ValueError(
+                    f"max_episode_steps={self.max_episode_steps} gives "
+                    f"{env_name!r} no post-move payout step"
+                )
+
     def validate(self) -> "R2D2Config":
         if self.block_length % self.learning_steps != 0:
             raise ValueError("block_length must be a multiple of learning_steps")
@@ -492,28 +574,51 @@ class R2D2Config:
                 "lstm_backend='scan' (or 'auto', which resolves to scan "
                 "there)"
             )
-        # catch-family geometry: an episode cap shorter than the last
-        # ball's landing means NO reward signal ever fires — training
-        # proceeds silently on zeros (found via the long_context
-        # obs_shape re-target, round 5). Deferred import: envs.catch
-        # pulls jax; config stays import-light until first validate.
+        # Functional-family geometry guards: an episode cap shorter than
+        # the env's first possible reward means NO signal ever fires —
+        # training proceeds silently on zeros (found via the long_context
+        # obs_shape re-target, round 5, for catch; the same silent failure
+        # class exists for every name-parameterized family, so each gets
+        # its own episode-cap/obs-shape sanity check here instead of
+        # silently skipping validation). Deferred import: the env modules
+        # pull jax; config stays import-light until first validate.
         if self.env_name:
-            from r2d2_tpu.envs.catch import catch_params, is_catch_name
-
-            if is_catch_name(self.env_name):
-                p = catch_params(self.env_name)
-                need = (
-                    (self.obs_shape[0] - 2)
-                    * p.get("fall_every", 1)
-                    * p.get("balls", 1)
+            self._validate_env_geometry(self.env_name, self.obs_shape)
+        for i, task_env in enumerate(self.multitask_envs):
+            # per-task envs render into the union obs canvas, so each must
+            # pass the same geometry checks against the shared obs_shape
+            try:
+                self._validate_env_geometry(task_env, self.obs_shape)
+            except ValueError as e:
+                raise ValueError(f"multitask_envs[{i}]: {e}") from e
+        if self.num_tasks < 1:
+            raise ValueError("num_tasks must be >= 1")
+        if self.multitask_envs and len(self.multitask_envs) != self.num_tasks:
+            raise ValueError(
+                f"multitask_envs names {len(self.multitask_envs)} envs for "
+                f"num_tasks={self.num_tasks}; one env name per task id"
+            )
+        if self.task_action_dims:
+            if len(self.task_action_dims) != self.num_tasks:
+                raise ValueError(
+                    f"task_action_dims has {len(self.task_action_dims)} "
+                    f"entries for num_tasks={self.num_tasks}"
                 )
-                if self.max_episode_steps < need:
+            for i, a in enumerate(self.task_action_dims):
+                if not 1 <= a <= self.action_dim:
                     raise ValueError(
-                        f"max_episode_steps={self.max_episode_steps} truncates "
-                        f"{self.env_name!r} at obs {self.obs_shape} before the "
-                        f"last ball lands (needs >= {need}): every episode "
-                        "would end reward-free"
+                        f"task_action_dims[{i}]={a} outside [1, action_dim="
+                        f"{self.action_dim}] — action_dim is the union width"
                     )
+        if self.task_gammas:
+            if len(self.task_gammas) != self.num_tasks:
+                raise ValueError(
+                    f"task_gammas has {len(self.task_gammas)} entries for "
+                    f"num_tasks={self.num_tasks}"
+                )
+            for i, g in enumerate(self.task_gammas):
+                if not 0.0 < g < 1.0:
+                    raise ValueError(f"task_gammas[{i}]={g} outside (0, 1)")
         if self.replay_plane not in (
             "host", "tiered", "device", "sharded", "multihost"
         ):

@@ -9,6 +9,11 @@ Three env families, one host-facing protocol (reset/step over numpy):
   exercised end-to-end on TPU with no emulator on the host.
 - `fake`: a deterministic scripted env giving exact expected values for
   n-step/terminal math in tests (SURVEY.md section 4 'fake backends').
+
+The multi-task family (ROADMAP item 2) adds three more pure-JAX cores with
+deliberately different structure — `keydoor` (partially observable memory
+probe), `drift` (continuing, no terminals), `banditgrid` (high-variance
+stochastic rewards) — all through the same functional protocol.
 """
 
 from r2d2_tpu.envs.fake import ScriptedEnv
@@ -20,9 +25,19 @@ from r2d2_tpu.envs.catch import (
     catch_params,
     is_catch_name,
 )
+from r2d2_tpu.envs.banditgrid import banditgrid_params, is_banditgrid_name
+from r2d2_tpu.envs.drift import drift_params, is_drift_name
+from r2d2_tpu.envs.keydoor import is_keydoor_name, keydoor_params
 from r2d2_tpu.envs.procmaze import is_procmaze_name, procmaze_params
 
 __all__ = ["ScriptedEnv", "CatchEnv", "CatchHostEnv", "CatchVecEnv", "make_env"]
+
+
+def is_multitask_family_name(name: str) -> bool:
+    """True for the pure-JAX multi-task family cores added by ROADMAP
+    item 2 (keydoor/drift/banditgrid) — the names routed through
+    envs/functional.FnHostEnv below and build_fn_env's functional path."""
+    return is_keydoor_name(name) or is_drift_name(name) or is_banditgrid_name(name)
 
 
 def make_env(cfg, seed: int = 0):
@@ -52,6 +67,29 @@ def make_env(cfg, seed: int = 0):
             cfg.obs_shape, cfg.max_episode_steps, grid=params.pop("grid", None)
         )
         return FnHostEnv(ProcMazeEnv, (grid, cell, horizon), seed=seed, kwargs=params)
+    if is_multitask_family_name(name):
+        from r2d2_tpu.envs.banditgrid import BanditGridEnv
+        from r2d2_tpu.envs.drift import DriftEnv
+        from r2d2_tpu.envs.functional import FnHostEnv
+        from r2d2_tpu.envs.keydoor import KeyDoorEnv
+
+        # FnHostEnv's (class, args, kwargs) form so the jitted fns cache
+        # across a pool of N host envs (same reason as procmaze above);
+        # kwargs mirror each family's build_*_env factory exactly
+        h, w = cfg.obs_shape[0], cfg.obs_shape[1]
+        if is_keydoor_name(name):
+            p = keydoor_params(name)
+            p["horizon"] = min(cfg.max_episode_steps, 4 * p["length"] + 4)
+            return FnHostEnv(KeyDoorEnv, (h, w), seed=seed, kwargs=p)
+        if is_drift_name(name):
+            return FnHostEnv(DriftEnv, (h, w), seed=seed, kwargs=drift_params(name))
+        p = banditgrid_params(name)
+        return FnHostEnv(
+            BanditGridEnv, (h, w), seed=seed,
+            kwargs=dict(
+                grid=p["grid"], horizon=min(cfg.max_episode_steps, p["horizon"])
+            ),
+        )
     if name == "scripted" or name.startswith("scripted:"):
         # "scripted:A" pins the action space independently of cfg — gives
         # the sweep tests per-game action_dim diversity without ALE

@@ -1,0 +1,139 @@
+"""BanditGrid — a stochastic-reward grid of noisy arms (pure JAX).
+
+The reward-variance probe of the multi-task family: the agent walks a g x g
+grid whose cells pay out like bandit arms — a FIXED mean surface (rising
+toward the far corner) plus fresh Gaussian noise every step. The optimal
+policy is trivial spatially (walk to the high corner and sit), but the
+return signal is buried in per-step noise whose sigma rivals the mean
+spread, so TD errors stay large and noisy long after the policy is right.
+That is exactly the load profile that stresses prioritized replay (PR 9's
+device priority plane): priorities driven by reward noise rather than by
+learnable error must not starve the rest of the buffer.
+
+Same functional protocol as envs/catch.py (reset/step/render + NUM_ACTIONS).
+Actions: 0 NOOP, 1 up, 2 down, 3 left, 4 right (procmaze's convention);
+out-of-range actions (a padded multi-task union action space) degrade to
+NOOP.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+BANDITGRID_DEFAULTS = dict(grid=4, horizon=16)
+BANDIT_NOISE_SIGMA = 0.5
+
+
+def banditgrid_params(name: str) -> dict:
+    """Variant parameters encoded in an env name: 'banditgrid[:G[:H]]'
+    (grid side, episode horizon). Raises on non-banditgrid names (gate on
+    is_banditgrid_name) and degenerate values."""
+    n = name.lower()
+    base, _, suffix = n.partition(":")
+    if base != "banditgrid":
+        raise ValueError(f"not a banditgrid family env name: {name!r}")
+    out = dict(BANDITGRID_DEFAULTS)
+    if suffix:
+        parts = suffix.split(":")
+        if len(parts) > 2:
+            raise ValueError(f"banditgrid takes at most :G:H, got {name!r}")
+        for k, v in zip(("grid", "horizon"), parts):
+            out[k] = int(v)
+    if out["grid"] < 2:
+        raise ValueError(f"banditgrid grid must be >= 2, got {out['grid']}")
+    if out["horizon"] < 2:
+        raise ValueError(f"banditgrid horizon must be >= 2, got {out['horizon']}")
+    return out
+
+
+def is_banditgrid_name(name: str) -> bool:
+    return name.lower().partition(":")[0] == "banditgrid"
+
+
+def build_banditgrid_env(obs_shape, max_episode_steps: int, name: str) -> "BanditGridEnv":
+    """ONE factory for every 'banditgrid[:G[:H]]' name; the name-encoded
+    horizon is capped by the config's episode budget."""
+    p = banditgrid_params(name)
+    h, w, c = obs_shape
+    return BanditGridEnv(
+        height=h, width=w, grid=p["grid"],
+        horizon=min(max_episode_steps, p["horizon"]),
+    )
+
+
+class BanditGridState(NamedTuple):
+    pos: jnp.ndarray  # (2,) int32 row, col
+    t: jnp.ndarray    # int32 step counter
+    key: jnp.ndarray  # PRNG key (consumed every step by the payout draw)
+
+
+class BanditGridEnv:
+    """Functional single-env core; every method is jit/vmap-safe."""
+
+    NUM_ACTIONS = 5  # 0 = NOOP, 1 = up, 2 = down, 3 = left, 4 = right
+
+    def __init__(
+        self,
+        height: int = 6,
+        width: int = 6,
+        grid: int = 4,
+        horizon: int = 16,
+        noise: float = BANDIT_NOISE_SIGMA,
+    ):
+        if grid < 2:
+            raise ValueError(f"banditgrid grid must be >= 2, got {grid}")
+        if height < grid or width < grid:
+            raise ValueError(
+                f"banditgrid obs canvas {height}x{width} cannot render a "
+                f"{grid}x{grid} grid"
+            )
+        if horizon < 2:
+            raise ValueError(f"banditgrid horizon must be >= 2, got {horizon}")
+        self.h, self.w = height, width
+        self.g = grid
+        self.horizon = horizon
+        self.noise = noise
+
+    def _means(self) -> jnp.ndarray:
+        """(g, g) f32 arm means in [0, 1], rising toward (g-1, g-1)."""
+        idx = jnp.arange(self.g, dtype=jnp.float32)
+        return (idx[:, None] + idx[None, :]) / (2.0 * (self.g - 1))
+
+    def reset(self, key: jax.Array) -> BanditGridState:
+        # fixed start at the LOW corner: the mean gradient must be climbed,
+        # not spawned onto
+        return BanditGridState(
+            jnp.zeros((2,), jnp.int32), jnp.zeros((), jnp.int32), key
+        )
+
+    def render(self, s: BanditGridState) -> jnp.ndarray:
+        """(H, W, 1) uint8: the static mean surface at half intensity
+        (payout structure is observable — the hard part is the noise, not
+        hidden state) with the agent cell at 255."""
+        ys = jnp.arange(self.h)[:, None]
+        xs = jnp.arange(self.w)[None, :]
+        in_grid = (ys < self.g) & (xs < self.g)
+        means = jnp.zeros((self.h, self.w), jnp.float32)
+        means = means.at[: self.g, : self.g].set(self._means())
+        surface = jnp.where(in_grid, means * 128.0, 0.0)
+        agent = (ys == s.pos[0]) & (xs == s.pos[1])
+        frame = jnp.where(agent, 255.0, surface).astype(jnp.uint8)
+        return frame[:, :, None]
+
+    def step(self, s: BanditGridState, action: jnp.ndarray):
+        """Returns (state', reward, done): reward = mean(cell') + noise,
+        terminal at the horizon."""
+        dr = jnp.where(action == 1, -1, jnp.where(action == 2, 1, 0))
+        dc = jnp.where(action == 3, -1, jnp.where(action == 4, 1, 0))
+        pos = jnp.clip(
+            s.pos + jnp.stack([dr, dc]), 0, self.g - 1
+        ).astype(jnp.int32)
+        t = s.t + 1
+        key, kn = jax.random.split(s.key)
+        mu = self._means()[pos[0], pos[1]]
+        reward = mu + self.noise * jax.random.normal(kn)
+        done = t >= self.horizon
+        return BanditGridState(pos, t, key), reward, done

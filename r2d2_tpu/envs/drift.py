@@ -1,0 +1,108 @@
+"""Drift — a continuing (non-episodic) target-tracking env (pure JAX).
+
+The no-terminal probe of the multi-task family: a target random-walks
+along a 1-D strip and the agent is paid +1 for every step it sits on the
+target. `step` NEVER returns done=True, so every downstream seam that
+episodic envs exercise only at episode ends runs here in its steady state:
+the accumulator's mid-episode block cuts with bootstrap Q
+(replay/accumulator.py finish(last_qval)), the burn-in tail carried across
+every block boundary, and the vec adapters' auto-reset path (traced but
+never taken). R2D2's stored-state + burn-in recipe was built exactly for
+this regime — there is no episode start to re-zero the carry at.
+
+Same functional protocol as envs/catch.py (reset/step/render + NUM_ACTIONS).
+Actions: 0 NOOP, 1 left, 2 right; out-of-range actions (a padded
+multi-task union action space) degrade to NOOP.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+DRIFT_DEFAULTS = dict(drift_every=2)
+
+
+def drift_params(name: str) -> dict:
+    """Variant parameters encoded in an env name: 'drift[:EVERY]' (the
+    target moves one cell every EVERY steps; 1 = every step, the hardest
+    tracking cadence). Raises on non-drift names (gate on is_drift_name)."""
+    n = name.lower()
+    base, _, suffix = n.partition(":")
+    if base != "drift":
+        raise ValueError(f"not a drift family env name: {name!r}")
+    out = dict(DRIFT_DEFAULTS)
+    if suffix:
+        out["drift_every"] = int(suffix)
+    if out["drift_every"] < 1:
+        raise ValueError(f"drift_every must be >= 1, got {out['drift_every']}")
+    return out
+
+
+def is_drift_name(name: str) -> bool:
+    return name.lower().partition(":")[0] == "drift"
+
+
+def build_drift_env(obs_shape, max_episode_steps: int, name: str) -> "DriftEnv":
+    """ONE factory for every 'drift[:EVERY]' name. max_episode_steps is
+    accepted for factory-signature parity but unused: the env is
+    continuing by construction — truncation is the caller's policy
+    (actor max_episode_steps, eval fixed horizons), never the env's."""
+    p = drift_params(name)
+    h, w, c = obs_shape
+    return DriftEnv(height=h, width=w, **p)
+
+
+class DriftState(NamedTuple):
+    pos: jnp.ndarray     # int32 agent cell in [0, width)
+    target: jnp.ndarray  # int32 target cell in [0, width)
+    t: jnp.ndarray       # int32 step counter (drives the drift cadence)
+    key: jnp.ndarray     # PRNG key (consumed every step by the drift draw)
+
+
+class DriftEnv:
+    """Functional single-env core; every method is jit/vmap-safe."""
+
+    NUM_ACTIONS = 3  # 0 = NOOP, 1 = left, 2 = right
+
+    def __init__(self, height: int = 4, width: int = 10, drift_every: int = 2):
+        if height < 2:
+            raise ValueError(f"drift needs height >= 2 (target + agent rows), got {height}")
+        if width < 3:
+            raise ValueError(f"drift needs width >= 3 (room to track), got {width}")
+        if drift_every < 1:
+            raise ValueError(f"drift_every must be >= 1, got {drift_every}")
+        self.h, self.w = height, width
+        self.every = drift_every
+
+    def reset(self, key: jax.Array) -> DriftState:
+        key, kp, kt = jax.random.split(key, 3)
+        pos = jax.random.randint(kp, (), 0, self.w)
+        target = jax.random.randint(kt, (), 0, self.w)
+        return DriftState(pos, target, jnp.zeros((), jnp.int32), key)
+
+    def render(self, s: DriftState) -> jnp.ndarray:
+        """(H, W, 1) uint8: row 0 is the target, row 1 the agent — both
+        fully observable; the task is control, not memory."""
+        ys = jnp.arange(self.h)[:, None]
+        xs = jnp.arange(self.w)[None, :]
+        target = (ys == 0) & (xs == s.target)
+        agent = (ys == 1) & (xs == s.pos)
+        frame = jnp.where(target | agent, 255, 0).astype(jnp.uint8)
+        return frame[:, :, None]
+
+    def step(self, s: DriftState, action: jnp.ndarray):
+        """Returns (state', reward, done) with done ALWAYS False — the
+        continuing-env invariant the multi-task tests pin."""
+        dx = jnp.where(action == 1, -1, jnp.where(action == 2, 1, 0))
+        pos = jnp.clip(s.pos + dx, 0, self.w - 1)
+        t = s.t + 1
+        key, kd = jax.random.split(s.key)
+        move = jax.random.randint(kd, (), -1, 2)  # {-1, 0, +1}
+        delta = jnp.where(t % self.every == 0, move, 0)
+        target = jnp.clip(s.target + delta, 0, self.w - 1)
+        reward = jnp.where(pos == target, 1.0, 0.0)
+        done = jnp.zeros((), bool)
+        return DriftState(pos, target, t, key), reward, done

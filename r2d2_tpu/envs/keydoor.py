@@ -1,0 +1,152 @@
+"""KeyDoor — a partially-observable keyed-door corridor (pure JAX).
+
+The memory probe of the multi-task family (ROADMAP item 2): a key color is
+rendered for only the first `cue_steps` observations of the episode; the
+agent then walks a corridor and, at the door cell on the far end, must pick
+the open-action matching the remembered color. The cue-to-door gap is the
+whole corridor, so the recurrent carry — not the frame — has to transport
+the color. This is the same stress as catch's memory variant (envs/catch.py
+cue_steps) but with a DISCRETE recall decision at the end instead of a
+continuous tracking one, which makes partial credit impossible: a policy
+that forgets the color caps at 1/num_colors of the achievable return.
+
+Same functional protocol as envs/catch.py (reset/step/render + NUM_ACTIONS),
+so the host pool, vectorized actor, on-device collector, and evaluator all
+compose unchanged. Action space: 0 NOOP, 1 left, 2 right, 3+c open-with-
+color-c at the door (opens elsewhere are NOOPs — out-of-range actions from
+a padded multi-task union action space degrade to NOOP, never crash).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+KEYDOOR_DEFAULTS = dict(length=6, num_colors=2, cue_steps=1)
+
+
+def keydoor_params(name: str) -> dict:
+    """Variant parameters encoded in an env name: 'keydoor[:L[:C[:CUE]]]'
+    (corridor length, key colors, cue steps). Raises on non-keydoor names
+    (gate on is_keydoor_name) and on degenerate values."""
+    n = name.lower()
+    base, _, suffix = n.partition(":")
+    if base != "keydoor":
+        raise ValueError(f"not a keydoor family env name: {name!r}")
+    out = dict(KEYDOOR_DEFAULTS)
+    if suffix:
+        parts = suffix.split(":")
+        if len(parts) > 3:
+            raise ValueError(f"keydoor takes at most :L:C:CUE, got {name!r}")
+        keys = ("length", "num_colors", "cue_steps")
+        for k, v in zip(keys, parts):
+            out[k] = int(v)
+    if out["length"] < 2:
+        raise ValueError(f"keydoor length must be >= 2, got {out['length']}")
+    if out["num_colors"] < 2:
+        raise ValueError(
+            f"keydoor num_colors must be >= 2 (1 color has no memory "
+            f"demand), got {out['num_colors']}"
+        )
+    if out["cue_steps"] < 1:
+        raise ValueError(f"keydoor cue_steps must be >= 1, got {out['cue_steps']}")
+    return out
+
+
+def is_keydoor_name(name: str) -> bool:
+    return name.lower().partition(":")[0] == "keydoor"
+
+
+def build_keydoor_env(obs_shape, max_episode_steps: int, name: str) -> "KeyDoorEnv":
+    """ONE factory for every 'keydoor[:L[:C[:CUE]]]' name (the same
+    single-factory rule as envs/procmaze.build_procmaze_env). The episode
+    horizon is 4*length + 4 (enough slack for an exploring policy to reach
+    the door) capped by the config's episode budget."""
+    p = keydoor_params(name)
+    h, w, c = obs_shape
+    horizon = min(max_episode_steps, 4 * p["length"] + 4)
+    return KeyDoorEnv(height=h, width=w, horizon=horizon, **p)
+
+
+class KeyDoorState(NamedTuple):
+    pos: jnp.ndarray    # int32 corridor cell in [0, length)
+    color: jnp.ndarray  # int32 key color in [0, num_colors)
+    t: jnp.ndarray      # int32 step counter (drives the cue window)
+    key: jnp.ndarray    # PRNG key (auto-reset contract, envs/functional.py)
+
+
+class KeyDoorEnv:
+    """Functional single-env core; every method is jit/vmap-safe."""
+
+    # 0 NOOP, 1 left, 2 right, then one open-action per color
+    NUM_ACTIONS = 3 + KEYDOOR_DEFAULTS["num_colors"]
+
+    def __init__(
+        self,
+        height: int = 8,
+        width: int = 8,
+        length: int = 6,
+        num_colors: int = 2,
+        cue_steps: int = 1,
+        horizon: int = 28,
+    ):
+        if length < 2 or num_colors < 2 or cue_steps < 1:
+            raise ValueError(
+                f"degenerate keydoor geometry: length={length}, "
+                f"num_colors={num_colors}, cue_steps={cue_steps}"
+            )
+        if width < max(length, num_colors):
+            raise ValueError(
+                f"keydoor width {width} cannot render the corridor "
+                f"(length {length}) and the cue row ({num_colors} colors)"
+            )
+        if height < 3:
+            raise ValueError(f"keydoor needs height >= 3, got {height}")
+        if horizon < length:
+            raise ValueError(
+                f"keydoor horizon {horizon} ends before the door "
+                f"(corridor length {length}) is reachable: every episode "
+                "would end reward-free"
+            )
+        self.h, self.w = height, width
+        self.length = length
+        self.colors = num_colors
+        self.cue = cue_steps
+        self.horizon = horizon
+        # instance attr (not the class default) so the union action space
+        # of a multi-color variant is visible to the adapters
+        self.NUM_ACTIONS = 3 + num_colors
+
+    def reset(self, key: jax.Array) -> KeyDoorState:
+        key, kc = jax.random.split(key)
+        color = jax.random.randint(kc, (), 0, self.colors)
+        zero = jnp.zeros((), jnp.int32)
+        return KeyDoorState(zero, color, zero, key)
+
+    def render(self, s: KeyDoorState) -> jnp.ndarray:
+        """(H, W, 1) uint8: row 0 flashes the key color (column = color
+        index, only while t < cue_steps); row 1 is the agent's corridor
+        position; the bottom row marks the door cell — a static landmark
+        so 'where is the door' never needs memory, only 'which color'."""
+        ys = jnp.arange(self.h)[:, None]
+        xs = jnp.arange(self.w)[None, :]
+        cue = (ys == 0) & (xs == s.color) & (s.t < self.cue)
+        agent = (ys == 1) & (xs == s.pos)
+        door = (ys == self.h - 1) & (xs == self.length - 1)
+        frame = jnp.where(cue | agent | door, 255, 0).astype(jnp.uint8)
+        return frame[:, :, None]
+
+    def step(self, s: KeyDoorState, action: jnp.ndarray):
+        """Returns (state', reward, done). Terminal on any open-action at
+        the door (+1 iff the color matches) or at the horizon."""
+        dx = jnp.where(action == 1, -1, jnp.where(action == 2, 1, 0))
+        pos = jnp.clip(s.pos + dx, 0, self.length - 1)
+        t = s.t + 1
+        at_door = s.pos == self.length - 1
+        opening = at_door & (action >= 3)
+        matched = opening & (action - 3 == s.color)
+        done = opening | (t >= self.horizon)
+        reward = jnp.where(matched, 1.0, 0.0)
+        return KeyDoorState(pos, s.color, t, s.key), reward, done

@@ -51,7 +51,11 @@ class TrainState(struct.PyTreeNode):
 
 
 class DeviceBatch(NamedTuple):
-    """The device-side view of a SampledBatch (jnp arrays)."""
+    """The device-side view of a SampledBatch (jnp arrays).
+
+    `task` is the multi-task plane's per-sequence task id (B,) int32; it
+    defaults to None so every single-task constructor, pytree, and
+    donation contract is unchanged (a None leaf is absent from the tree)."""
 
     obs: jnp.ndarray
     last_action: jnp.ndarray
@@ -64,6 +68,7 @@ class DeviceBatch(NamedTuple):
     learning_steps: jnp.ndarray
     forward_steps: jnp.ndarray
     is_weights: jnp.ndarray
+    task: Optional[jnp.ndarray] = None
 
     @classmethod
     def from_sampled(cls, b: SampledBatch) -> "DeviceBatch":
@@ -79,6 +84,7 @@ class DeviceBatch(NamedTuple):
             learning_steps=jnp.asarray(b.learning_steps),
             forward_steps=jnp.asarray(b.forward_steps),
             is_weights=jnp.asarray(b.is_weights),
+            task=None if b.task is None else jnp.asarray(b.task, jnp.int32),
         )
 
 
@@ -125,13 +131,15 @@ def make_loss_fn(cfg: R2D2Config, net: R2D2Network):
         contributions and a grad psum reproduces the global-batch gradient
         exactly (per-shard mask sums differ, so pmean of local ratios would
         not)."""
+        # b.task is None on the single-task golden path (a no-op input);
+        # multi-task batches condition the dueling head per sequence
         q_learn, q_boot_online, mask = net.apply(
             params, b.obs, b.last_action, b.last_reward, b.hidden,
-            b.burn_in_steps, b.learning_steps, b.forward_steps,
+            b.burn_in_steps, b.learning_steps, b.forward_steps, b.task,
         )
         _, q_boot_target, _ = net.apply(
             target_params, b.obs, b.last_action, b.last_reward, b.hidden,
-            b.burn_in_steps, b.learning_steps, b.forward_steps,
+            b.burn_in_steps, b.learning_steps, b.forward_steps, b.task,
         )
         # fp32 island (precision policy, config.precision): Q-target math,
         # value rescaling, n-step folding, TD/priorities, IS weighting,
@@ -258,6 +266,10 @@ def make_store_gather(cfg: R2D2Config):
             learning_steps=learn,
             forward_steps=fwd,
             is_weights=is_weights,
+            # the task store exists only when the config runs multi-task
+            # (replay/block.store_field_specs) — single-task stores keep
+            # their exact field set and this stays a None leaf
+            task=stores["task"][b, s] if "task" in stores else None,
         )
 
     return gather_batch
@@ -468,11 +480,16 @@ def make_sharded_gather_step(cfg: R2D2Config, mesh):
     def body(stores, b, s, is_weights):
         return gather_batch(stores, b[0], s[0], is_weights[0])
 
+    out_specs = DeviceBatch(*([P("dp")] * len(DeviceBatch._fields)))
+    if cfg.num_tasks <= 1:
+        # single-task gathers return task=None; the spec tree must carry
+        # the same empty subtree for the structures to match
+        out_specs = out_specs._replace(task=None)
     gathered = shard_map(
         body,
         mesh=mesh,
         in_specs=(P("dp"), P("dp"), P("dp"), P("dp")),
-        out_specs=DeviceBatch(*([P("dp")] * len(DeviceBatch._fields))),
+        out_specs=out_specs,
         axis_names={"dp"},
         check_vma=False,
     )

@@ -63,6 +63,12 @@ class R2D2Network(nn.Module):
     # (config.fused_sequence). LSTM core only; the LRU's associative-scan
     # unroll keeps full backprop regardless (documented in ARCHITECTURE.md).
     fused_sequence: bool = True
+    # multi-task head conditioning (config.num_tasks): > 1 widens the
+    # dueling-head input by a one-hot task embedding and (with
+    # task_action_dims set) masks each task's invalid action tail out of
+    # the union action space. 1 = the single-task golden path, bit-exact.
+    num_tasks: int = 1
+    task_action_dims: Tuple[int, ...] = ()
 
     @classmethod
     def from_config(cls, cfg: R2D2Config) -> "R2D2Network":
@@ -89,6 +95,8 @@ class R2D2Network(nn.Module):
             lru_r_min=cfg.lru_r_min,
             lru_r_max=cfg.lru_r_max,
             fused_sequence=cfg.fused_sequence,
+            num_tasks=cfg.num_tasks,
+            task_action_dims=tuple(cfg.task_action_dims),
         )
 
     def setup(self):
@@ -128,12 +136,44 @@ class R2D2Network(nn.Module):
         reward = last_reward.astype(dtype)[:, None]
         return jnp.concatenate([latent, onehot, reward], axis=-1)
 
-    def _dueling(self, h: jnp.ndarray) -> jnp.ndarray:
-        """Dueling Q in float32: Q = V + A - mean_a A (model.py:94)."""
+    def _task_mask(self, task: jnp.ndarray | None) -> jnp.ndarray | None:
+        """(B, A) bool valid-action mask for each row's task, or None when
+        every task spans the full union action space."""
+        if task is None or self.num_tasks <= 1 or not self.task_action_dims:
+            return None
+        dims = jnp.asarray(self.task_action_dims, jnp.int32)
+        return jnp.arange(self.action_dim)[None, :] < dims[task][:, None]
+
+    def _dueling(self, h: jnp.ndarray, task: jnp.ndarray | None = None) -> jnp.ndarray:
+        """Dueling Q in float32: Q = V + A - mean_a A (model.py:94).
+
+        Multi-task (num_tasks > 1, task a (B,) int32): the head input is
+        widened with the one-hot task embedding, the advantage mean runs
+        over each task's VALID actions only (the identifiability constant
+        must not drift with the number of masked slots), and invalid
+        actions are pinned to a -1e9 floor so neither the acting argmax
+        nor the learner's bootstrap max can select them."""
         h = h.astype(jnp.float32)
+        mask = self._task_mask(task)
+        if task is not None and self.num_tasks > 1:
+            onehot = jax.nn.one_hot(task, self.num_tasks, dtype=jnp.float32)
+            if h.ndim == 3:  # (B, L, H): per-sequence task, broadcast over L
+                onehot = jnp.broadcast_to(
+                    onehot[:, None, :], (*h.shape[:2], self.num_tasks)
+                )
+            h = jnp.concatenate([h, onehot], axis=-1)
         adv = self.adv_out(nn.relu(self.adv_hidden(h)))
         val = self.val_out(nn.relu(self.val_hidden(h)))
-        return val + adv - adv.mean(axis=-1, keepdims=True)
+        if mask is None:
+            return val + adv - adv.mean(axis=-1, keepdims=True)
+        if adv.ndim == 3:  # (B, L, A): broadcast the (B, A) mask over L
+            mask = mask[:, None, :]
+        valid = mask.astype(jnp.float32)
+        adv_mean = (adv * valid).sum(axis=-1, keepdims=True) / valid.sum(
+            axis=-1, keepdims=True
+        )
+        q = val + adv - adv_mean
+        return jnp.where(mask, q, -1e9)
 
     # ------------------------------------------------------------------ act
 
@@ -143,10 +183,11 @@ class R2D2Network(nn.Module):
         last_action: jnp.ndarray,  # (B,) int32
         last_reward: jnp.ndarray,  # (B,) float32
         carry: Carry,              # ((B, H), (B, H))
+        task: jnp.ndarray | None = None,  # (B,) int32 (multi-task only)
     ) -> Tuple[jnp.ndarray, Carry]:
         x = self._core_input(obs, last_action, last_reward)
         h, carry = self.core.step(x, carry)
-        return self._dueling(h), carry
+        return self._dueling(h, task), carry
 
     def act_select(
         self,
@@ -156,16 +197,20 @@ class R2D2Network(nn.Module):
         carry: Carry,                 # ((B, H), (B, H))
         explore: jnp.ndarray,         # (B,) bool ε-coin per row
         random_actions: jnp.ndarray,  # (B,) int random draws in [0, A)
+        task: jnp.ndarray | None = None,  # (B,) int32 (multi-task only)
     ) -> Tuple[jnp.ndarray, jnp.ndarray, Carry]:
         """Fused act tail: core step + dueling + ε-greedy select in one op.
 
         Returns (q (B, A) f32, action (B,) int32, carry). The ε coin and
         the uniform random actions are inputs (not a key) so host-loop
-        callers keep their numpy RNG stream — see ops/act_tail.py.
+        callers keep their numpy RNG stream — see ops/act_tail.py. In the
+        multi-task case callers draw random_actions within each row's
+        NATIVE action count (the masked q floor keeps the greedy branch
+        valid; random draws are the caller's contract).
         """
         from r2d2_tpu.ops.act_tail import epsilon_greedy_actions
 
-        q, carry = self.act(obs, last_action, last_reward, carry)
+        q, carry = self.act(obs, last_action, last_reward, carry, task)
         return q, epsilon_greedy_actions(q, explore, random_actions), carry
 
     # --------------------------------------------------------------- unroll
@@ -179,6 +224,7 @@ class R2D2Network(nn.Module):
         burn_in: jnp.ndarray,       # (B,) int32
         learning: jnp.ndarray,      # (B,) int32
         forward: jnp.ndarray,       # (B,) int32
+        task: jnp.ndarray | None = None,  # (B,) int32 (multi-task only)
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """Returns (q_learn (B,L,A), q_boot (B,L,A), mask (B,L) f32)."""
         B, T = obs.shape[:2]
@@ -207,13 +253,18 @@ class R2D2Network(nn.Module):
         learn_h = jnp.take_along_axis(outs, learn_idx[:, :, None], axis=1)
         boot_h = jnp.take_along_axis(outs, boot_idx[:, :, None], axis=1)
 
-        q_learn = self._dueling(learn_h)
-        q_boot = self._dueling(boot_h)
+        q_learn = self._dueling(learn_h, task)
+        q_boot = self._dueling(boot_h, task)
         mask = (t[None, :] < learning[:, None]).astype(jnp.float32)
         return q_learn, q_boot, mask
 
-    def __call__(self, obs, last_action, last_reward, hidden, burn_in, learning, forward):
-        return self.unroll(obs, last_action, last_reward, hidden, burn_in, learning, forward)
+    def __call__(
+        self, obs, last_action, last_reward, hidden, burn_in, learning, forward,
+        task=None,
+    ):
+        return self.unroll(
+            obs, last_action, last_reward, hidden, burn_in, learning, forward, task
+        )
 
 
 def initial_carry(batch: int, hidden_dim: int) -> Carry:
@@ -233,7 +284,11 @@ def init_params(rng: jax.Array, cfg: R2D2Config):
     lr = jnp.zeros((B, T), jnp.float32)
     hid = jnp.zeros((B, 2, cfg.hidden_dim), jnp.float32)
     ones = jnp.ones((B,), jnp.int32)
+    # the task input widens the head's Dense inputs, so multi-task init
+    # must trace with it for the params to take the wider shape
+    task = jnp.zeros((B,), jnp.int32) if cfg.num_tasks > 1 else None
     params = net.init(
-        rng, obs, la, lr, hid, ones * cfg.burn_in_steps, ones * cfg.learning_steps, ones * cfg.forward_steps
+        rng, obs, la, lr, hid, ones * cfg.burn_in_steps, ones * cfg.learning_steps,
+        ones * cfg.forward_steps, task,
     )
     return net, params

@@ -1,0 +1,17 @@
+"""Multi-task plane: ONE learner over a family of pure-JAX envs.
+
+The registry (registry.py) maps env names to dense task ids and computes
+the union geometry one shared network needs (max action_dim, shared
+obs_shape); the trainer (trainer.py) runs per-task actor fleets into
+per-task replay buffers and trains a single task-conditioned R2D2 on
+task-stratified batches. Everything is gated on cfg.num_tasks > 1 — the
+single-task golden path is untouched.
+"""
+
+from r2d2_tpu.multitask.registry import (  # noqa: F401
+    TASK_ALIASES,
+    TaskSpec,
+    build_registry,
+    resolve_task_names,
+)
+from r2d2_tpu.multitask.trainer import MultiTaskTrainer  # noqa: F401

@@ -1,0 +1,107 @@
+"""Task registry: env name -> dense task id + union geometry.
+
+One shared network serves every task, so the registry computes the UNION
+action space (max native action_dim; the model's per-task mask floors the
+padding, models/r2d2.py) and requires a shared obs_shape — the functional
+env families render at whatever geometry the config asks for (each
+build_*_env factory takes obs_shape), so no padding plane is needed for
+training. Per-task discounts come from the Agent57-style gamma ladder
+(ops/epsilon.multitask_gamma_ladder) unless pinned explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.ops.epsilon import multitask_gamma_ladder
+
+# launcher shorthand (sweep.py --multitask maze,drift,bandit) -> env names
+TASK_ALIASES = {
+    "maze": "keydoor",
+    "keydoor": "keydoor",
+    "drift": "drift",
+    "bandit": "banditgrid",
+    "banditgrid": "banditgrid",
+    "catch": "catch",
+}
+
+
+class TaskSpec(NamedTuple):
+    task_id: int
+    name: str         # launcher alias ("maze") or the env name itself
+    env_name: str     # full env name the factories parse
+    action_dim: int   # NATIVE action count (<= union cfg.action_dim)
+    gamma: float      # per-task discount (stored into replayed returns)
+
+
+def resolve_task_names(spec: str) -> List[str]:
+    """"maze,drift,bandit" -> env names, aliases resolved, order kept.
+    Unknown names pass through verbatim (full env names like
+    "keydoor:4:2" are legal task entries)."""
+    names = [s.strip() for s in spec.split(",") if s.strip()]
+    if not names:
+        raise ValueError(f"no task names in {spec!r}")
+    return [TASK_ALIASES.get(n.lower(), n) for n in names]
+
+
+def _native_action_dim(cfg: R2D2Config, env_name: str) -> int:
+    """The env's own action count at this config's geometry — read off the
+    functional core so the registry can never drift from the factories."""
+    from r2d2_tpu.train import build_fn_env
+
+    return build_fn_env(cfg.replace(env_name=env_name)).NUM_ACTIONS
+
+
+def build_registry(
+    cfg: R2D2Config,
+    names: Sequence[str],
+    gammas: Optional[Sequence[float]] = None,
+    gamma_min: float = 0.97,
+) -> Tuple[R2D2Config, List[TaskSpec]]:
+    """Resolve task names into (multi-task config, specs).
+
+    The returned config carries num_tasks / multitask_envs /
+    task_action_dims / task_gammas and the UNION action_dim; it has been
+    validate()d, so every task's env geometry passed the per-family
+    sanity checks (config._validate_env_geometry).
+    """
+    env_names = resolve_task_names(",".join(names)) if isinstance(names, str) else [
+        TASK_ALIASES.get(n.lower(), n) for n in names
+    ]
+    T = len(env_names)
+    if T < 1:
+        raise ValueError("need at least one task")
+    if len(set(env_names)) != T:
+        raise ValueError(f"duplicate task envs in {env_names}")
+
+    dims = [_native_action_dim(cfg, n) for n in env_names]
+    union_a = max(dims)
+
+    if gammas is None:
+        # task 0 keeps the config's own horizon; later tasks step down the
+        # log(1-gamma) ladder (Agent57's horizon spacing)
+        g_max = cfg.gamma
+        g_min = min(gamma_min, g_max)
+        gammas = [float(g) for g in multitask_gamma_ladder(T, g_min, g_max)]
+    else:
+        gammas = [float(g) for g in gammas]
+        if len(gammas) != T:
+            raise ValueError(f"{len(gammas)} gammas for {T} tasks")
+
+    out = cfg.replace(
+        env_name=env_names[0],
+        action_dim=union_a,
+        num_tasks=T,
+        multitask_envs=tuple(env_names),
+        task_action_dims=tuple(dims),
+        task_gammas=tuple(gammas),
+    )
+    out.validate()
+
+    specs = [
+        TaskSpec(task_id=t, name=env_names[t], env_name=env_names[t],
+                 action_dim=dims[t], gamma=gammas[t])
+        for t in range(T)
+    ]
+    return out, specs

@@ -31,3 +31,47 @@ def epsilon_ladder(
     i = np.arange(num_actors, dtype=np.float64)
     exponent = 1.0 + i / max(num_actors - 1, 1) * alpha
     return (float(base_eps) ** exponent).astype(np.float32)
+
+
+def multitask_epsilon_ladders(
+    num_tasks: int,
+    actors_per_task: int,
+    base_eps: float = 0.4,
+    alpha: float = 7.0,
+) -> np.ndarray:
+    """(num_tasks, actors_per_task) ε matrix: EACH task gets its own full
+    Ape-X ladder rather than slicing one ladder across tasks.
+
+    Rationale (Agent57, PAPERS.md): exploration needs are per-task — a
+    task whose replay is young still wants its greedy rungs, and a task
+    whose rewards are dense still wants its exploratory rungs. Slicing one
+    N*T ladder would give task 0 only the noisy top and task T-1 only the
+    near-greedy bottom.
+    """
+    if num_tasks < 1:
+        raise ValueError(f"num_tasks must be >= 1, got {num_tasks}")
+    ladder = epsilon_ladder(actors_per_task, base_eps, alpha)
+    return np.tile(ladder[None, :], (num_tasks, 1))
+
+
+def multitask_gamma_ladder(
+    num_tasks: int, gamma_min: float = 0.97, gamma_max: float = 0.997
+) -> np.ndarray:
+    """(num_tasks,) per-task discount ladder, interpolated UNIFORMLY IN
+    log(1 - gamma) space (Agent57 section 3.1's horizon-spacing trick):
+    linear interpolation in gamma-space would crowd every rung against
+    gamma_max because effective horizon 1/(1-gamma) is convex in gamma.
+
+    Task 0 gets gamma_max (the longest horizon — by convention the primary
+    task); the single-task rung is gamma_max exactly.
+    """
+    if num_tasks < 1:
+        raise ValueError(f"num_tasks must be >= 1, got {num_tasks}")
+    if not (0.0 < gamma_min <= gamma_max < 1.0):
+        raise ValueError(f"need 0 < gamma_min <= gamma_max < 1, got [{gamma_min}, {gamma_max}]")
+    i = np.arange(num_tasks, dtype=np.float64)
+    frac = i / max(num_tasks - 1, 1)
+    log_span = np.log(1.0 - gamma_max) + frac * (
+        np.log(1.0 - gamma_min) - np.log(1.0 - gamma_max)
+    )
+    return (1.0 - np.exp(log_span)).astype(np.float64).astype(np.float32)

@@ -34,12 +34,18 @@ from r2d2_tpu.replay.block import Block
 
 
 class SequenceAccumulator:
-    def __init__(self, cfg: R2D2Config):
+    def __init__(self, cfg: R2D2Config, task_id: int = 0, gamma: Optional[float] = None):
         self.cfg = cfg
         self.L = cfg.learning_steps
         self.B = cfg.burn_in_steps
         self.n = cfg.forward_steps
-        self.gamma = cfg.gamma
+        # per-task gamma (Agent57-style ladder, ops/epsilon.py): the n-step
+        # returns and bootstrap discounts are computed HERE at collect time
+        # and stored, so a per-task override needs no learner change
+        self.gamma = cfg.gamma if gamma is None else float(gamma)
+        # stamped into every Block this accumulator packs (multi-task
+        # replay stratification; 0 on the single-task golden path)
+        self.task_id = int(task_id)
         self.curr_burn_in = 0
         self.size = 0
 
@@ -198,6 +204,7 @@ class SequenceAccumulator:
             burn_in_steps=burn_in,
             learning_steps=learning,
             forward_steps=forward,
+            task=self.task_id,
         )
 
         episode_reward = self.sum_reward if self.done else None

@@ -47,6 +47,10 @@ class Block:
     burn_in_steps: np.ndarray
     learning_steps: np.ndarray
     forward_steps: np.ndarray
+    # multi-task plane: the task id the producing actor was collecting
+    # (multitask/registry.py). Scalar per block — one actor serves one
+    # task — broadcast per-sequence by the stores. 0 on single-task runs.
+    task: int = 0
 
     @property
     def stored_steps(self) -> int:
@@ -73,4 +77,9 @@ def store_field_specs(cfg):
         "burn_in": ((S,), np.int32),
         "learning": ((S,), np.int32),
         "forward": ((S,), np.int32),
-    }
+    } | (
+        # per-sequence task ids, present ONLY on multi-task configs so the
+        # single-task store layout (and every golden-path jaxpr/donation
+        # contract over it) is byte-identical to before
+        {"task": ((S,), np.int32)} if cfg.num_tasks > 1 else {}
+    )

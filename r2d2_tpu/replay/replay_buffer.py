@@ -50,6 +50,10 @@ class SampledBatch:
     env_steps: int             # total env steps stored so far
     # ptr_advances stamp (full-lap detection); None = no lap check
     old_advances: Optional[int] = None
+    # (B,) int32 per-sequence task ids on multi-task configs; None on the
+    # single-task golden path (keeps DeviceBatch.from_sampled's pytree —
+    # and thus every donation/jaxpr contract over it — unchanged)
+    task: Optional[np.ndarray] = None
 
 
 class ReplayBuffer(ReplayControlPlane):
@@ -71,6 +75,10 @@ class ReplayBuffer(ReplayControlPlane):
         self.burn_in_store = np.zeros((nb, S), dtype=np.int32)
         self.learning_store = np.zeros((nb, S), dtype=np.int32)
         self.forward_store = np.zeros((nb, S), dtype=np.int32)
+        # scalar per block (one actor collects one task); (nb,) is cheap
+        # enough to keep unconditionally — sampling only SURFACES it on
+        # multi-task configs (SampledBatch.task stays None otherwise)
+        self.task_store = np.zeros((nb,), dtype=np.int32)
 
     # ------------------------------------------------------------------ add
 
@@ -103,6 +111,7 @@ class ReplayBuffer(ReplayControlPlane):
             self.burn_in_store[ptr, :ns] = block.burn_in_steps
             self.learning_store[ptr, :ns] = block.learning_steps
             self.forward_store[ptr, :ns] = block.forward_steps
+            self.task_store[ptr] = block.task
             self._account_add(
                 block.num_sequences, int(block.learning_steps.sum()), priorities, episode_reward
             )
@@ -134,6 +143,7 @@ class ReplayBuffer(ReplayControlPlane):
                 self.burn_in_store[ptr, :ns] = block.burn_in_steps
                 self.learning_store[ptr, :ns] = block.learning_steps
                 self.forward_store[ptr, :ns] = block.forward_steps
+                self.task_store[ptr] = block.task
                 self._account_add(
                     block.num_sequences, int(block.learning_steps.sum()),
                     priorities, episode_reward,
@@ -206,5 +216,6 @@ class ReplayBuffer(ReplayControlPlane):
                 old_ptr=self.block_ptr,
                 env_steps=self.env_steps,
                 old_advances=self.ptr_advances,
+                task=self.task_store[b] if cfg.num_tasks > 1 else None,
             )
         return batch

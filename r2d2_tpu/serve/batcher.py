@@ -49,6 +49,10 @@ class ServeRequest:
     # per-request exploration override: None defers to the server's
     # per-session assignment (liveloop) or ServeConfig.epsilon
     epsilon: Optional[float] = None
+    # multi-task serving (cfg.num_tasks > 1): the session's task id
+    # conditions the dueling head and bounds exploration draws to the
+    # task's native actions. 0 is the single-task default.
+    task: int = 0
 
 
 class MicroBatcher:
@@ -93,6 +97,7 @@ class MicroBatcher:
     def submit(
         self, session_id: str, obs: np.ndarray, reward: float = 0.0,
         reset: bool = False, epsilon: Optional[float] = None,
+        task: int = 0,
     ) -> Future:
         """Enqueue one request; the returned Future resolves to the serve
         loop's ServeResult. A full queue fails the future immediately with
@@ -126,6 +131,7 @@ class MicroBatcher:
             future=fut,
             t_enqueue=time.monotonic(),
             epsilon=None if epsilon is None else float(epsilon),
+            task=int(task),
         )
         try:
             self._q.put_nowait(req)

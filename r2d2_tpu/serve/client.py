@@ -48,13 +48,16 @@ class LocalClient:
         self.timeout = timeout
 
     def act(self, session_id: str, obs, reward: float = 0.0,
-            reset: bool = False, epsilon: Optional[float] = None) -> ServeResult:
+            reset: bool = False, epsilon: Optional[float] = None,
+            task: int = 0) -> ServeResult:
         """Submit one request and block for its result. Raises what the
         server failed the future with (QueueFullError on overload,
         RuntimeError on a crashed iteration). `epsilon` overrides the
-        session's exploration for THIS request (None = server default)."""
+        session's exploration for THIS request (None = server default);
+        `task` is the session's task id under multi-task serving."""
         fut = self.server.submit(
-            session_id, obs, reward=reward, reset=reset, epsilon=epsilon
+            session_id, obs, reward=reward, reset=reset, epsilon=epsilon,
+            task=task,
         )
         return fut.result(timeout=self.timeout)
 

@@ -258,12 +258,14 @@ class MultiDeviceServer:
     # ------------------------------------------------------------- serving
 
     def submit(self, session_id: str, obs, reward: float = 0.0,
-               reset: bool = False, epsilon: Optional[float] = None) -> Future:
+               reset: bool = False, epsilon: Optional[float] = None,
+               task: int = 0) -> Future:
         """Route to the session's replica (placing a new session on the
         least-loaded one) and enqueue on that replica's batcher."""
         replica = self.router.route(session_id)
         return self.replicas[replica].submit(
-            session_id, obs, reward=reward, reset=reset, epsilon=epsilon
+            session_id, obs, reward=reward, reset=reset, epsilon=epsilon,
+            task=task,
         )
 
     def replica_for(self, session_id: str) -> Optional[PolicyServer]:

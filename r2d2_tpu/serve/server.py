@@ -68,15 +68,21 @@ class ServeConfig:
 
 class ServeResult:
     """One answered request: the action plus enough provenance (checkpoint
-    step, params version) to audit which params produced it."""
+    step, params version, batch bucket) to audit which params produced it.
+    `bucket` is the batch shape the request was actually served at — a
+    reference replay that pads to the same bucket runs the very program
+    shape the server compiled, which makes bit-parity structural instead
+    of leaning on XLA's batch-size canonicalization."""
 
-    __slots__ = ("action", "q", "ckpt_step", "params_version")
+    __slots__ = ("action", "q", "ckpt_step", "params_version", "bucket")
 
-    def __init__(self, action: int, q: np.ndarray, ckpt_step: int, params_version: int):
+    def __init__(self, action: int, q: np.ndarray, ckpt_step: int,
+                 params_version: int, bucket: int = 0):
         self.action = action
         self.q = q
         self.ckpt_step = ckpt_step
         self.params_version = params_version
+        self.bucket = bucket
 
     def __repr__(self) -> str:
         return (
@@ -88,25 +94,55 @@ class ServeResult:
 _REF_JITS: Dict[R2D2Network, object] = {}
 
 
+def _pad_obs(obs: np.ndarray, target: Tuple[int, ...]) -> np.ndarray:
+    """Zero-pad one request's obs up to the serving geometry (mixed-shape
+    multi-task families: a smaller task's rendering rides in the top-left
+    corner of the union canvas, exactly where the training-side factories
+    put it when asked to render AT the union shape)."""
+    target = tuple(target)
+    if obs.shape == target:
+        return obs
+    if obs.ndim != len(target) or any(s > t for s, t in zip(obs.shape, target)):
+        raise ValueError(
+            f"request obs shape {obs.shape} does not fit the serve "
+            f"obs_shape {target}"
+        )
+    return np.pad(obs, [(0, t - s) for s, t in zip(obs.shape, target)])
+
+
 def reference_act(net: R2D2Network, params, obs, last_action, last_reward, carry,
-                  min_batch: int = 2):
+                  min_batch: int = 2, task=None):
     """The direct (unbatched-service) acting path tests compare against:
     one jitted `net.act` on exactly the given sessions, padded to
-    `min_batch` rows. The pad matters: XLA lowers batch-1 acting through a
-    matrix-vector path whose reduction order differs bitwise from the
-    batched matmul path, while every batch shape >= 2 is row-stable and
-    pad-content-independent — so a 2-row padded call IS the canonical
-    per-session reference, and the served path can match it bit-for-bit.
+    `min_batch` rows. The pad matters twice over: XLA lowers batch-1
+    acting through a matrix-vector path whose reduction order differs
+    bitwise from the batched matmul path, and at aggressive-enough (or
+    low-enough) backend optimization levels even two matmul batch shapes
+    may lower with different reduction orders. Rows are independent and
+    pad-content blind at ANY level, so padding to the EXACT bucket the
+    server answered at (`ServeResult.bucket`) replays the same program
+    shape the server compiled and makes bit-parity structural. The
+    min_batch=2 default remains the canonical standalone reference at
+    XLA's default optimization level.
+
+    `task` ((B,) int32, multi-task serving only) conditions the head the
+    same way the served path does; None is the single-task golden path.
 
     Returns (q (B, A), (h, c)) for the B real rows.
     """
     fn = _REF_JITS.get(net)
     if fn is None:
-        fn = jax.jit(lambda p, o, la, lr, c: net.apply(p, o, la, lr, c, method=net.act))
+        fn = jax.jit(
+            lambda p, o, la, lr, c, t: net.apply(
+                p, o, la, lr, c, task=t, method=net.act
+            )
+        )
         _REF_JITS[net] = fn
     obs = jnp.asarray(obs)
     la = jnp.asarray(last_action, jnp.int32)
     lr = jnp.asarray(last_reward, jnp.float32)
+    if task is not None:
+        task = jnp.asarray(task, jnp.int32)
     h, c = carry
     B = obs.shape[0]
     pad = max(min_batch - B, 0)
@@ -116,7 +152,9 @@ def reference_act(net: R2D2Network, params, obs, last_action, last_reward, carry
         lr = jnp.concatenate([lr, jnp.zeros((pad,), jnp.float32)])
         h = jnp.concatenate([h, jnp.zeros((pad, h.shape[1]), h.dtype)])
         c = jnp.concatenate([c, jnp.zeros((pad, c.shape[1]), c.dtype)])
-    q, (h_out, c_out) = fn(params, obs, la, lr, (h, c))
+        if task is not None:
+            task = jnp.concatenate([task, jnp.zeros((pad,), jnp.int32)])
+    q, (h_out, c_out) = fn(params, obs, la, lr, (h, c), task)
     return q[:B], (h_out[:B], c_out[:B])
 
 
@@ -370,7 +408,8 @@ class PolicyServer:
         net = self.net
 
         def step(params, h_store, c_store, la_store, lr_store,
-                 obs, rewards, slots, reset_mask, explore_mask, random_actions):
+                 obs, rewards, slots, reset_mask, explore_mask, random_actions,
+                 task=None):
             # runs once per TRACE (new bucket shape), not per call; a
             # metrics counter bumped at trace time — a lock can't live in
             # a traced function, and a lost increment under a concurrent
@@ -394,7 +433,7 @@ class PolicyServer:
             # with the core step (models/r2d2.py act_select)
             q, action, (h_new, c_new) = net.apply(
                 params, obs, la, lr, (h, c), explore_mask, random_actions,
-                method=net.act_select,
+                task=task, method=net.act_select,
             )
             # scatter back: pad rows all target the scratch slot (their
             # writes collide there harmlessly; real slots are unique by the
@@ -415,9 +454,11 @@ class PolicyServer:
     # ------------------------------------------------------------- serving
 
     def submit(self, session_id: str, obs, reward: float = 0.0,
-               reset: bool = False, epsilon: Optional[float] = None) -> Future:
+               reset: bool = False, epsilon: Optional[float] = None,
+               task: int = 0) -> Future:
         return self.batcher.submit(
-            session_id, obs, reward=reward, reset=reset, epsilon=epsilon
+            session_id, obs, reward=reward, reset=reset, epsilon=epsilon,
+            task=task,
         )
 
     def reset_session(self, session_id: str) -> None:
@@ -445,8 +486,15 @@ class PolicyServer:
         pad = bucket - n
         slots, fresh = self.cache.assign([r.session_id for r in batch])
 
+        obs_rows = [r.obs for r in batch]
+        target = tuple(self.cfg.obs_shape)
+        if any(o.shape != target for o in obs_rows):
+            # mixed-shape task interleaving (multi-task serving): pad every
+            # row to the union geometry the compiled step expects, so one
+            # bucket serves the whole family without per-shape retraces
+            obs_rows = [_pad_obs(o, target) for o in obs_rows]
         obs = np.stack(
-            [r.obs for r in batch] + [np.zeros_like(batch[0].obs)] * pad
+            obs_rows + [np.zeros_like(obs_rows[0])] * pad
         )
         rewards = np.fromiter(
             (r.reward for r in batch), np.float32, count=n
@@ -461,6 +509,14 @@ class PolicyServer:
         slots_full = np.concatenate(
             [slots, np.full(pad, self.cache.pad_slot, np.int32)]
         )
+        # multi-task conditioning rides per request (a serve fleet hosts
+        # sessions of EVERY task at once); pad rows take task 0 — they
+        # target the scratch slot, so their q values are never read
+        task_full = None
+        if self.cfg.num_tasks > 1:
+            task_full = np.zeros(bucket, np.int32)
+            for i, r in enumerate(batch):
+                task_full[i] = r.task
         # per-row exploration: request override > per-session assignment
         # (liveloop's ladder) > the ServeConfig.epsilon fleet default.
         # RNG discipline keeps the legacy stream bit-exact: the coin and
@@ -478,18 +534,27 @@ class PolicyServer:
                     eps_row[i] = assigner.epsilon_for(r.session_id)
         if float(eps_row.max()) > 0.0:
             explore = self._rng.random(bucket) < eps_row
-            randoms = self._rng.integers(0, self.cfg.action_dim, bucket)
+            if task_full is not None and self.cfg.task_action_dims:
+                # exploration stays NATIVE per row: a drawn action must be
+                # legal for the row's task, not just the union head
+                dims = np.asarray(self.cfg.task_action_dims, np.int64)
+                randoms = self._rng.integers(0, dims[task_full])
+            else:
+                randoms = self._rng.integers(0, self.cfg.action_dim, bucket)
         else:
             explore = np.zeros(bucket, bool)
             randoms = np.zeros(bucket, np.int64)
 
         h, c, la, lr = self.cache.arrays()
-        q, action, h, c, la, lr = step_fn(
+        step_args = [
             params, h, c, la, lr,
             jnp.asarray(obs), jnp.asarray(rewards), jnp.asarray(slots_full),
             jnp.asarray(reset_mask), jnp.asarray(explore),
             jnp.asarray(randoms, jnp.int32),
-        )
+        ]
+        if task_full is not None:
+            step_args.append(jnp.asarray(task_full))
+        q, action, h, c, la, lr = step_fn(*step_args)
         q_np = np.asarray(q)
         act_np = np.asarray(action)
         # stores commit BEFORE futures resolve: a client's next request for
@@ -499,7 +564,8 @@ class PolicyServer:
         t_done = time.monotonic()
         for i, r in enumerate(batch):
             r.future.set_result(
-                ServeResult(int(act_np[i]), q_np[i], ckpt_step, version)
+                ServeResult(int(act_np[i]), q_np[i], ckpt_step, version,
+                            bucket=bucket)
             )
         with self._state_lock:
             self._inflight = []
@@ -616,13 +682,16 @@ class PolicyServer:
         for bucket in self.batcher.buckets:
             obs = np.zeros((bucket, *self.cfg.obs_shape), np.uint8)
             h, c, la, lr = self.cache.arrays()
-            out = step_fn(
+            warm_args = [
                 params, h, c, la, lr,
                 jnp.asarray(obs), jnp.zeros(bucket, jnp.float32),
                 jnp.full(bucket, self.cache.pad_slot, jnp.int32),
                 jnp.ones(bucket, bool), jnp.zeros(bucket, bool),
                 jnp.zeros(bucket, jnp.int32),
-            )
+            ]
+            if self.cfg.num_tasks > 1:
+                warm_args.append(jnp.zeros(bucket, jnp.int32))
+            out = step_fn(*warm_args)
             q, action, h, c, la, lr = out
             jax.block_until_ready(q)
             # commit: on donating backends the old stores were consumed

@@ -108,6 +108,57 @@ def run_sweep(
     return rows
 
 
+def run_multitask(
+    task_spec: str = "maze,drift,bandit",
+    preset: str = "tiny_test",
+    root: str = "sweep",
+    steps: Optional[int] = None,
+    eval_episodes: int = 8,
+    cfg_overrides: Optional[dict] = None,
+) -> List[dict]:
+    """ONE learner over the whole task family (multitask/MultiTaskTrainer):
+    the named tasks plus catch (auto-included as the family's anchor task
+    unless already listed). Writes one summary row PER TASK — the
+    acceptance bar is per-task, never an average."""
+    from r2d2_tpu.multitask import MultiTaskTrainer
+    from r2d2_tpu.multitask.registry import resolve_task_names
+
+    names = resolve_task_names(task_spec)
+    if "catch" not in names:
+        names.append("catch")
+    os.makedirs(root, exist_ok=True)
+    summary_path = os.path.join(root, "summary.jsonl")
+
+    cfg = PRESETS[preset]()
+    kw = dict(
+        checkpoint_dir=os.path.join(root, "multitask", "checkpoints"),
+        metrics_path=os.path.join(root, "multitask", "metrics.jsonl"),
+    )
+    if steps:
+        kw["training_steps"] = steps
+    kw.update(cfg_overrides or {})
+    cfg = cfg.replace(**kw)
+    os.makedirs(os.path.dirname(cfg.metrics_path), exist_ok=True)
+
+    t0 = time.time()
+    from r2d2_tpu.utils.metrics import MetricsLogger
+
+    trainer = MultiTaskTrainer(
+        cfg, names, metrics=MetricsLogger(cfg.metrics_path, cfg.log_interval)
+    )
+    trainer.warmup()
+    trainer.train(cfg.training_steps)
+    rows = trainer.evaluate(episodes=eval_episodes)
+    wall = (time.time() - t0) / 60.0
+    with open(summary_path, "a") as fh:
+        for row in rows:
+            row = {**row, "mode": "multitask", "steps": trainer._updates,
+                   "wall_minutes": wall}
+            fh.write(json.dumps(row) + "\n")
+            print(json.dumps(row))
+    return rows
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description="r2d2_tpu Atari-57 sweep")
     p.add_argument("--games", nargs="*", default=None, help="subset of games")
@@ -123,7 +174,23 @@ def main(argv=None):
     p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
                    help="override any R2D2Config field for every game "
                         "(repeatable, typed by the field)")
+    p.add_argument("--multitask", nargs="?", const="maze,drift,bandit",
+                   default=None, metavar="TASKS",
+                   help="train ONE learner over a comma-separated task "
+                        "family (aliases: maze/drift/bandit; catch is "
+                        "auto-included). Default family: maze,drift,bandit")
+    p.add_argument("--eval-episodes", type=int, default=8)
     args = p.parse_args(argv)
+    if args.multitask is not None:
+        run_multitask(
+            args.multitask,
+            preset=args.preset if args.preset != "atari" else "tiny_test",
+            root=args.root,
+            steps=args.steps,
+            eval_episodes=args.eval_episodes,
+            cfg_overrides=parse_overrides(args.set) if args.set else None,
+        )
+        return
     games = list(ATARI_57) if args.all else (args.games or ["MsPacman"])
     unknown = [g for g in games if g not in ATARI_57]
     if unknown and not args.allow_any_env:

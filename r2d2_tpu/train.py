@@ -84,6 +84,23 @@ def _build_procmaze(cfg: R2D2Config, name: str):
     return build_procmaze_env(cfg.obs_shape, cfg.max_episode_steps, name)
 
 
+def _build_multitask_family(cfg: R2D2Config, name: str):
+    """Functional core for the keydoor/drift/banditgrid families (None if
+    the name is not one of them) — each family's single build_*_env
+    factory, driven by cfg geometry like procmaze above."""
+    from r2d2_tpu.envs.banditgrid import build_banditgrid_env, is_banditgrid_name
+    from r2d2_tpu.envs.drift import build_drift_env, is_drift_name
+    from r2d2_tpu.envs.keydoor import build_keydoor_env, is_keydoor_name
+
+    if is_keydoor_name(name):
+        return build_keydoor_env(cfg.obs_shape, cfg.max_episode_steps, name)
+    if is_drift_name(name):
+        return build_drift_env(cfg.obs_shape, cfg.max_episode_steps, name)
+    if is_banditgrid_name(name):
+        return build_banditgrid_env(cfg.obs_shape, cfg.max_episode_steps, name)
+    return None
+
+
 def build_vec_env(cfg: R2D2Config, seed: int = 0):
     """One vectorized env spanning cfg.num_actors slots."""
     from r2d2_tpu.envs.catch import catch_params, is_catch_name
@@ -100,6 +117,11 @@ def build_vec_env(cfg: R2D2Config, seed: int = 0):
         return FnVecEnv(
             _build_procmaze(cfg, name), num_envs=cfg.num_actors, seed=seed
         )
+    family_env = _build_multitask_family(cfg, name)
+    if family_env is not None:
+        from r2d2_tpu.envs.functional import FnVecEnv
+
+        return FnVecEnv(family_env, num_envs=cfg.num_actors, seed=seed)
     envs = [make_env(cfg, seed=seed + i) for i in range(cfg.num_actors)]
     if cfg.env_pool_workers > 0:
         from r2d2_tpu.actor import ThreadedHostEnvPool
@@ -119,6 +141,9 @@ def build_fn_env(cfg: R2D2Config):
         )
     if _is_procmaze(name):
         return _build_procmaze(cfg, name)
+    family_env = _build_multitask_family(cfg, name)
+    if family_env is not None:
+        return family_env
     if name == "scripted" or name.startswith("scripted:"):
         from r2d2_tpu.envs.fake import ScriptedFnEnv
 

@@ -537,6 +537,50 @@ def test_multi_serve_step_gate():
     assert findings == [], render_text(findings)
 
 
+def test_multitask_train_step_gate_both_precisions():
+    """The task-conditioned stacked train step (ISSUE 13) traces clean at
+    fp32 AND bf16, and the fp32 trace really carries the (K, B) int32
+    task leaf through the batch scan — the head is task-conditioned, not
+    silently single-task."""
+    from r2d2_tpu.analysis import jaxpr_rules
+
+    for precision in ("fp32", "bf16"):
+        findings = jaxpr_rules.scan_multitask_train_step(precision)
+        assert findings == [], render_text(findings)
+    text = jaxpr_rules.multitask_train_step_jaxpr("fp32")
+    assert "scan" in text and "i32[" in text
+
+
+def test_host_sync_fires_in_multitask_serve_batch_loop():
+    """The per-request task gather in serve _run_batch is the shape most
+    likely to regress into a host sync: device-array conversion inside the
+    per-request loop. The looped form fires; the hoisted form (what
+    server.py actually does) stays clean."""
+    bad = """
+    import numpy as np
+    def run_batch(batch, q):
+        tasks = []
+        for r in batch:
+            tasks.append(np.asarray(r.task))
+            tasks.append(q.item())
+        return tasks
+    """
+    findings, _ = lint(bad, path="r2d2_tpu/serve/server.py")
+    assert rules_of(findings) == ["host-sync-in-hot-path"]
+    assert len(findings) == 2
+    good = """
+    import numpy as np
+    def run_batch(batch, dims):
+        task_full = np.zeros(len(batch), np.int32)
+        for i, r in enumerate(batch):
+            task_full[i] = r.task
+        bounds = np.asarray(dims, np.int64)
+        return task_full, bounds
+    """
+    findings, _ = lint(good, path="r2d2_tpu/serve/server.py")
+    assert findings == []
+
+
 def test_donation_checker_fires_on_mismatch():
     import jax
 

@@ -272,7 +272,8 @@ def test_serve_bucketed_parity_both_precisions(precision):
         for s in range(n_sessions):
             ref = SessionReference(srv.net, cfg.hidden_dim)
             for (obs, reward, reset), res in zip(streams[s], responses[s]):
-                q_ref, a_ref = ref.step(params, obs, reward, reset)
+                q_ref, a_ref = ref.step(params, obs, reward, reset,
+                                        bucket=res.bucket)
                 np.testing.assert_array_equal(q_ref, np.asarray(res.q))
                 assert a_ref == res.action
     finally:

@@ -351,7 +351,8 @@ def test_replica_kill_migrates_every_session_bit_exact():
             obs = rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8)
             reward = float(rng.normal())
             res = client.act(f"kc-{s}", obs, reward=reward, reset=first)
-            q_ref, a_ref = refs[s].step(srv._params_host, obs, reward, first)
+            q_ref, a_ref = refs[s].step(srv._params_host, obs, reward,
+                                        first, bucket=res.bucket)
             np.testing.assert_array_equal(q_ref, np.asarray(res.q))
             assert a_ref == res.action
 

@@ -58,7 +58,10 @@ class SessionReference:
         self.last_action = np.zeros(1, np.int32)
         self.started = False
 
-    def step(self, params, obs, reward: float, reset: bool):
+    def step(self, params, obs, reward: float, reset: bool, bucket: int = 0):
+        # bucket: the ServeResult.bucket the live answer came from; padding
+        # the reference to the same shape keeps parity structural at any
+        # XLA optimization level (see reference_act's docstring)
         if reset or not self.started:
             self.h = jnp.zeros_like(self.h)
             self.c = jnp.zeros_like(self.c)
@@ -68,7 +71,7 @@ class SessionReference:
         q, (self.h, self.c) = reference_act(
             self.net, params, obs[None],
             self.last_action, np.array([reward], np.float32),
-            (self.h, self.c),
+            (self.h, self.c), min_batch=max(int(bucket), 2),
         )
         q = np.asarray(q)[0]
         action = int(np.argmax(q))
@@ -120,7 +123,7 @@ def test_batched_parity_interleaved_sessions(base_server):
     for s in range(n_sessions):
         ref = SessionReference(srv.net, CFG.hidden_dim)
         for (obs, reward, reset), res in zip(streams[s], responses[s]):
-            q_ref, a_ref = ref.step(params, obs, reward, reset)
+            q_ref, a_ref = ref.step(params, obs, reward, reset, bucket=res.bucket)
             np.testing.assert_array_equal(q_ref, np.asarray(res.q))
             assert a_ref == res.action
 
@@ -143,7 +146,7 @@ def test_eviction_and_readmission(base_server):
 
     ref = SessionReference(srv.net, CFG.hidden_dim)
     # the reference restarts from zero: the carried reward/action are gone
-    q_ref, a_ref = ref.step(params, obs1, 1.5, reset=True)
+    q_ref, a_ref = ref.step(params, obs1, 1.5, reset=True, bucket=res.bucket)
     np.testing.assert_array_equal(q_ref, np.asarray(res.q))
     assert a_ref == res.action
     # contrast: a session that KEPT its slot must NOT equal the fresh path
@@ -390,7 +393,10 @@ def test_hot_reload_e2e(tmp_path):
         ref = SessionReference(srv.net, CFG.hidden_dim)
         for obs, reward, reset, res in records[i]:
             assert res.ckpt_step in params_by_step  # never torn/unknown
-            q_ref, a_ref = ref.step(params_by_step[res.ckpt_step], obs, reward, reset)
+            q_ref, a_ref = ref.step(
+                params_by_step[res.ckpt_step], obs, reward, reset,
+                bucket=res.bucket,
+            )
             np.testing.assert_array_equal(q_ref, np.asarray(res.q))
             assert a_ref == res.action
 
@@ -411,7 +417,7 @@ def test_crash_recovery_preserves_sessions():
 
     ref = SessionReference(srv.net, CFG.hidden_dim)
     res0 = client.act("s", obs[0], reset=True)
-    ref.step(params, obs[0], 0.0, True)
+    ref.step(params, obs[0], 0.0, True, bucket=res0.bucket)
 
     real_iteration = srv._serve_iteration
     bomb_active = threading.Event()
@@ -443,7 +449,7 @@ def test_crash_recovery_preserves_sessions():
 
     # the retried request continues from the LAST COMMITTED carry
     res1 = client.act("s", obs[1], reward=0.5)
-    q_ref, a_ref = ref.step(params, obs[1], 0.5, False)
+    q_ref, a_ref = ref.step(params, obs[1], 0.5, False, bucket=res1.bucket)
     np.testing.assert_array_equal(q_ref, np.asarray(res1.q))
     assert a_ref == res1.action
     assert res0.params_version == res1.params_version
@@ -513,7 +519,7 @@ class TestServeInt8:
                 res_q = cl_q.act("s", obs, reward=r, reset=reset)
                 # self-consistency: the int8 arm IS the direct path on the
                 # dequantized params, bit for bit (no extra serving drift)
-                q_ref, a_ref = ref.step(deq, obs, r, reset)
+                q_ref, a_ref = ref.step(deq, obs, r, reset, bucket=res_q.bucket)
                 np.testing.assert_array_equal(q_ref, np.asarray(res_q.q))
                 assert a_ref == res_q.action
                 max_drift = max(max_drift, float(np.max(np.abs(
@@ -559,3 +565,179 @@ class TestServeInt8:
         # params doubled -> per-channel absmax scales double exactly
         for b, a in zip(before, after):
             np.testing.assert_allclose(a, b * 2.0, rtol=1e-6)
+
+
+# --------------------------------------------------------------- multi-task
+
+
+def _mt_cfg():
+    """tiny_test widened to a 2-task family (drift A=3, banditgrid A=5 ->
+    union action_dim 5, task-conditioned head)."""
+    from r2d2_tpu.multitask import build_registry
+
+    return build_registry(CFG, ["drift", "banditgrid"])
+
+
+class MTSessionReference:
+    """Task-conditioned per-session reference: reference_act with the
+    session's task id, carrying (h, c, last_action) like training does."""
+
+    def __init__(self, net, hidden_dim: int, task: int):
+        self.net = net
+        self.h = jnp.zeros((1, hidden_dim), jnp.float32)
+        self.c = jnp.zeros((1, hidden_dim), jnp.float32)
+        self.last_action = np.zeros(1, np.int32)
+        self.task = np.array([task], np.int32)
+        self.started = False
+
+    def step(self, params, obs, reward: float, reset: bool, bucket: int = 0):
+        if reset or not self.started:
+            self.h = jnp.zeros_like(self.h)
+            self.c = jnp.zeros_like(self.c)
+            self.last_action = np.zeros(1, np.int32)
+            reward = 0.0
+            self.started = True
+        q, (self.h, self.c) = reference_act(
+            self.net, params, obs[None],
+            self.last_action, np.array([reward], np.float32),
+            (self.h, self.c), min_batch=max(int(bucket), 2), task=self.task,
+        )
+        q = np.asarray(q)[0]
+        action = int(np.argmax(q))
+        self.last_action = np.array([action], np.int32)
+        return q, action
+
+
+@pytest.mark.multitask
+class TestServeMultiTask:
+    @pytest.fixture(scope="class")
+    def mt_server(self):
+        cfg, specs = _mt_cfg()
+        srv = PolicyServer(
+            cfg, ServeConfig(buckets=(2, 4, 8), max_wait_ms=3.0,
+                             cache_capacity=64),
+        )
+        srv.warmup()
+        srv.start()
+        yield srv, cfg, specs
+        srv.stop()
+
+    def test_mixed_task_bucketed_parity(self, mt_server):
+        """Sessions of DIFFERENT tasks interleave through one bucketed
+        step; every answer is bit-identical to the task-conditioned
+        reference path, and each task's padded action tail stays floored."""
+        srv, cfg, specs = mt_server
+        client = LocalClient(srv)
+        params = srv._published[0]
+        rng = np.random.default_rng(7)
+        n_sessions, n_steps = 4, 8
+        streams = [
+            [
+                (rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8),
+                 float(rng.normal()))
+                for _ in range(n_steps)
+            ]
+            for _ in range(n_sessions)
+        ]
+        responses = [[] for _ in range(n_sessions)]
+        barrier = threading.Barrier(n_sessions)
+
+        def run(s: int) -> None:
+            barrier.wait()  # overlap so batches mix tasks
+            for i, (obs, reward) in enumerate(streams[s]):
+                responses[s].append(
+                    client.act(f"mt-{s}", obs, reward=reward,
+                               reset=(i == 0), task=s % 2)
+                )
+
+        threads = [
+            threading.Thread(target=run, args=(s,)) for s in range(n_sessions)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+
+        for s in range(n_sessions):
+            task = s % 2
+            native = specs[task].action_dim
+            ref = MTSessionReference(srv.net, cfg.hidden_dim, task)
+            for (obs, reward), res in zip(streams[s], responses[s]):
+                q_ref, a_ref = ref.step(params, obs, reward, reset=False,
+                                        bucket=res.bucket)
+                np.testing.assert_array_equal(q_ref, np.asarray(res.q))
+                assert a_ref == res.action
+                # the union head's invalid tail is masked for this task
+                assert res.action < native
+                if native < cfg.action_dim:
+                    assert np.all(np.asarray(res.q)[native:] < -1e8)
+
+    def test_mixed_obs_shapes_pad_through_bucket(self, mt_server):
+        """A smaller task's obs rides zero-padded through the union-shape
+        step: same answer as submitting the padded canvas directly."""
+        srv, cfg, specs = mt_server
+        client = LocalClient(srv)
+        params = srv._published[0]
+        rng = np.random.default_rng(9)
+        small = rng.integers(0, 255, (8, 8, 1), dtype=np.uint8)
+        res = client.act("mt-small", small, reset=True, task=1)
+        padded = np.zeros(cfg.obs_shape, np.uint8)
+        padded[:8, :8, :] = small
+        ref = MTSessionReference(srv.net, cfg.hidden_dim, 1)
+        q_ref, a_ref = ref.step(params, padded, 0.0, reset=True,
+                                bucket=res.bucket)
+        np.testing.assert_array_equal(q_ref, np.asarray(res.q))
+        assert a_ref == res.action
+
+    def test_pad_obs_rejects_oversize(self):
+        from r2d2_tpu.serve.server import _pad_obs
+
+        with pytest.raises(ValueError):
+            _pad_obs(np.zeros((16, 16, 1), np.uint8), (12, 12, 1))
+
+    def test_multitask_fleet_affinity(self):
+        """Mixed-task sessions through a 2-replica fleet: affinity pins
+        each session to one replica, answers stay bit-identical to the
+        task-conditioned reference, and per-replica compiles stay bounded
+        by the bucket set."""
+        from r2d2_tpu.serve import MultiDeviceServer
+
+        cfg, specs = _mt_cfg()
+        srv = MultiDeviceServer(
+            cfg,
+            ServeConfig(buckets=(2, 4), max_wait_ms=2.0, cache_capacity=16),
+            devices=jax.local_devices()[:2],
+        )
+        srv.warmup()
+        srv.start()
+        try:
+            client = LocalClient(srv)
+            params = srv._params_host
+            rng = np.random.default_rng(11)
+            n_sessions, n_steps = 6, 5
+            refs = [
+                MTSessionReference(srv.net, cfg.hidden_dim, s % 2)
+                for s in range(n_sessions)
+            ]
+            homes = [None] * n_sessions
+            for i in range(n_steps):
+                for s in range(n_sessions):
+                    obs = rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8)
+                    reward = float(rng.normal())
+                    res = client.act(f"fleet-{s}", obs, reward=reward,
+                                     reset=(i == 0), task=s % 2)
+                    q_ref, a_ref = refs[s].step(
+                        params, obs, reward, reset=(i == 0), bucket=res.bucket
+                    )
+                    np.testing.assert_array_equal(q_ref, np.asarray(res.q))
+                    assert a_ref == res.action
+                    home = srv.router.peek(f"fleet-{s}")
+                    assert home is not None
+                    if homes[s] is None:
+                        homes[s] = home
+                    assert home == homes[s]  # affinity never moves
+            assert len({h for h in homes}) > 1  # fleet actually spread
+            for r in srv.replicas:
+                assert r.trace_count <= len(r.batcher.buckets)
+        finally:
+            srv.stop()

@@ -193,7 +193,8 @@ def test_sessions_8x_capacity_carry_continuity():
                 reward = float(rng.normal())
                 reset = rnd == 0
                 res = client.act(f"pop-{s}", obs, reward=reward, reset=reset)
-                q_ref, a_ref = refs[s].step(params, obs, reward, reset)
+                q_ref, a_ref = refs[s].step(params, obs, reward, reset,
+                                            bucket=res.bucket)
                 np.testing.assert_array_equal(q_ref, np.asarray(res.q))
                 assert a_ref == res.action
     finally:
@@ -270,7 +271,8 @@ def test_multi_device_parity_and_affinity():
                 obs = rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8)
                 reward = float(rng.normal())
                 res = client.act(sid, obs, reward=reward, reset=t == 0)
-                q_ref, a_ref = refs[s].step(srv._params_host, obs, reward, t == 0)
+                q_ref, a_ref = refs[s].step(srv._params_host, obs, reward,
+                                            t == 0, bucket=res.bucket)
                 np.testing.assert_array_equal(q_ref, np.asarray(res.q))
                 assert a_ref == res.action
                 owner = srv.router.peek(sid)
@@ -395,7 +397,8 @@ def test_multi_device_reload_under_traffic(tmp_path):
         for obs, reward, reset, res in records[i]:
             assert res.params_version in params_by_version  # never torn
             q_ref, a_ref = ref.step(
-                params_by_version[res.params_version], obs, reward, reset
+                params_by_version[res.params_version], obs, reward, reset,
+                bucket=res.bucket,
             )
             np.testing.assert_array_equal(q_ref, np.asarray(res.q))
             assert a_ref == res.action
