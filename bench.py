@@ -24,6 +24,7 @@ Prints exactly one JSON line:
 from __future__ import annotations
 
 import json
+import os
 import queue
 import sys
 import threading
@@ -1574,8 +1575,22 @@ def long_context_main(core: str = "lstm", lru_chunk: int = 0,
     )
 
 
+def _load_r09_breakdown():
+    """The committed round-9 breakdown (BENCH_r09.json next to this file):
+    the baseline the vs_r09 column is measured against. None when the
+    file is missing or carries no parsed breakdown."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_r09.json")
+    try:
+        with open(path) as fh:
+            return json.load(fh)["parsed"]["breakdown"]
+    except (OSError, KeyError, ValueError):
+        return None
+
+
 def breakdown_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0,
-                   precision: str = "bf16"):
+                   precision: str = "bf16", backward_arm: str = "default",
+                   ckpt_every: int = 0):
     """Per-phase learner step breakdown: the denominator map for kernel
     work. Times the train step's constituent programs as SEPARATELY
     jitted pieces on one synthetic DeviceBatch —
@@ -1611,6 +1626,21 @@ def breakdown_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0,
     )
     if batch:
         cfg = cfg.replace(batch_size=batch)
+    # Backward-arm selection (ISSUE 14): time the pallas backward kernels
+    # themselves instead of the scan VJP. Only meaningful on a real TPU —
+    # on CPU the pallas path runs in interpret mode and the timings say
+    # nothing; the analytic backward_arms/residual section below covers
+    # the CPU story for every arm regardless of which one is timed.
+    seq_T = cfg.burn_in_steps + cfg.learning_steps + cfg.forward_steps
+    ckpt_S = ckpt_every or max(
+        s for s in range(1, seq_T) if seq_T % s == 0
+    )
+    if seq_T % ckpt_S:
+        raise SystemExit(f"--ckpt-every {ckpt_S} does not divide T={seq_T}")
+    if backward_arm == "fused_dwh":
+        cfg = cfg.replace(lstm_backend="pallas", seq_fused_dwh=True)
+    elif backward_arm == "ckpt":
+        cfg = cfg.replace(lstm_backend="pallas", seq_grad_checkpoint=ckpt_S)
     dev = jax.devices()[0]
     print(f"device: {dev.device_kind} ({dev.platform})", file=sys.stderr)
 
@@ -1693,34 +1723,104 @@ def breakdown_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0,
     }
     step_ms = times.pop("train_step")
     host_ms = _priority_host_ms(cfg, B)
-    print(
-        json.dumps(
-            {
-                "metric": "learner_step_breakdown",
-                "value": round(step_ms, 3),
-                "unit": "ms/update",
-                "batch": B,
-                "core": cfg.recurrent_core
-                + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
-                "precision": cfg.precision,
-                "fused_sequence": cfg.fused_sequence,
-                "phases": {
-                    name: {
-                        "ms": round(ms, 3),
-                        "frac_of_step": round(ms / step_ms, 3),
-                    }
-                    for name, ms in times.items()
-                },
-                # host-thread occupancy of the PRIORITY plane per update,
-                # for both settings of config.priority_plane: "host" pays
-                # a numpy tree sample+update on the host critical path
-                # every update; "device" pays only the dispatch of the
-                # in-jit sample/IS/write-back program (the tree math rides
-                # the device stream)
-                "host_ms_per_update": host_ms,
+    report = {
+        "metric": "learner_step_breakdown",
+        "value": round(step_ms, 3),
+        "unit": "ms/update",
+        "batch": B,
+        "core": cfg.recurrent_core
+        + (f"_c{cfg.lru_chunk}" if cfg.lru_chunk else ""),
+        "precision": cfg.precision,
+        "fused_sequence": cfg.fused_sequence,
+        "backward_arm": backward_arm,
+        "phases": {
+            name: {
+                "ms": round(ms, 3),
+                "frac_of_step": round(ms / step_ms, 3),
             }
+            for name, ms in times.items()
+        },
+        # host-thread occupancy of the PRIORITY plane per update,
+        # for both settings of config.priority_plane: "host" pays
+        # a numpy tree sample+update on the host critical path
+        # every update; "device" pays only the dispatch of the
+        # in-jit sample/IS/write-back program (the tree math rides
+        # the device stream)
+        "host_ms_per_update": host_ms,
+    }
+
+    # vs_r09: per-phase deltas against the committed round-9 breakdown,
+    # only when the run is apples-to-apples (same batch/core/precision)
+    base = _load_r09_breakdown()
+    if (
+        base
+        and base.get("batch") == B
+        and base.get("precision") == cfg.precision
+        and base.get("core") == report["core"]
+    ):
+        report["vs_r09"] = {
+            "step_ms": round(step_ms - base["value"], 3),
+            "phases": {
+                name: {
+                    "ms": round(ms - base["phases"][name]["ms"], 3),
+                    "frac_of_step": round(
+                        ms / step_ms - base["phases"][name]["frac_of_step"], 3
+                    ),
+                }
+                for name, ms in times.items()
+                if name in base.get("phases", {})
+            },
+        }
+    else:
+        report["vs_r09"] = None
+
+    # Peak-residual-bytes row: what each backward arm pins in HBM across
+    # the forward/backward boundary at THESE shapes, from the same
+    # accounting the kernel tests assert (analytic, so it holds on this
+    # host even when only the scan arm is timed). The fused/ckpt arms
+    # also shrink the dz output from f32 to the proj dtype.
+    from r2d2_tpu.ops.pallas_lstm import seq_backward_residual_bytes
+
+    H = cfg.hidden_dim
+    itemsize = jnp.dtype(cfg.resolved_compute_dtype).itemsize
+    dz_f32 = seq_T * B * 4 * H * 4
+    dz_proj = seq_T * B * 4 * H * itemsize
+    arms = {
+        "default": dict(
+            seq_backward_residual_bytes(seq_T, B, H, cfg.resolved_compute_dtype),
+            dz_bytes=dz_f32,
+        ),
+        "fused_dwh": dict(
+            seq_backward_residual_bytes(seq_T, B, H, cfg.resolved_compute_dtype),
+            dz_bytes=dz_proj,
+        ),
+        "ckpt": dict(
+            seq_backward_residual_bytes(
+                seq_T, B, H, cfg.resolved_compute_dtype, ckpt_S
+            ),
+            dz_bytes=dz_proj,
+            segment=ckpt_S,
+        ),
+    }
+    for a in arms.values():
+        a["peak_residual_bytes"] = a["carry_residual_bytes"] + a["dz_bytes"]
+    report["backward_arms"] = {
+        "T": seq_T,
+        "hidden_dim": H,
+        "proj_dtype": str(jnp.dtype(cfg.resolved_compute_dtype)),
+        "arms": arms,
+    }
+    # compiled peak for the timed arm, when this jax exposes it
+    try:
+        fn, args_fn = programs["loss_grad"]
+        mem = fn.lower(*args_fn()).compile().memory_analysis()
+        report["backward_arms"]["compiled_temp_bytes"] = int(
+            mem.temp_size_in_bytes
         )
-    )
+    except Exception:
+        pass
+
+    print(json.dumps(report))
 
 
 def multitask_main(
@@ -2052,6 +2152,19 @@ if __name__ == "__main__":
         help="liveloop mode: also write the report JSON here "
              "(e.g. BENCH_r12.json)",
     )
+    p.add_argument(
+        "--backward-arm", default="default",
+        choices=["default", "fused_dwh", "ckpt"],
+        help="breakdown mode: which seq-backward arm the timed programs "
+             "run (fused_dwh / ckpt force lstm_backend=pallas; only "
+             "meaningful on TPU — on CPU pallas runs in interpret mode)",
+    )
+    p.add_argument(
+        "--ckpt-every", type=int, default=0,
+        help="breakdown mode: checkpoint segment length S for the ckpt "
+             "arm (0 = largest proper divisor of T); also sets the S the "
+             "analytic residual row reports",
+    )
     args = p.parse_args()
     enable_compilation_cache(args.compile_cache)
     precision = args.precision or (
@@ -2066,7 +2179,9 @@ if __name__ == "__main__":
     elif args.mode == "recovery":
         recovery_main(precision)
     elif args.mode == "breakdown":
-        breakdown_main(args.core, args.lru_chunk, args.batch, precision)
+        breakdown_main(args.core, args.lru_chunk, args.batch, precision,
+                       backward_arm=args.backward_arm,
+                       ckpt_every=args.ckpt_every)
     elif args.mode == "serve":
         serve_main(args.core, args.lru_chunk, args.sessions,
                    args.serve_seconds, precision,

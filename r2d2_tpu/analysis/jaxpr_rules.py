@@ -260,6 +260,69 @@ def fused_train_step_jaxpr(precision: str) -> str:
     return str(jax.make_jaxpr(step)(state, _stacked_batch_struct(precision, _NUM_STEPS)))
 
 
+# ckpt segment length for the tiny_test trace: seq_len = 4+4+2 = 10, so 5
+# walks two real segments (the recompute loop AND the segment grid are
+# both exercised, not degenerate)
+_CKPT_S = 5
+
+
+def _backward_arm_cfg(precision: str, arm: str):
+    """tiny config with one alternative backward arm armed (ops/pallas_lstm):
+    'fused_dwh' accumulates dWh in kernel scratch, 'ckpt' checkpoints every
+    _CKPT_S-th carry and recomputes segments in the backward kernel."""
+    cfg = _cfg(precision).replace(lstm_backend="pallas")
+    if arm == "fused_dwh":
+        return cfg.replace(seq_fused_dwh=True)
+    if arm == "ckpt":
+        return cfg.replace(seq_grad_checkpoint=_CKPT_S)
+    raise ValueError(f"unknown backward arm {arm!r}")
+
+
+@functools.lru_cache(maxsize=None)
+def _backward_arm_net_and_state(precision: str, arm: str):
+    import jax
+
+    from r2d2_tpu.learner import init_train_state
+
+    cfg = _backward_arm_cfg(precision, arm)
+    net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    return net, state
+
+
+@functools.lru_cache(maxsize=None)
+def backward_arm_train_step_jaxpr(precision: str, arm: str) -> str:
+    """Jaxpr text of the stacked train step with a backward arm armed —
+    same trace as fused_train_step_jaxpr, different VJP program. Gated on
+    the SAME 3-launch budget: the fused-dWh arm replaces the outside
+    hᵀ@dz matmul with scratch accumulation (not an extra launch), and the
+    ckpt arm recomputes segments inside its one backward launch."""
+    import jax
+
+    from r2d2_tpu.learner import make_stacked_batch_train_step
+
+    cfg = _backward_arm_cfg(precision, arm)
+    net, state = _backward_arm_net_and_state(precision, arm)
+    step = make_stacked_batch_train_step(cfg, net, _NUM_STEPS, donate=False)
+    return str(jax.make_jaxpr(step)(state, _stacked_batch_struct(precision, _NUM_STEPS)))
+
+
+def check_backward_arm_donation(precision: str, arm: str) -> List[Finding]:
+    """Donation contract per backward arm: the alternative VJPs change the
+    residual set, which must not break full TrainState consumption."""
+    import jax
+
+    from r2d2_tpu.learner import make_stacked_batch_train_step
+
+    label = f"backward_arm[{arm}][{precision}].donation"
+    cfg = _backward_arm_cfg(precision, arm)
+    net, state = _backward_arm_net_and_state(precision, arm)
+    step = make_stacked_batch_train_step(cfg, net, _NUM_STEPS, donate=True)
+    out_state, _, _ = jax.eval_shape(
+        step, state, _stacked_batch_struct(precision, _NUM_STEPS)
+    )
+    return compare_donated_leaves(state, out_state, label)
+
+
 _SUPERSTEP_N = 2  # dispatches: >1 so the outer scan over dispatch keys is real
 
 
@@ -819,6 +882,30 @@ def scan_fused_unroll(precision: str) -> List[Finding]:
     return out
 
 
+def scan_backward_arms(precision: str) -> List[Finding]:
+    """The alternative backward-arm entries (fused-dWh, ckpt): each arm's
+    train step holds the SAME 3-launch budget as the default pallas path
+    (no extra launches bought with the memory savings), stays off f64,
+    keeps the precision plane's dtype contract, and still donates the
+    whole TrainState."""
+    out: List[Finding] = []
+    for arm in ("fused_dwh", "ckpt"):
+        label = f"backward_arm[{arm}][{precision}]"
+        text = backward_arm_train_step_jaxpr(precision, arm)
+        out += check_no_float64(text, label)
+        if precision == "fp32":
+            out += check_no_bf16(text, label)
+        else:
+            out += check_fp32_island(text, label)
+        out += check_kernel_launch_count(
+            text, label, 3,
+            "train step (online fwd + target fwd + one backward kernel — "
+            "the arm must not add launches)",
+        )
+        out += check_backward_arm_donation(precision, arm)
+    return out
+
+
 def scan_superstep(precision: str) -> List[Finding]:
     """The N×K priority superstep entry: the tree descent / IS-weight /
     write-back math must stay off f64 at either precision (the device
@@ -1086,6 +1173,7 @@ def scan_entry_points(
         out += scan_act(p)
         out += scan_act_select(p)
         out += scan_fused_unroll(p)
+        out += scan_backward_arms(p)
         out += scan_superstep(p)
         out += scan_serve_step(p)
         out += scan_multi_serve_step(p)

@@ -242,12 +242,37 @@ class R2D2Config:
     # through burn-in. The LRU core ignores this knob (its associative
     # scan has no per-row seam kernel; documented in ARCHITECTURE.md).
     fused_sequence: bool = True
+    # Backward-pass kernel arms for the fused sequence unroll
+    # (ops/pallas_lstm.py). Both default OFF: the default backward path is
+    # bit-identical to every earlier release.
+    #
+    # seq_fused_dwh: accumulate the (H, 4H) recurrent-weight gradient in a
+    # VMEM scratch inside the reversed-T backward kernel (each step already
+    # holds h_{t-1} and dz in VMEM) instead of the separate
+    # (T*B, H)^T @ (T*B, 4H) matmul outside it — and stream dz out directly
+    # in the compute dtype (it only feeds dproj once dWh is fused), so the
+    # full-size f32 dz array disappears from the backward.
+    seq_fused_dwh: bool = False
+    # seq_grad_checkpoint = S > 0: gradient-checkpointed backward. The VJP
+    # saves only every-S-step (h, c) carries as residuals — O((T/S)*B*H)
+    # HBM instead of O(T*B*H) — and the backward kernel recomputes each
+    # S-segment's gates from its checkpoint before walking it in reverse.
+    # Implies the fused dWh accumulation (the full h sequence is never in
+    # HBM for the outside matmul to read). Requires seq_len % S == 0.
+    # 0 = off. Pallas-backend knob; the scan backend has scan_chunk.
+    seq_grad_checkpoint: int = 0
 
     # --- parallelism ------------------------------------------------------
     # Data-parallel learner shards the batch over the "dp" mesh axis;
     # "tp" shards wide layers (impala encoder / LSTM kernels) when > 1.
     dp_size: int = 1
     tp_size: int = 1
+    # fsdp axis size (parallel/sharding_map.py): > 1 adds a third mesh axis
+    # that shards the optimizer-state mu/nu trees (the next-largest HBM
+    # residents after backward residuals) over their first divisible dim.
+    # Params stay replicated over fsdp (ZeRO-1 style): grads are computed
+    # from whole params, only the Adam moments live sharded. CLI: --fsdp.
+    fsdp_size: int = 1
     # chunk size for remat'd long-sequence scans. SCAN-BACKEND KNOB ONLY:
     # the Pallas unroll stores no per-gate residuals (gates are recomputed
     # in its backward kernel), so it has nothing to remat — when the pallas
@@ -573,6 +598,48 @@ class R2D2Config:
                 "cannot partition the Pallas unroll; use "
                 "lstm_backend='scan' (or 'auto', which resolves to scan "
                 "there)"
+            )
+        if self.seq_grad_checkpoint < 0:
+            raise ValueError("seq_grad_checkpoint must be >= 0 (0 = off)")
+        if self.seq_grad_checkpoint > 0:
+            if self.seq_len % self.seq_grad_checkpoint != 0:
+                raise ValueError(
+                    f"seq_grad_checkpoint={self.seq_grad_checkpoint} must "
+                    f"divide seq_len={self.seq_len} (burn_in + learning + "
+                    "forward): the checkpointed backward kernel walks whole "
+                    "S-step segments"
+                )
+            if self.seq_fused_dwh:
+                raise ValueError(
+                    "seq_fused_dwh and seq_grad_checkpoint are alternative "
+                    "backward arms; the checkpointed arm already fuses dWh "
+                    "(it never materializes the h sequence for the outside "
+                    "matmul) — set at most one"
+                )
+        if (self.seq_fused_dwh or self.seq_grad_checkpoint > 0) and (
+            self.recurrent_core != "lstm"
+        ):
+            raise ValueError(
+                "seq_fused_dwh / seq_grad_checkpoint tune the fused LSTM "
+                "sequence kernel's backward; they require "
+                "recurrent_core='lstm'"
+            )
+        if self.fsdp_size < 1:
+            raise ValueError("fsdp_size must be >= 1")
+        if self.fsdp_size > 1 and self.replay_plane == "multihost":
+            raise ValueError(
+                "replay_plane='multihost' keeps params/opt-state replicated "
+                "per its P() in_specs; fsdp_size > 1 is a single-controller "
+                "mesh feature (parallel/sharding_map.py)"
+            )
+        if self.fsdp_size > 1 and self.tp_size > 1:
+            raise ValueError(
+                "fsdp_size > 1 composes with dp only for now: tp-sharded "
+                "params on a 3-axis mesh miscompile the recurrent scan "
+                "under the current XLA SPMD partitioner (the forward's "
+                "values change — caught by tests/test_sharding_map.py's "
+                "equivalence probe). Shard optimizer state over fsdp xor "
+                "kernels over tp"
             )
         # Functional-family geometry guards: an episode cap shorter than
         # the env's first possible reward means NO signal ever fires —

@@ -48,6 +48,13 @@ class LSTM(nn.Module):
     # pallas_lstm.py) — recurrent weights + carry stay VMEM-resident for
     # the whole unroll. "auto": pallas on TPU, scan elsewhere.
     backend: str = "auto"
+    # Pallas-backend backward arms (config.seq_fused_dwh /
+    # seq_grad_checkpoint; ops/pallas_lstm.py). Both default OFF — the
+    # default backward path stays bit-identical. Applied only on the
+    # fused-sequence (burn_in) path; the scan backend ignores them
+    # (scan_chunk is its rematerialization knob).
+    fused_dwh: bool = False
+    grad_checkpoint: int = 0
 
     def setup(self):
         H = self.hidden_dim
@@ -102,10 +109,23 @@ class LSTM(nn.Module):
             self.backend == "auto" and jax.default_backend() == "tpu"
         )
         if use_pallas:
-            from r2d2_tpu.ops.pallas_lstm import lstm_seq_unroll, lstm_unroll
+            from r2d2_tpu.ops.pallas_lstm import (
+                lstm_seq_unroll,
+                lstm_seq_unroll_ckpt,
+                lstm_seq_unroll_fused_dwh,
+                lstm_unroll,
+            )
 
             if burn_in is None:
                 outs_t, (hT, cT) = lstm_unroll(proj_t, wh, h, c)
+            elif self.grad_checkpoint:
+                outs_t, (hT, cT) = lstm_seq_unroll_ckpt(self.grad_checkpoint)(
+                    proj_t, wh, h, c, burn_in.astype(jnp.int32)
+                )
+            elif self.fused_dwh:
+                outs_t, (hT, cT) = lstm_seq_unroll_fused_dwh(
+                    proj_t, wh, h, c, burn_in.astype(jnp.int32)
+                )
             else:
                 outs_t, (hT, cT) = lstm_seq_unroll(
                     proj_t, wh, h, c, burn_in.astype(jnp.int32)
@@ -145,22 +165,36 @@ class LSTM(nn.Module):
         if self.scan_chunk is None or T <= self.scan_chunk:
             (h, c), outs = jax.lax.scan(step, (h, c), xs_scan)
         else:
+            # T > chunk: remat each full chunk; a non-divisible tail runs
+            # as ONE shorter remat'd chunk (same step fn, same remat
+            # boundary semantics), so burn-in/learning-window geometries
+            # are not constrained to divisible sequence lengths.
             chunk = self.scan_chunk
-            if T % chunk != 0:
-                raise ValueError(f"seq len {T} not divisible by scan_chunk {chunk}")
+            n_full = T // chunk
+            main_len = n_full * chunk
 
             @jax.checkpoint
             def run_chunk(carry, chunk_xs):
                 return jax.lax.scan(step, carry, chunk_xs)
 
-            p_chunks = proj_t.reshape(T // chunk, chunk, B, 4 * self.hidden_dim)
+            p_chunks = proj_t[:main_len].reshape(
+                n_full, chunk, B, 4 * self.hidden_dim
+            )
+            ts = jnp.arange(T, dtype=jnp.int32)
             if burn_in is None:
                 chunk_xs = p_chunks
             else:
-                ts = jnp.arange(T, dtype=jnp.int32).reshape(T // chunk, chunk)
-                chunk_xs = (ts, p_chunks)
+                chunk_xs = (ts[:main_len].reshape(n_full, chunk), p_chunks)
             (h, c), outs = jax.lax.scan(run_chunk, (h, c), chunk_xs)
-            outs = outs.reshape(T, B, self.hidden_dim)
+            outs = outs.reshape(main_len, B, self.hidden_dim)
+            if main_len < T:
+                tail_xs = (
+                    proj_t[main_len:]
+                    if burn_in is None
+                    else (ts[main_len:], proj_t[main_len:])
+                )
+                (h, c), tail_outs = run_chunk((h, c), tail_xs)
+                outs = jnp.concatenate([outs, tail_outs], axis=0)
 
         return jnp.swapaxes(outs, 0, 1), (h, c)
 

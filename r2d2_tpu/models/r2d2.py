@@ -63,6 +63,11 @@ class R2D2Network(nn.Module):
     # (config.fused_sequence). LSTM core only; the LRU's associative-scan
     # unroll keeps full backprop regardless (documented in ARCHITECTURE.md).
     fused_sequence: bool = True
+    # Pallas backward arms for the fused sequence unroll (config.
+    # seq_fused_dwh / seq_grad_checkpoint; ops/pallas_lstm.py). LSTM core
+    # + pallas backend only; both default OFF (default path bit-identical).
+    seq_fused_dwh: bool = False
+    seq_grad_checkpoint: int = 0
     # multi-task head conditioning (config.num_tasks): > 1 widens the
     # dueling-head input by a one-hot task embedding and (with
     # task_action_dims set) masks each task's invalid action tail out of
@@ -95,6 +100,8 @@ class R2D2Network(nn.Module):
             lru_r_min=cfg.lru_r_min,
             lru_r_max=cfg.lru_r_max,
             fused_sequence=cfg.fused_sequence,
+            seq_fused_dwh=cfg.seq_fused_dwh,
+            seq_grad_checkpoint=cfg.seq_grad_checkpoint,
             num_tasks=cfg.num_tasks,
             task_action_dims=tuple(cfg.task_action_dims),
         )
@@ -117,6 +124,8 @@ class R2D2Network(nn.Module):
                 dtype=dtype,
                 scan_chunk=self.scan_chunk,
                 backend=self.lstm_backend,
+                fused_dwh=self.seq_fused_dwh,
+                grad_checkpoint=self.seq_grad_checkpoint,
             )
         else:
             raise ValueError(f"unknown recurrent_core {self.recurrent_core!r}")

@@ -14,5 +14,20 @@ from r2d2_tpu.parallel.mesh import (
     replicated_sharding,
     shard_batch,
 )
+from r2d2_tpu.parallel.sharding_map import (
+    DEFAULT_RULES,
+    serve_param_shardings,
+    train_state_shardings,
+    tree_shardings,
+)
 
-__all__ = ["make_mesh", "batch_sharding", "replicated_sharding", "shard_batch"]
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "shard_batch",
+    "DEFAULT_RULES",
+    "train_state_shardings",
+    "tree_shardings",
+    "serve_param_shardings",
+]

@@ -25,15 +25,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def make_mesh(
-    dp: Optional[int] = None, tp: int = 1, devices: Optional[Sequence] = None
+    dp: Optional[int] = None,
+    tp: int = 1,
+    devices: Optional[Sequence] = None,
+    fsdp: int = 1,
 ) -> Mesh:
+    """(dp, tp) mesh, growing a third "fsdp" axis when fsdp > 1.
+
+    The fsdp axis shards optimizer-state moments (parallel/sharding_map
+    spec rules); with fsdp == 1 the mesh keeps its historical two-axis
+    shape so every existing P("dp")/P("tp") spec and shard_map
+    axis_names={"dp"} plane is untouched."""
     devices = list(devices if devices is not None else jax.devices())
+    if fsdp < 1:
+        raise ValueError(f"fsdp must be >= 1, got {fsdp}")
     if dp is None:
-        dp = len(devices) // tp
-    if dp * tp != len(devices):
-        raise ValueError(f"dp*tp = {dp * tp} != {len(devices)} devices")
-    dev_array = np.asarray(devices).reshape(dp, tp)
-    return Mesh(dev_array, axis_names=("dp", "tp"))
+        dp = len(devices) // (tp * fsdp)
+    if dp * tp * fsdp != len(devices):
+        raise ValueError(
+            f"dp*tp*fsdp = {dp * tp * fsdp} != {len(devices)} devices"
+        )
+    if fsdp == 1:
+        return Mesh(np.asarray(devices).reshape(dp, tp), axis_names=("dp", "tp"))
+    return Mesh(
+        np.asarray(devices).reshape(dp, tp, fsdp),
+        axis_names=("dp", "tp", "fsdp"),
+    )
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -72,64 +89,27 @@ def shard_batch(mesh: Mesh, batch_pytree):
     return jax.tree.map(lambda x: jax.device_put(x, sh), batch_pytree)
 
 
-def train_state_shardings(state, mesh: Mesh):
-    """Per-leaf NamedShardings for a TrainState: every dense matmul in the
-    model shards over tp in Megatron column/row pairs; with tp=1 this
-    degenerates to fully-replicated, so it is safe to apply
-    unconditionally on any mesh.
+def train_state_shardings(state, mesh: Mesh, rules=None):
+    """Per-leaf NamedShardings for a TrainState — now data-driven.
 
-    The pairing (one collective per pair, inserted by GSPMD from the
-    annotations alone):
-    - LSTM `wi`/`wh` (in, 4H) + bias `b`: COLUMN-parallel — each tp shard
-      owns a 4H/tp slice of every gate; the recurrence's h feeding back
-      into wh re-gathers once per step (the scan's unavoidable tp
-      collective).
-    - encoder `Dense_0` (3136, 512) + bias: COLUMN-parallel (the largest
-      single matmul in the model).
-    - dueling `adv_hidden`/`val_hidden` (H, H) + biases: COLUMN-parallel,
-      paired with `adv_out`/`val_out` (H, A)/(H, 1): ROW-parallel — the
-      contraction over the sharded H axis psums, so each head pair costs
-      one all-reduce and no intermediate gather.
-    - conv kernels stay REPLICATED deliberately: the Nature/IMPALA stacks
-      top out at 64/32 output channels — a tp=2 split leaves 16-32
-      channel shards whose collective cost exceeds the FLOPs they save on
-      the MXU. The convs' FLOPs share is also dominated by the batched
-      seq dimension, which dp already covers.
+    The Megatron column/row layout that used to be hardcoded here as name
+    sets lives in parallel/sharding_map.DEFAULT_RULES, an ordered table of
+    wildcard param-name patterns -> mesh-axis tuples, which also carries
+    the fsdp rule for optimizer-state moments and the serve plane's int8
+    placement. This wrapper keeps the historical import site/signature;
+    see sharding_map.py for the pattern grammar, the per-layer rationale,
+    and the tp/fsdp axis semantics.
 
-    Scope: everywhere except multihost. On the plain-jit learner paths
-    (host/device planes) XLA/GSPMD partitions the matmuls and inserts the
-    tp collectives from these annotations alone (compile-level
-    partitioning is pinned by tests/test_learner.py). The "sharded"
-    shard_map paths are manual over dp only (axis_names={"dp"}), so
-    inside each dp shard the SAME annotations partition the update body
-    over the GSPMD-auto tp axis (dp×tp parity pinned by
-    tests/test_sharded_replay.py / test_sharded_megastep.py). The
-    multihost plane keeps params replicated per its P() in_specs.
+    Scope is unchanged: everywhere except multihost. Plain-jit planes
+    partition from these annotations alone; the "sharded" shard_map
+    planes are manual over dp only (axis_names={"dp"}) with tp GSPMD-auto
+    (dp×tp parity pinned by tests/test_sharded_replay.py /
+    test_sharded_megastep.py); multihost keeps params replicated per its
+    P() in_specs. Adam's mu/nu mirror the param tree structure, so the
+    same wildcard rules shard them consistently."""
+    from r2d2_tpu.parallel.sharding_map import train_state_shardings as _tss
 
-    Adam's mu/nu mirror the param tree structure, so the same path rule
-    shards them consistently (optimizer math is elementwise)."""
-
-    COLUMN = {"wi", "wh", "adv_hidden", "val_hidden", "Dense_0"}
-    ROW = {"adv_out", "val_out"}
-    # bias of a column-parallel layer lives on the sharded output axis
-    COLUMN_BIAS_OWNERS = {"core", "adv_hidden", "val_hidden", "Dense_0"}
-
-    def spec_for(path, leaf):
-        keys = {getattr(p, "key", getattr(p, "name", "")) for p in path}
-        if leaf.ndim == 2:
-            if keys & COLUMN:
-                return P(None, "tp")
-            if keys & ROW:
-                return P("tp", None)
-        if leaf.ndim == 1 and keys & {"b", "bias"} and keys & COLUMN_BIAS_OWNERS:
-            return P("tp")
-        return P()
-
-    import jax.tree_util as jtu
-
-    return jtu.tree_map_with_path(
-        lambda p, l: NamedSharding(mesh, spec_for(p, l)), state
-    )
+    return _tss(state, mesh, rules)
 
 
 def tp_probe_kernel(params):

@@ -1,0 +1,176 @@
+"""Data-driven per-param sharding: wildcard name patterns -> mesh axes.
+
+Replaces the hardcoded Megatron name-sets that used to live inside
+`parallel/mesh.train_state_shardings` with an explicit, inspectable map
+from wildcarded dotted param names to partition-axis tuples — the idiom
+large-model serving stacks use for per-weight sharding tables, adapted to
+this repo's tp×fsdp mesh.
+
+Pattern grammar
+---------------
+A leaf's *name* is its pytree path joined with dots, with every integer
+path component collapsed to ``*``:
+
+    TrainState.params['params']['core']['wi']        -> params.params.core.wi
+    opt_state[1][0].mu['params']['core']['wi']       -> opt_state.*.*.mu.params.core.wi
+
+Rules are an ordered sequence of ``(pattern, axes)`` pairs matched with
+fnmatch semantics (``*`` crosses dots); the FIRST match wins and anything
+unmatched is replicated. ``axes`` is a PartitionSpec-style tuple over the
+leaf's dims using mesh axis names ("tp", "fsdp") or None.
+
+Axis semantics
+--------------
+tp    Megatron tensor parallelism, exactly the rules the old name-sets
+      encoded: column-parallel (None, "tp") for the LSTM gate kernels /
+      encoder Dense_0 / dueling hiddens (+ their biases on the sharded
+      output axis), row-parallel ("tp", None) for the head outs, convs
+      replicated (see DEFAULT_RULES below for the per-layer rationale,
+      inherited from the old docstring).
+fsdp  optimizer-state sharding (ZeRO-1 style): when the mesh carries an
+      fsdp axis of size > 1, the Adam mu/nu moment leaves — the
+      next-largest HBM residents after backward residuals — additionally
+      shard their first still-unsharded, divisible dim over "fsdp".
+      Params and target_params stay REPLICATED over fsdp: gradients are
+      computed from whole params (no gather in the backward); only the
+      moment update runs sharded. The rule is positional (``.mu.`` /
+      ``.nu.`` in the name), so it composes with any param-level rules
+      without per-layer duplication.
+
+int8 serve weights flow through the same table: `quantize_tree` replaces a
+kernel leaf with a ``{"q8", "scale"}`` dict, so the q8 leaf's name is the
+kernel's name plus a suffix — the ``kernel*`` wildcards below cover both,
+and the per-output-channel scale of the ROW-parallel heads gets an
+explicit replicated entry (its (1, out) shape has no input dim to shard).
+
+Topology note: the fsdp axis shards *state*, never the replay layout —
+snapshot topology manifests record (plane, dp, tp, process_count) only
+(replay/snapshot.py), so changing --fsdp across --resume/--reshard never
+trips TopologyMismatch (pinned by tests/test_sharding_map.py).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Optional, Sequence, Tuple
+
+import jax.tree_util as jtu
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (pattern, axes) in priority order — first match wins. Rationale for the
+# tp choices (inherited from the old hardcoded sets): the LSTM gate
+# kernels and encoder Dense_0 are the wide matmuls worth splitting;
+# hidden/out head pairs form column/row Megatron pairs costing one
+# all-reduce each; conv kernels stay replicated because 16-64 output
+# channels shard into slivers whose collective cost exceeds the saved
+# FLOPs (dp already covers the conv's batch-dominated FLOPs).
+DEFAULT_RULES: Tuple[Tuple[str, Tuple[Optional[str], ...]], ...] = (
+    # ROW-parallel head-out scales first: (1, out) has no input dim, the
+    # generic kernel* row rule below must not claim it
+    ("*.adv_out.kernel.scale", ()),
+    ("*.val_out.kernel.scale", ()),
+    # LSTM core: column-parallel gates + bias on the sharded 4H axis
+    ("*.core.wi", (None, "tp")),
+    ("*.core.wh", (None, "tp")),
+    ("*.core.b", ("tp",)),
+    # encoder Dense_0 (the largest single matmul): column-parallel
+    ("*.Dense_0.kernel*", (None, "tp")),
+    ("*.Dense_0.bias", ("tp",)),
+    # dueling hiddens: column-parallel, paired with row-parallel outs
+    ("*.adv_hidden.kernel*", (None, "tp")),
+    ("*.adv_hidden.bias", ("tp",)),
+    ("*.val_hidden.kernel*", (None, "tp")),
+    ("*.val_hidden.bias", ("tp",)),
+    ("*.adv_out.kernel*", ("tp", None)),
+    ("*.val_out.kernel*", ("tp", None)),
+)
+
+# name markers of the Adam moment subtrees the fsdp axis shards
+_MOMENT_MARKERS = (".mu.", ".nu.")
+
+
+def process_name(path) -> str:
+    """Pytree path -> dotted name with integer components collapsed to *.
+
+    Accepts the key objects jax.tree_util emits (GetAttrKey / DictKey /
+    SequenceKey / FlattenedIndexKey); integer keys — tuple positions in
+    the optax chain, list indices — become ``*`` so one pattern covers
+    every stacked/replicated instance (SNIPPETS idiom)."""
+    parts = []
+    for k in path:
+        v = getattr(k, "name", None)
+        if v is None:
+            v = getattr(k, "key", None)
+        if v is None:
+            v = getattr(k, "idx", None)
+        if isinstance(v, int) or (isinstance(v, str) and v.isdigit()):
+            parts.append("*")
+        else:
+            parts.append(str(v))
+    return ".".join(parts)
+
+
+def match_axes(
+    name: str, rules: Sequence[Tuple[str, Tuple[Optional[str], ...]]]
+) -> Tuple[Optional[str], ...]:
+    """First-match lookup of a processed name against the rule table."""
+    for pattern, axes in rules:
+        if fnmatch.fnmatchcase(name, pattern):
+            return tuple(axes)
+    return ()
+
+
+def spec_for(name: str, leaf, mesh: Mesh, rules=None) -> P:
+    """PartitionSpec for one leaf: tp rules from the table, then the
+    positional fsdp rule for optimizer-moment leaves."""
+    rules = DEFAULT_RULES if rules is None else rules
+    axes = list(match_axes(name, rules))
+    ndim = getattr(leaf, "ndim", 0)
+    # drop axes the mesh does not carry (a tp-only mesh ignores fsdp
+    # entries and vice versa) and anything past the leaf's rank
+    axes = [
+        a if (a is None or a in mesh.axis_names) else None for a in axes
+    ][:ndim]
+    if (
+        "fsdp" in mesh.axis_names
+        and mesh.shape["fsdp"] > 1
+        and any(m in name for m in _MOMENT_MARKERS)
+    ):
+        fsdp = mesh.shape["fsdp"]
+        axes = axes + [None] * (ndim - len(axes))
+        for d in range(ndim):
+            if axes[d] is None and leaf.shape[d] % fsdp == 0 and leaf.shape[d] > 0:
+                axes[d] = "fsdp"
+                break
+    # emit the rule's axes verbatim (trailing Nones included) so the
+    # table reads back exactly as the old hardcoded layout spelled it
+    return P(*axes)
+
+
+def tree_shardings(tree, mesh: Mesh, rules=None):
+    """Per-leaf NamedShardings for ANY pytree (params, a full TrainState,
+    a quantized serve tree) via the wildcard table."""
+    return jtu.tree_map_with_path(
+        lambda p, l: NamedSharding(mesh, spec_for(process_name(p), l, mesh, rules)),
+        tree,
+    )
+
+
+def train_state_shardings(state, mesh: Mesh, rules=None):
+    """Per-leaf NamedShardings for a TrainState over the wildcard table.
+
+    Drop-in successor of the old hardcoded implementation: on a (dp, tp)
+    mesh the DEFAULT_RULES reproduce its Megatron column/row layout
+    exactly (pinned by tests/test_sharding_map.py), and with tp=1 it
+    degenerates to fully-replicated, so it is safe on any mesh. On a mesh
+    carrying an fsdp axis, the Adam mu/nu trees additionally shard over
+    it (see module docstring)."""
+    return tree_shardings(state, mesh, rules)
+
+
+def serve_param_shardings(params, mesh: Mesh, rules=None):
+    """Shardings for a serve-plane param tree — possibly int8-quantized
+    (ops/quantize.py): q8/scale leaves inherit the kernel's rules through
+    the ``kernel*`` wildcards, so one table drives train AND serve
+    placement."""
+    return tree_shardings(params, mesh, rules)

@@ -176,6 +176,7 @@ class PolicyServer:
         checkpoint_dir: Optional[str] = None,
         metrics: Optional[MetricsLogger] = None,
         device=None,
+        mesh=None,
         name: str = "",
     ):
         self.cfg = cfg
@@ -185,6 +186,14 @@ class PolicyServer:
         # replica placement (serve/multi.py): params + session rows live on
         # exactly this device; None keeps jax's default (single-device)
         self.device = device
+        # sharded placement: a Mesh routes every publish — including the
+        # int8-quantized tree, whose q8/scale leaves inherit the kernel
+        # rules — through parallel/sharding_map.serve_param_shardings, the
+        # SAME wildcard table the learner shards from. Mutually exclusive
+        # with `device` (one replica is either pinned or mesh-spread).
+        if mesh is not None and device is not None:
+            raise ValueError("pass device= or mesh=, not both")
+        self.mesh = mesh
         # worker-name suffix so multi-device supervisors tell replicas apart
         self.name = name
 
@@ -315,7 +324,12 @@ class PolicyServer:
             )
         elif arm != "full":
             raise ValueError(f"unknown serve arm {arm!r}")
-        if self.device is not None:
+        if self.mesh is not None:
+            from r2d2_tpu.parallel.sharding_map import serve_param_shardings
+
+            params = jax.device_put(
+                params, serve_param_shardings(params, self.mesh))
+        elif self.device is not None:
             params = jax.device_put(params, self.device)
         return params, leaves, arm
 

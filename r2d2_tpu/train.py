@@ -696,9 +696,15 @@ class Trainer:
             self.mesh = make_global_mesh(
                 dp=cfg.dp_size if cfg.dp_size > 1 else None, tp=1
             )
-        elif cfg.dp_size * cfg.tp_size > 1:
+        elif cfg.dp_size * cfg.tp_size * cfg.fsdp_size > 1:
+            # fsdp > 1 grows the third mesh axis that shards the Adam
+            # mu/nu trees (parallel/sharding_map.py); the replay layout
+            # stays dp-determined, so --resume/--reshard snapshots are
+            # fsdp-agnostic (their topology manifests record dp/tp only).
+            n_mesh = cfg.dp_size * cfg.tp_size * cfg.fsdp_size
             self.mesh = make_mesh(dp=cfg.dp_size, tp=cfg.tp_size,
-                                  devices=jax.devices()[: cfg.dp_size * cfg.tp_size])
+                                  devices=jax.devices()[:n_mesh],
+                                  fsdp=cfg.fsdp_size)
 
         self.net, self.state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
         if self.mesh is not None:
@@ -1595,6 +1601,11 @@ def main(argv=None):
                    help="data-parallel mesh size (overrides preset dp_size)")
     p.add_argument("--tp", type=int, default=None,
                    help="tensor-parallel mesh size (overrides preset tp_size)")
+    p.add_argument("--fsdp", type=int, default=None,
+                   help="fsdp mesh-axis size (overrides preset fsdp_size): "
+                        "shards the Adam mu/nu trees over a third mesh axis "
+                        "(parallel/sharding_map.py); replay snapshots are "
+                        "fsdp-agnostic, so --resume/--reshard compose freely")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--reshard", action="store_true",
                    help="on --resume, a replay snapshot saved under a "
@@ -1656,6 +1667,8 @@ def main(argv=None):
         overrides["dp_size"] = args.dp
     if args.tp is not None:
         overrides["tp_size"] = args.tp
+    if args.fsdp is not None:
+        overrides["fsdp_size"] = args.fsdp
     if args.updates_per_dispatch is not None:
         overrides["updates_per_dispatch"] = args.updates_per_dispatch
         # convenience only for the single-chip default: never silently

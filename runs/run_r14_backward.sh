@@ -1,0 +1,83 @@
+#!/bin/bash
+# Round-14 backward-pass kernel chain: the measurement side of the
+# fused-dWh / gradient-checkpointed backward arms + per-param sharding
+# map PR. Four rungs, each one JSON line appended to
+# runs/bench_backward_r14.jsonl:
+#
+#   1. backward gate — the grad-parity suites for both arms (fused dWh
+#      bitwise dproj + one-ulp dWh; checkpointed one-ulp at every
+#      divisor S; exact-zero burn-in seam at and inside segment
+#      boundaries), the sharding-map parity/fsdp tests, and the static
+#      analysis CLI (the backward-arm jaxprs are traced at fp32 AND bf16
+#      with a 3-launch budget and donation check). A parity regression
+#      aborts the chain: a wrong gradient's speedup is noise.
+#   2. breakdown (default arm) — per-phase step timing with the vs_r09
+#      column (per-phase deltas against BENCH_r09.json) and the
+#      backward_arms residual table: peak_residual_bytes per arm at the
+#      benched shapes, with the ckpt arm's carry bytes scaling as T/S.
+#   3. breakdown (pallas arms) — the same timing with the fused-dWh and
+#      checkpointed backward kernels actually on the step's critical
+#      path. TPU-gated: on CPU pallas runs in interpret mode and the
+#      timings say nothing, so the rung is skipped (rung 2's analytic
+#      residual rows already cover every arm on any host).
+#   4. fsdp smoke — one short train.py run with --fsdp 2 over faked host
+#      devices: optimizer-state (mu/nu) sharded over the third mesh
+#      axis through the same wildcard table, checkpoint save/resume
+#      crossing an fsdp-topology change without TopologyMismatch.
+#
+# PRE-REGISTERED read: rung 2's loss_grad.frac_of_step <= r09's 0.965
+# on the same host class, and rung 3's (TPU) loss_grad ms dropping
+# under both pallas arms with the ckpt arm's peak_residual_bytes at
+# ~(1/S + dz) of the default arm's — the BENCH_r14 headline.
+cd /root/repo
+
+. runs/lib.sh
+
+OUT=runs/bench_backward_r14.jsonl
+: > "$OUT"
+
+echo "=== RUNG 1: backward + sharding gate ==="
+python -m pytest tests/test_pallas_lstm.py tests/test_sharding_map.py \
+  -q -p no:cacheprovider
+RC=$?
+echo "=== BACKWARD_PYTEST EXIT: $RC ==="
+python -m r2d2_tpu.analysis.cli --jaxpr
+RCA=$?
+echo "=== ANALYSIS EXIT: $RCA ==="
+if [ $RC -ne 0 ] || [ $RCA -ne 0 ]; then
+  echo "=== ABORT: backward gate failed; bench rows would be noise ==="
+  exit 1
+fi
+
+echo "=== RUNG 2: breakdown, default arm (vs_r09 + residual table) ==="
+python bench.py --mode breakdown --batch 8 | tee -a "$OUT"
+echo "=== BREAKDOWN_DEFAULT EXIT: $? ==="
+
+if python -c 'import jax, sys; sys.exit(0 if jax.default_backend() == "tpu" else 1)'; then
+  echo "=== RUNG 3: breakdown, pallas backward arms ==="
+  python bench.py --mode breakdown --batch 8 --backward-arm fused_dwh | tee -a "$OUT"
+  echo "=== BREAKDOWN_FUSED_DWH EXIT: $? ==="
+  python bench.py --mode breakdown --batch 8 --backward-arm ckpt | tee -a "$OUT"
+  echo "=== BREAKDOWN_CKPT EXIT: $? ==="
+else
+  echo "=== RUNG 3 SKIPPED: no TPU (pallas would run in interpret mode) ==="
+fi
+
+echo "=== RUNG 4: fsdp optimizer-state smoke (save/resume across --fsdp) ==="
+CKPT=runs/r14_fsdp_smoke
+rm -rf "$CKPT"
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python -m r2d2_tpu.train --preset tiny_test --env catch --mode inline \
+  --dp 4 --fsdp 2 --steps 30 \
+  --set checkpoint_dir="$CKPT" --set save_interval=15
+echo "=== FSDP_TRAIN EXIT: $? ==="
+# resume under a DIFFERENT fsdp layout: topology manifests record
+# (plane, dp, tp, process layout) only, so this must not trip
+# TopologyMismatch
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python -m r2d2_tpu.train --preset tiny_test --env catch --mode inline \
+  --dp 4 --fsdp 1 --steps 60 --resume \
+  --set checkpoint_dir="$CKPT" --set save_interval=15
+echo "=== FSDP_RESUME EXIT: $? ==="
+
+echo R14_BACKWARD_ALL_DONE

@@ -551,6 +551,40 @@ def test_multitask_train_step_gate_both_precisions():
     assert "scan" in text and "i32[" in text
 
 
+def test_backward_arm_gate_both_precisions():
+    """The alternative backward arms (ISSUE 14: fused-dWh scratch
+    accumulation, S-step gradient checkpointing) trace clean at fp32 AND
+    bf16 under every jaxpr checker, hold the default path's exact
+    3-launch budget (the memory savings must not buy extra launches), and
+    still donate the full TrainState despite the changed residual set."""
+    from r2d2_tpu.analysis import jaxpr_rules
+
+    for precision in ("fp32", "bf16"):
+        findings = jaxpr_rules.scan_backward_arms(precision)
+        assert findings == [], render_text(findings)
+    for arm in ("fused_dwh", "ckpt"):
+        text = jaxpr_rules.backward_arm_train_step_jaxpr("fp32", arm)
+        assert text.count("pallas_call") == 3
+    # the ckpt trace must NOT carry the default arm's full (T*B)xH
+    # h-sequence residual matmul: its dWh comes out of the kernel
+    ckpt = jaxpr_rules.backward_arm_train_step_jaxpr("fp32", "ckpt")
+    assert "pallas_call" in ckpt
+
+
+def test_kernel_launch_count_checker_fires_on_budget_overrun():
+    """Negative fixture for the per-arm launch budget: a program with one
+    launch too many (the classic regression: dWh split back out into a
+    4th launch) is a finding; the exact budget is clean."""
+    from r2d2_tpu.analysis import jaxpr_rules as j
+
+    four = "\n".join(f"a{i}:f32[2] = pallas_call[...] b" for i in range(4))
+    three = "\n".join(f"a{i}:f32[2] = pallas_call[...] b" for i in range(3))
+    assert rules_of(j.check_kernel_launch_count(four, "t", 3, "step")) == [
+        "jaxpr-kernel-launch-count"
+    ]
+    assert j.check_kernel_launch_count(three, "t", 3, "step") == []
+
+
 def test_host_sync_fires_in_multitask_serve_batch_loop():
     """The per-request task gather in serve _run_batch is the shape most
     likely to regress into a host sync: device-array conversion inside the

@@ -11,7 +11,13 @@ import pytest
 
 from r2d2_tpu.config import tiny_test
 from r2d2_tpu.models.lstm import LSTM
-from r2d2_tpu.ops.pallas_lstm import lstm_seq_unroll, lstm_unroll
+from r2d2_tpu.ops.pallas_lstm import (
+    lstm_seq_unroll,
+    lstm_seq_unroll_ckpt,
+    lstm_seq_unroll_fused_dwh,
+    lstm_unroll,
+    seq_backward_residual_bytes,
+)
 
 pytestmark = pytest.mark.kernels
 
@@ -305,3 +311,287 @@ class TestFusedSequence:
         assert scan_fused_unroll("fp32") == []
         assert fused_unroll_jaxpr("fp32").count("pallas_call") == 1
         assert fused_train_step_jaxpr("fp32").count("pallas_call") == 3
+
+
+# --------------------------------------------------------------------------
+# alternative backward arms (ISSUE 14): fused-dWh and checkpointed kernels
+# --------------------------------------------------------------------------
+
+
+def _seam_loss(fn, proj_t, wh, h0, c0, burn):
+    outs, (hT, cT) = fn(proj_t, wh, h0, c0, burn)
+    return jnp.sum(outs.astype(jnp.float32) ** 2) + jnp.sum(
+        hT.astype(jnp.float32) * cT.astype(jnp.float32)
+    )
+
+
+class TestFusedDwhArm:
+    """lstm_seq_unroll_fused_dwh: dWh accumulated in VMEM scratch inside
+    the reversed backward kernel — no outside (T·B,H)ᵀ@(T·B,4H) matmul,
+    no full-size f32 dz in HBM. Forward and dproj are the SAME program as
+    the default arm, so those are bitwise; dWh differs only in summation
+    order (per-step scratch += vs one big matmul)."""
+
+    def test_forward_bit_identical_to_default_arm(self):
+        proj_t, wh, h0, c0 = _rand_inputs(np.random.default_rng(20))
+        burn = jnp.asarray(_BURN)
+        outs_a, (hT_a, cT_a) = lstm_seq_unroll(proj_t, wh, h0, c0, burn)
+        outs_b, (hT_b, cT_b) = lstm_seq_unroll_fused_dwh(proj_t, wh, h0, c0, burn)
+        assert np.array_equal(np.asarray(outs_a), np.asarray(outs_b))
+        assert np.array_equal(np.asarray(hT_a), np.asarray(hT_b))
+        assert np.array_equal(np.asarray(cT_a), np.asarray(cT_b))
+
+    def test_grads_match_default_arm_fp32(self):
+        """dproj is bitwise (identical dz program); dWh within a few ulp
+        (summation order only); dh0/dc0 exact zeros on both arms."""
+        proj_t, wh, h0, c0 = _rand_inputs(np.random.default_rng(21))
+        burn = jnp.asarray(_BURN)
+        g_d = jax.grad(
+            lambda *a: _seam_loss(lstm_seq_unroll, *a, burn), argnums=(0, 1, 2, 3)
+        )(proj_t, wh, h0, c0)
+        g_f = jax.grad(
+            lambda *a: _seam_loss(lstm_seq_unroll_fused_dwh, *a, burn),
+            argnums=(0, 1, 2, 3),
+        )(proj_t, wh, h0, c0)
+        assert np.array_equal(np.asarray(g_d[0]), np.asarray(g_f[0]))  # dproj
+        np.testing.assert_allclose(
+            np.asarray(g_d[1]), np.asarray(g_f[1]), rtol=1e-5, atol=1e-6
+        )
+        assert not np.asarray(g_f[2]).any() and not np.asarray(g_f[3]).any()
+
+    def test_exact_zero_below_seam(self):
+        """The seam contract carries over verbatim: dproj rows strictly
+        below each row's burn are EXACT zeros (the masked dz contributes
+        exact zeros to the scratch dWh too)."""
+        proj_t, wh, h0, c0 = _rand_inputs(np.random.default_rng(22))
+        burn = jnp.asarray(_BURN)
+        dproj = jax.grad(
+            lambda *a: _seam_loss(lstm_seq_unroll_fused_dwh, *a, burn)
+        )(proj_t, wh, h0, c0)
+        dproj = np.asarray(dproj)
+        for b, bi in enumerate(_BURN):
+            assert not dproj[:bi, b, :].any(), f"row {b}: leak below seam {bi}"
+            if bi < dproj.shape[0]:
+                assert dproj[bi:, b, :].any()
+
+    def test_grads_match_seam_scan_reference(self):
+        proj_t, wh, h0, c0 = _rand_inputs(np.random.default_rng(23))
+        burn = jnp.asarray(_BURN)
+        for wrt in (0, 1):
+            g_k = jax.grad(
+                lambda *a: _seam_loss(lstm_seq_unroll_fused_dwh, *a, burn),
+                argnums=wrt,
+            )(proj_t, wh, h0, c0)
+            g_s = jax.grad(
+                lambda *a: _seam_loss(_seam_scan_reference, *a, burn), argnums=wrt
+            )(proj_t, wh, h0, c0)
+            np.testing.assert_allclose(
+                np.asarray(g_k), np.asarray(g_s), rtol=1e-4, atol=1e-5
+            )
+
+
+class TestCheckpointedArm:
+    """lstm_seq_unroll_ckpt(S): residuals are every-S-step (h, c) carries
+    only — O((T/S)·B·H) instead of O(T·B·H) — and the backward kernel
+    recomputes each segment's gates from its checkpoint before walking it
+    in reverse. dWh is inherently fused (the full h sequence never exists
+    in HBM)."""
+
+    def test_forward_bit_identical_to_default_arm(self):
+        proj_t, wh, h0, c0 = _rand_inputs(np.random.default_rng(30))
+        burn = jnp.asarray(_BURN)
+        outs_a, (hT_a, cT_a) = lstm_seq_unroll(proj_t, wh, h0, c0, burn)
+        outs_b, (hT_b, cT_b) = lstm_seq_unroll_ckpt(2)(proj_t, wh, h0, c0, burn)
+        assert np.array_equal(np.asarray(outs_a), np.asarray(outs_b))
+        assert np.array_equal(np.asarray(hT_a), np.asarray(hT_b))
+        assert np.array_equal(np.asarray(cT_a), np.asarray(cT_b))
+
+    @pytest.mark.parametrize("S", [1, 2, 3, 6])
+    def test_grads_match_default_arm_fp32(self, S):
+        """Every divisor segment length, including the degenerate S=1
+        (checkpoint every step — pure recompute overhead, same math) and
+        S=T (one segment — the whole unroll recomputed from h0/c0). The
+        recompute replays identical f32 ops, but XLA fuses the two
+        programs differently, so parity is one-ulp-tight, not bitwise."""
+        proj_t, wh, h0, c0 = _rand_inputs(np.random.default_rng(31))
+        burn = jnp.asarray(_BURN)
+        g_d = jax.grad(
+            lambda *a: _seam_loss(lstm_seq_unroll, *a, burn), argnums=(0, 1, 2, 3)
+        )(proj_t, wh, h0, c0)
+        g_c = jax.grad(
+            lambda *a: _seam_loss(lstm_seq_unroll_ckpt(S), *a, burn),
+            argnums=(0, 1, 2, 3),
+        )(proj_t, wh, h0, c0)
+        np.testing.assert_allclose(
+            np.asarray(g_d[0]), np.asarray(g_c[0]), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(g_d[1]), np.asarray(g_c[1]), rtol=1e-5, atol=1e-6
+        )
+        assert not np.asarray(g_c[2]).any() and not np.asarray(g_c[3]).any()
+
+    @pytest.mark.parametrize(
+        "burn_vec",
+        [
+            # seams ON segment boundaries (S=2 over T=6: boundaries 0/2/4)
+            np.array([0, 2, 4, 2, 4, 0, 2, 4], np.int32),
+            # seams strictly INSIDE recomputed segments
+            np.array([1, 3, 5, 1, 3, 5, 1, 3], np.int32),
+            # mixed, plus the all-learn and nearly-all-burn extremes
+            np.array([0, 5, 1, 4, 2, 3, 0, 5], np.int32),
+        ],
+    )
+    def test_seam_exact_zero_at_and_inside_segment_boundaries(self, burn_vec):
+        """The hard case the segment recompute must not soften: a seam
+        landing exactly on an S-boundary (the carry cut coincides with a
+        checkpoint reload) or mid-segment (the cut applies inside the
+        recomputed walk). Below-seam dproj must be EXACT zeros either
+        way."""
+        proj_t, wh, h0, c0 = _rand_inputs(np.random.default_rng(32))
+        burn = jnp.asarray(burn_vec)
+        dproj, dwh, dh0, dc0 = jax.grad(
+            lambda *a: _seam_loss(lstm_seq_unroll_ckpt(2), *a, burn),
+            argnums=(0, 1, 2, 3),
+        )(proj_t, wh, h0, c0)
+        dproj = np.asarray(dproj)
+        for b, bi in enumerate(burn_vec):
+            assert not dproj[:bi, b, :].any(), f"row {b}: leak below seam {bi}"
+            if bi < dproj.shape[0]:
+                assert dproj[bi:, b, :].any(), f"row {b}: train segment empty"
+        assert not np.asarray(dh0).any() and not np.asarray(dc0).any()
+        assert np.asarray(dwh).any()
+
+    def test_grads_match_seam_scan_reference(self):
+        proj_t, wh, h0, c0 = _rand_inputs(np.random.default_rng(33))
+        burn = jnp.asarray(_BURN)
+        for wrt in (0, 1):
+            g_k = jax.grad(
+                lambda *a: _seam_loss(lstm_seq_unroll_ckpt(3), *a, burn),
+                argnums=wrt,
+            )(proj_t, wh, h0, c0)
+            g_s = jax.grad(
+                lambda *a: _seam_loss(_seam_scan_reference, *a, burn), argnums=wrt
+            )(proj_t, wh, h0, c0)
+            np.testing.assert_allclose(
+                np.asarray(g_k), np.asarray(g_s), rtol=1e-4, atol=1e-5
+            )
+
+    def test_rejects_non_divisor_segment(self):
+        proj_t, wh, h0, c0 = _rand_inputs(np.random.default_rng(34))
+        burn = jnp.asarray(_BURN)
+        with pytest.raises(ValueError, match="not divisible"):
+            jax.grad(
+                lambda *a: _seam_loss(lstm_seq_unroll_ckpt(4), *a, burn)
+            )(proj_t, wh, h0, c0)
+
+    def test_residual_bytes_scale_with_segment_length(self):
+        """The measurable claim behind the arm: carry residuals shrink by
+        exactly T/S (h at proj dtype + c at f32, per the vjp_fwd's
+        concatenated checkpoint tensors)."""
+        T, B, H = 80, 32, 512
+        full = seq_backward_residual_bytes(T, B, H, jnp.bfloat16)
+        ck = seq_backward_residual_bytes(T, B, H, jnp.bfloat16, ckpt_every=5)
+        assert full["carry_residual_bytes"] == T * B * H * (2 + 4)
+        assert ck["carry_residual_bytes"] == (T // 5) * B * H * (2 + 4)
+        assert full["carry_residual_bytes"] == 5 * ck["carry_residual_bytes"]
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("arm", ["fused_dwh", "ckpt"])
+def test_backward_arm_module_parity(arm, dtype):
+    """Full LSTM module with an arm enabled vs the default pallas path:
+    identical params, seam active, both precisions. fp32 is one-ulp
+    tight; bf16 recompute parity holds by construction (bf16 h round-trip
+    is identity, c checkpoints are f32-exact), so bf16 is ALSO tight
+    against the default arm — the drift-vs-scan class does not widen."""
+    B, T, D, H = 8, 6, 24, tiny_test().hidden_dim
+    kw = dict(hidden_dim=H, in_dim=D, dtype=dtype, backend="pallas")
+    default_mod = LSTM(**kw)
+    arm_mod = LSTM(**kw, fused_dwh=True) if arm == "fused_dwh" else LSTM(
+        **kw, grad_checkpoint=3
+    )
+    rng = np.random.default_rng(40)
+    xs = jnp.asarray(rng.normal(size=(B, T, D)).astype(np.float32))
+    carry = (
+        jnp.asarray(rng.normal(size=(B, H)).astype(np.float32) * 0.2),
+        jnp.asarray(rng.normal(size=(B, H)).astype(np.float32) * 0.2),
+    )
+    burn = jnp.asarray(np.minimum(_BURN, T - 1))
+    params = default_mod.init(jax.random.PRNGKey(3), xs, carry)
+
+    outs_d, _ = default_mod.apply(params, xs, carry, burn_in=burn)
+    outs_a, _ = arm_mod.apply(params, xs, carry, burn_in=burn)
+    assert np.array_equal(np.asarray(outs_d), np.asarray(outs_a))  # fwd bitwise
+
+    def loss(mod, p):
+        outs, _ = mod.apply(p, xs, carry, burn_in=burn)
+        return jnp.sum(jnp.tanh(outs.astype(jnp.float32)))
+
+    g_d = jax.tree.leaves(jax.grad(lambda p: loss(default_mod, p))(params))
+    g_a = jax.tree.leaves(jax.grad(lambda p: loss(arm_mod, p))(params))
+    for a, b in zip(g_a, g_d):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-5, atol=2e-6,
+        )
+
+
+def test_backward_arm_launch_budget():
+    """Each armed train step holds the default path's exact 3-launch
+    budget — the fused dWh and the segment recompute live INSIDE the one
+    backward launch, they do not buy extra launches."""
+    from r2d2_tpu.analysis.jaxpr_rules import (
+        backward_arm_train_step_jaxpr,
+        scan_backward_arms,
+    )
+
+    assert scan_backward_arms("fp32") == []
+    for arm in ("fused_dwh", "ckpt"):
+        assert backward_arm_train_step_jaxpr("fp32", arm).count("pallas_call") == 3
+
+
+class TestScanChunkRemainder:
+    """scan_chunk no longer requires chunk | T: the tail runs as one
+    shorter remat'd chunk (models/lstm.py), so live-loop sequence lengths
+    don't have to be multiples of the checkpoint chunk."""
+
+    @pytest.mark.parametrize("chunk", [3, 4, 5, 7, 10, 11])
+    def test_remainder_chunks_match_plain_scan(self, chunk):
+        B, T, D, H = 4, 10, 12, 16
+        plain = LSTM(hidden_dim=H, in_dim=D, backend="scan")
+        chunked = LSTM(hidden_dim=H, in_dim=D, backend="scan", scan_chunk=chunk)
+        rng = np.random.default_rng(50)
+        xs = jnp.asarray(rng.normal(size=(B, T, D)).astype(np.float32))
+        carry = (jnp.zeros((B, H), jnp.float32), jnp.zeros((B, H), jnp.float32))
+        burn = jnp.asarray([0, 3, 6, 9], jnp.int32)
+        params = plain.init(jax.random.PRNGKey(4), xs, carry)
+
+        def loss(mod, p):
+            outs, _ = mod.apply(p, xs, carry, burn_in=burn)
+            return jnp.sum(outs**2)
+
+        np.testing.assert_allclose(
+            np.asarray(plain.apply(params, xs, carry, burn_in=burn)[0]),
+            np.asarray(chunked.apply(params, xs, carry, burn_in=burn)[0]),
+            atol=1e-6,
+        )
+        g_a = jax.tree.leaves(jax.grad(lambda p: loss(plain, p))(params))
+        g_b = jax.tree.leaves(jax.grad(lambda p: loss(chunked, p))(params))
+        for a, b in zip(g_a, g_b):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )
+
+    def test_remainder_without_burn_in(self):
+        B, T, D, H = 2, 7, 8, 16
+        plain = LSTM(hidden_dim=H, in_dim=D, backend="scan")
+        chunked = LSTM(hidden_dim=H, in_dim=D, backend="scan", scan_chunk=4)
+        rng = np.random.default_rng(51)
+        xs = jnp.asarray(rng.normal(size=(B, T, D)).astype(np.float32))
+        carry = (jnp.zeros((B, H), jnp.float32), jnp.zeros((B, H), jnp.float32))
+        params = plain.init(jax.random.PRNGKey(5), xs, carry)
+        outs_a, (h_a, c_a) = plain.apply(params, xs, carry)
+        outs_b, (h_b, c_b) = chunked.apply(params, xs, carry)
+        np.testing.assert_allclose(np.asarray(outs_a), np.asarray(outs_b), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(h_a), np.asarray(h_b), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(c_a), np.asarray(c_b), atol=1e-6)
