@@ -1529,6 +1529,338 @@ def serve_main(
     print(json.dumps(row))
 
 
+def _rate_window(server, sessions: int, rate: float, seconds: float,
+                 slo_ms: float, seed: int, seen: set) -> dict:
+    """One open-loop Poisson window at a FIXED arrival rate against an
+    ALREADY-RUNNING server — the rate search's unit probe. Unlike
+    _serve_load the server (compiled buckets, carry cache, session
+    population) persists across windows, so each probe costs only its own
+    wall-clock; `seen` carries session novelty across windows so only the
+    first window pays the new-session reset wave. The window ends with a
+    bounded drain wait, so an overloaded probe's queue can't leak latency
+    into the NEXT probe's numbers.
+
+    Returns one trace row: offered rate, measured requests/s, p50/p99,
+    and slo_attainment where a rejected, failed, or never-resolved
+    request is a miss — not an absent sample."""
+    from r2d2_tpu.serve import QueueFullError
+
+    rng = np.random.default_rng(seed)
+    records: list = []
+    submitted = [0]
+    session_obs: dict = {}
+    t0 = time.perf_counter()
+    next_t = t0
+    deadline = t0 + seconds
+    while True:
+        next_t += rng.exponential(1.0 / rate)
+        if next_t >= deadline:
+            break
+        now = time.perf_counter()
+        if next_t > now:
+            time.sleep(next_t - now)
+        i = int(rng.integers(0, sessions))
+        obs = session_obs.get(i)
+        if obs is None:
+            obs = rng.integers(0, 255, server.cfg.obs_shape, dtype=np.uint8)
+            session_obs[i] = obs
+        sid = f"rate-{i}"
+        reset = sid not in seen
+        seen.add(sid)
+        t_sub = time.perf_counter()
+        submitted[0] += 1
+        fut = server.submit(sid, obs, reward=0.0, reset=reset)
+
+        def _done(f, t_sub=t_sub):
+            exc = f.exception()
+            if exc is None:
+                records.append((t_sub - t0, time.perf_counter() - t_sub, None))
+            elif isinstance(exc, QueueFullError):
+                records.append((t_sub - t0, None, "rejected"))
+            else:
+                records.append((t_sub - t0, None, "transport"))
+
+        fut.add_done_callback(_done)
+    drain_deadline = time.perf_counter() + max(5.0, seconds)
+    while len(records) < submitted[0] and time.perf_counter() < drain_deadline:
+        time.sleep(0.05)
+    snapshot = list(records)  # late callbacks append past this point
+    warmup_s = min(1.0, 0.2 * seconds)
+    measured = [r for r in snapshot if r[0] >= warmup_s]
+    unresolved = max(submitted[0] - len(snapshot), 0)
+    ok = np.sort(np.asarray(
+        [lat for _, lat, _ in measured if lat is not None]))
+    offered = len(measured) + unresolved
+    attained = int(np.count_nonzero(ok <= slo_ms / 1e3)) if ok.size else 0
+    return {
+        "rate": round(rate, 2),
+        "requests_per_sec": round(ok.size / max(seconds - warmup_s, 1e-9), 1),
+        "p50_latency_ms": round(float(np.percentile(ok, 50) * 1e3), 2)
+        if ok.size else None,
+        "p99_latency_ms": round(float(np.percentile(ok, 99) * 1e3), 2)
+        if ok.size else None,
+        "slo_attainment": round(attained / max(offered, 1), 4),
+        "errors": sum(1 for _, _, e in measured if e is not None),
+        "unresolved": unresolved,
+    }
+
+
+def _search_max_rate(window, start_rate: float, slo_target: float,
+                     max_rate: float = 4096.0, bisect_steps: int = 4):
+    """Double-then-bisect search for the highest arrival rate whose
+    window still attains the SLO target. Doubling finds the bracket (the
+    first failing rate), bisection tightens it; the reported
+    max_rate_at_slo is always the highest rate that actually PASSED a
+    window, never an interpolation. If even start_rate misses, halve
+    down to 1 req/s before giving up at 0."""
+    trace = []
+    rate = start_rate
+    row = window(rate)
+    trace.append(row)
+    while row["slo_attainment"] < slo_target and rate > 1.0:
+        rate /= 2.0
+        row = window(rate)
+        trace.append(row)
+    if row["slo_attainment"] < slo_target:
+        return 0.0, trace
+    lo, hi = rate, None
+    while hi is None and rate < max_rate:
+        rate *= 2.0
+        row = window(rate)
+        trace.append(row)
+        if row["slo_attainment"] >= slo_target:
+            lo = rate
+        else:
+            hi = rate
+    if hi is None:
+        hi = rate * 2.0
+    for _ in range(bisect_steps):
+        if hi - lo <= max(0.05 * lo, 2.0):
+            break
+        mid = (lo + hi) / 2.0
+        row = window(mid)
+        trace.append(row)
+        if row["slo_attainment"] >= slo_target:
+            lo = mid
+        else:
+            hi = mid
+    return lo, trace
+
+
+def _pipeline_parity_probe(core: str, lru_chunk: int) -> bool:
+    """Bitwise pipelined-vs-serial action parity, in-process: one
+    deterministic request stream (recurring sessions, resets, identical
+    batch composition via direct batcher drives) through a serial server
+    (serve_pipeline=False, _run_batch) and through a pipelined server
+    hand-driven at depth 2 (_stage_and_dispatch now, _complete two
+    batches later — the started pipeline's exact overlap, made
+    deterministic). True iff every action and q row matches bit-for-bit.
+    The full matrix (bf16, mixed-task buckets, mid-pipeline reload) lives
+    in tests/test_serve_pipeline.py; this probe pins the benched build."""
+    from collections import deque
+
+    from r2d2_tpu.config import tiny_test
+    from r2d2_tpu.serve import PolicyServer, ServeConfig
+
+    cfg = tiny_test().replace(**_core_overrides(core, lru_chunk)).validate()
+    serve_cfg = ServeConfig(buckets=(2, 4, 8), max_wait_ms=3.0,
+                            cache_capacity=64, epsilon=0.3)
+    stream_rng = np.random.default_rng(77)
+    sids = [f"parity-{i}" for i in range(6)]
+    batches = []
+    for b in range(12):
+        n = 1 + (b % 4)
+        picks = stream_rng.choice(len(sids), size=n, replace=False)
+        batches.append([
+            (sids[int(i)],
+             stream_rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8),
+             float(stream_rng.standard_normal()),
+             bool(stream_rng.integers(0, 4) == 0))
+            for i in picks
+        ])
+
+    def run(pipelined: bool):
+        srv = PolicyServer(cfg.replace(serve_pipeline=pipelined), serve_cfg)
+        srv.warmup()
+        futs, pending = [], deque()
+        for rows in batches:
+            for sid, obs, rew, rs in rows:
+                futs.append(srv.submit(sid, obs, reward=rew, reset=rs))
+            batch = srv.batcher.next_batch(timeout=1.0)
+            if pipelined:
+                if len(pending) == 2:
+                    srv._complete(pending.popleft())
+                pending.append(srv._stage_and_dispatch(batch))
+            else:
+                srv._run_batch(batch)
+        while pending:
+            srv._complete(pending.popleft())
+        out = []
+        for f in futs:
+            res = f.result(timeout=5.0)
+            out.append((res.action, np.asarray(res.q)))
+        srv.stop()
+        return out
+
+    serial, pipe = run(False), run(True)
+    return len(serial) == len(pipe) and all(
+        a == b and np.array_equal(qa, qb)
+        for (a, qa), (b, qb) in zip(serial, pipe)
+    )
+
+
+def serve_rate_search_main(
+    core: str = "lstm",
+    lru_chunk: int = 0,
+    sessions: int = 64,
+    seconds: float = 5.0,
+    slo_ms: float = 50.0,
+    slo_target: float = 0.99,
+    start_rate: float = 32.0,
+    out_path: str = "",
+):
+    """The serving plane's capacity headline: the maximum sustained
+    Poisson arrival rate at which SLO attainment stays >= --slo-target,
+    found by doubling then bisection and A/B'd between the serial serve
+    path (serve_pipeline=False) and the depth-2 staged pipeline (the
+    default). ONE server per arm is built, warmed, and REUSED across
+    every rate window — a fresh server per probe would re-trace 5 buckets
+    (tens of seconds each on CPU) and drown the measurement in compile
+    noise.
+
+    Alongside the A/B: an in-process bitwise action-parity probe (the
+    pipeline must be a scheduling change, not a numerics change) and a
+    two-replica replica-kill scenario cell run with the pipeline ON,
+    whose sessions_lost must be 0 — kill-triggered migration has to drain
+    mid-pipeline batches without dropping carries. --serve-out writes the
+    whole report (the BENCH_r15.json shape)."""
+    from r2d2_tpu.serve import (
+        MultiDeviceServer,
+        PolicyServer,
+        ScenarioRunner,
+        ServeConfig,
+        builtin_scenarios,
+    )
+
+    base_cfg = _system_cfg(core=core, lru_chunk=lru_chunk, precision="fp32")
+    base_cfg = base_cfg.replace(serve_spill=4 * sessions).validate()
+    serve_cfg = ServeConfig(
+        buckets=(2, 4, 8, 16, 32),
+        max_wait_ms=2.0,
+        cache_capacity=max(64, sessions),
+        poll_interval_s=0.5,
+    )
+    arms = {}
+    for arm, pipelined in (("serial", False), ("pipelined", True)):
+        cfg = base_cfg.replace(serve_pipeline=pipelined).validate()
+        server = PolicyServer(cfg, serve_cfg)
+        t0 = time.perf_counter()
+        server.warmup()
+        print(
+            f"[rate-search:{arm}] warmup in {time.perf_counter() - t0:.1f}s",
+            file=sys.stderr,
+        )
+        server.start()
+        try:
+            seen: set = set()
+            widx = [0]
+
+            def window(rate, server=server, seen=seen, widx=widx, arm=arm):
+                widx[0] += 1
+                row = _rate_window(server, sessions, rate, seconds, slo_ms,
+                                   seed=1000 + widx[0], seen=seen)
+                print(
+                    f"[rate-search:{arm}] rate={rate:.0f} "
+                    f"slo={row['slo_attainment']:.3f} "
+                    f"p99={row['p99_latency_ms']}ms "
+                    f"rps={row['requests_per_sec']}",
+                    file=sys.stderr,
+                )
+                return row
+
+            max_rate, trace = _search_max_rate(window, start_rate, slo_target)
+            server.check()
+            stats = server.stats()
+        finally:
+            server.stop()
+        arms[arm] = {
+            "max_rate_at_slo": round(max_rate, 2),
+            "windows": trace,
+            "completed_batches": stats["completed_batches"],
+            "metrics_skipped": stats["metrics_skipped"],
+            "mean_batch_occupancy": round(stats["mean_batch_occupancy"], 2),
+            "serve_pipeline": pipelined,
+        }
+    speedup = arms["pipelined"]["max_rate_at_slo"] / max(
+        arms["serial"]["max_rate_at_slo"], 1e-9
+    )
+    print(
+        f"[rate-search] pipelined {arms['pipelined']['max_rate_at_slo']:.0f} "
+        f"vs serial {arms['serial']['max_rate_at_slo']:.0f} req/s at SLO "
+        f"= {speedup:.2f}x",
+        file=sys.stderr,
+    )
+    parity = _pipeline_parity_probe(core, lru_chunk)
+    print(f"[rate-search] bitwise action parity: {parity}", file=sys.stderr)
+    # kill cell: pipeline ON, two replicas, mid-scenario replica kill —
+    # every routed session must come out the other side (migration drains
+    # the victim's in-flight pipeline records before carries move)
+    d0 = jax.local_devices()[0]
+    fleet = MultiDeviceServer(base_cfg, serve_cfg, devices=[d0, d0])
+    t0 = time.perf_counter()
+    fleet.warmup()
+    print(f"[rate-search:kill] warmup in {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+    fleet.start(watch_checkpoints=False)
+    try:
+        spec = next(
+            s for s in builtin_scenarios(
+                base_rate=start_rate, duration_s=max(seconds, 4.0),
+                sessions=sessions, seed=0,
+            )
+            if s.name == "replica_kill"
+        )
+        before = fleet.stats()
+        cell = ScenarioRunner(fleet, spec, slo_ms=slo_ms).run()
+        after = fleet.stats()
+    finally:
+        fleet.stop()
+    kill_cell = {
+        **cell,
+        "sessions_lost": after["sessions_lost"] - before["sessions_lost"],
+        "sessions_migrated": after["sessions_migrated"]
+        - before["sessions_migrated"],
+    }
+    print(
+        f"[rate-search:kill] lost={kill_cell['sessions_lost']} "
+        f"migrated={kill_cell['sessions_migrated']} "
+        f"kills={kill_cell.get('replica_kills')}",
+        file=sys.stderr,
+    )
+    row = {
+        "metric": "serve_max_rate_at_slo",
+        "value": arms["pipelined"]["max_rate_at_slo"],
+        "unit": "requests/s",
+        "vs_baseline": None,
+        "vs_serial": round(speedup, 3),
+        "slo_ms": slo_ms,
+        "slo_target": slo_target,
+        "window_seconds": seconds,
+        "sessions": sessions,
+        "bitwise_action_parity": bool(parity),
+        "arms": arms,
+        "replica_kill": kill_cell,
+        "core": base_cfg.recurrent_core
+        + (f"_c{base_cfg.lru_chunk}" if base_cfg.lru_chunk else ""),
+        "precision": "fp32",
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(row, f, indent=1)
+        print(f"[rate-search] report -> {out_path}", file=sys.stderr)
+    print(json.dumps(row))
+
+
 def long_context_main(core: str = "lstm", lru_chunk: int = 0,
                       precision: str = "bf16"):
     """Stretch configuration (BASELINE.json config 5): seq_len = 64 burn-in
@@ -2082,7 +2414,9 @@ if __name__ == "__main__":
     )
     p.add_argument(
         "--serve-seconds", type=float, default=30.0,
-        help="serve mode: measurement window (a hot reload fires halfway)",
+        help="serve mode: measurement window (a hot reload fires "
+             "halfway); with --rate-search, the length of EACH probed "
+             "rate window (pass something small, e.g. 5)",
     )
     p.add_argument(
         "--arrival-rate", type=float, default=200.0,
@@ -2096,6 +2430,30 @@ if __name__ == "__main__":
         help="serve mode: latency SLO for the slo_attainment row "
              "(fraction of post-warmup requests answered within this; "
              "rejected/errored requests count as misses)",
+    )
+    p.add_argument(
+        "--rate-search", action="store_true",
+        help="serve mode: replace the fixed-rate load arms with a "
+             "max-sustained-rate search (double then bisect) A/B'ing the "
+             "staged serve pipeline (config.serve_pipeline) against the "
+             "serial path, plus a bitwise action-parity probe and a "
+             "pipeline-on replica-kill cell — emits the "
+             "serve_max_rate_at_slo row",
+    )
+    p.add_argument(
+        "--slo-target", type=float, default=0.99,
+        help="serve mode --rate-search: SLO attainment a rate window "
+             "must reach to count as sustained",
+    )
+    p.add_argument(
+        "--rate-start", type=float, default=32.0,
+        help="serve mode --rate-search: first probed arrival rate in "
+             "requests/s (doubles until the SLO breaks, then bisects)",
+    )
+    p.add_argument(
+        "--serve-out", default="",
+        help="serve mode --rate-search: also write the report JSON here "
+             "(e.g. BENCH_r15.json)",
     )
     p.add_argument(
         "--serve-devices", type=int, default=1,
@@ -2183,10 +2541,19 @@ if __name__ == "__main__":
                        backward_arm=args.backward_arm,
                        ckpt_every=args.ckpt_every)
     elif args.mode == "serve":
-        serve_main(args.core, args.lru_chunk, args.sessions,
-                   args.serve_seconds, precision,
-                   arrival_rate=args.arrival_rate, slo_ms=args.slo_ms,
-                   devices=args.serve_devices)
+        if args.rate_search:
+            serve_rate_search_main(
+                args.core, args.lru_chunk,
+                sessions=args.sessions or 64,
+                seconds=args.serve_seconds,
+                slo_ms=args.slo_ms, slo_target=args.slo_target,
+                start_rate=args.rate_start, out_path=args.serve_out,
+            )
+        else:
+            serve_main(args.core, args.lru_chunk, args.sessions,
+                       args.serve_seconds, precision,
+                       arrival_rate=args.arrival_rate, slo_ms=args.slo_ms,
+                       devices=args.serve_devices)
     elif args.mode == "liveloop":
         liveloop_main(args.core, args.lru_chunk,
                       sessions=args.liveloop_sessions,

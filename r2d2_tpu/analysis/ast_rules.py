@@ -34,8 +34,18 @@ Rule catalog (ids, severities — the table in ARCHITECTURE.md mirrors this):
 
 - host-sync-in-hot-path  (warning)  `.item()` / `jax.device_get` /
   `np.asarray` / `np.array` / `float(x)` / `bool(x)` inside a for/while
-  body in the hot-path modules (learner.py, collect.py, megastep.py,
-  serve/*): each call can force a device->host sync per iteration.
+  body in the hot-path modules (learner.py, collect.py, megastep.py):
+  each call can force a device->host sync per iteration. The serving
+  plane graduated to its own rule (below).
+- blocking-host-sync-in-serve-step (warning)  the serve-pipeline variant,
+  covering serve/* files: the same loop-body flags as
+  host-sync-in-hot-path, PLUS function-wide (not just loop-body) coverage
+  of `np.asarray` / `np.array` / `jax.device_get` / `.item()` /
+  `.block_until_ready()` inside the pipeline's stage/dispatch bodies
+  (`_run_batch`, `_serve_iteration`, `_stage*`, `_dispatch*`) — one
+  blocking materialization there stalls the whole depth-2 overlap, so the
+  serve thread must never wait on the device. Completion-side functions
+  (`_complete*`) and `warmup*` are exempt: materializing is their job.
 - jit-in-loop            (error)    `jax.jit(...)` called inside a
   for/while body — a fresh jit wrapper per iteration retraces every call.
 - unhashable-static-arg  (error)    a jit static parameter whose default
@@ -86,6 +96,7 @@ from r2d2_tpu.utils.faults import KNOWN_SITES
 
 ALL_RULES = (
     "host-sync-in-hot-path",
+    "blocking-host-sync-in-serve-step",
     "jit-in-loop",
     "unhashable-static-arg",
     "shape-branch-in-jit",
@@ -98,9 +109,19 @@ ALL_RULES = (
 )
 
 # hot-path modules for the host-sync rule: the learner/collection dispatch
-# loops and the whole serving plane
+# loops. The serving plane moved to blocking-host-sync-in-serve-step,
+# which adds function-wide stage/dispatch coverage on top of the same
+# loop-body checks.
 HOT_BASENAMES = {"learner.py", "collect.py", "megastep.py"}
-HOT_DIRNAMES = {"serve"}
+HOT_DIRNAMES: Set[str] = set()
+
+# the serve rule's scope + its pipeline-role name conventions
+# (serve/server.py): stage/dispatch bodies must never block on the
+# device; completion/warmup bodies exist to block on it
+SERVE_DIRNAMES = {"serve"}
+_SERVE_STEP_NAMES = {"_run_batch", "_serve_iteration"}
+_SERVE_STEP_PREFIXES = ("_stage", "_dispatch")
+_SERVE_EXEMPT_PREFIXES = ("_complete", "warmup")
 
 _SYNC_CALLS = {
     "np.asarray": "np.asarray",
@@ -119,6 +140,11 @@ _MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, as
 def is_hot_path(path: str) -> bool:
     parts = path.replace(os.sep, "/").split("/")
     return parts[-1] in HOT_BASENAMES or bool(HOT_DIRNAMES & set(parts[:-1]))
+
+
+def is_serve_path(path: str) -> bool:
+    parts = path.replace(os.sep, "/").split("/")
+    return bool(SERVE_DIRNAMES & set(parts[:-1]))
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -242,6 +268,112 @@ def _rule_host_sync(tree: ast.AST, path: str) -> List[Finding]:
                     and not isinstance(node.args[0], ast.Constant)
                 ):
                     flag(node, f"{node.func.id}(...) on a possible device value")
+    return out
+
+
+def _own_nodes(root: ast.AST) -> List[ast.AST]:
+    """All descendant nodes of `root` that belong to ITS scope — nested
+    function/class definitions are skipped (they get their own scope
+    decision when the caller iterates over them directly)."""
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _rule_serve_step_host_sync(tree: ast.AST, path: str) -> List[Finding]:
+    """Serve-plane host-sync discipline (the depth-2 pipeline's contract):
+
+    - everywhere in serve/* except completion/warmup bodies, the classic
+      loop-body checks apply (a sync per iteration stalls the batch);
+    - inside stage/dispatch bodies (`_run_batch`, `_serve_iteration`,
+      `_stage*`, `_dispatch*`) the blocking calls are banned FUNCTION-WIDE
+      — np.asarray / np.array / jax.device_get / `.item()` /
+      `.block_until_ready()` anywhere there serializes the serve thread
+      against the device and collapses the stage/step overlap. float()/
+      bool() stay loop-only (scalar host math at stage time is fine).
+    """
+    if not is_serve_path(path):
+        return []
+    out: List[Finding] = []
+    seen: Set[Tuple[int, int]] = set()
+
+    def flag(node: ast.AST, what: str, where: str) -> None:
+        key = (node.lineno, node.col_offset)
+        if key in seen:
+            return
+        seen.add(key)
+        out.append(
+            Finding(
+                rule="blocking-host-sync-in-serve-step",
+                severity="warning",
+                path=path,
+                line=node.lineno,
+                col=node.col_offset,
+                message=f"{what} {where} blocks the serve thread on the "
+                "device and stalls the stage/dispatch pipeline",
+                hint="materialize on the completion side (_complete*), or "
+                "mark a deliberate sync with "
+                "`# r2d2: disable=blocking-host-sync-in-serve-step`",
+            )
+        )
+
+    def _blocking(node: ast.Call) -> Optional[str]:
+        d = _dotted(node.func)
+        if d in _SYNC_CALLS:
+            return f"{_SYNC_CALLS[d]}(...)"
+        if d == "jax.block_until_ready":
+            return "jax.block_until_ready(...)"
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "item" and not node.args:
+                return ".item()"
+            if node.func.attr == "block_until_ready":
+                return ".block_until_ready()"
+        return None
+
+    def check_loops(scope: ast.AST) -> None:
+        own = _own_nodes(scope)
+        own_set = set(map(id, own))
+        for loop in own:
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for stmt in list(loop.body) + list(loop.orelse):
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call) or id(node) not in own_set:
+                        continue
+                    what = _blocking(node)
+                    if what is not None:
+                        flag(node, what, "inside a serve loop body")
+                    elif (
+                        isinstance(node.func, ast.Name)
+                        and node.func.id in ("float", "bool")
+                        and len(node.args) == 1
+                        and not isinstance(node.args[0], ast.Constant)
+                    ):
+                        flag(
+                            node,
+                            f"{node.func.id}(...) on a possible device value",
+                            "inside a serve loop body",
+                        )
+
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name.startswith(_SERVE_EXEMPT_PREFIXES):
+            continue
+        if fn.name in _SERVE_STEP_NAMES or fn.name.startswith(_SERVE_STEP_PREFIXES):
+            for node in _own_nodes(fn):
+                if isinstance(node, ast.Call):
+                    what = _blocking(node)
+                    if what is not None:
+                        flag(node, what, f"in stage/dispatch body {fn.name}()")
+        check_loops(fn)
+    check_loops(tree)
     return out
 
 
@@ -750,6 +882,7 @@ def _rule_lock_discipline(tree: ast.AST, path: str) -> List[Finding]:
 
 _RULES = (
     _rule_host_sync,
+    _rule_serve_step_host_sync,
     _rule_jit_in_loop,
     _rule_unhashable_static_arg,
     _rule_shape_branch_in_jit,

@@ -186,6 +186,18 @@ def _dotted(node: ast.AST) -> Optional[str]:
     return ast_rules._dotted(node)
 
 
+def _spawn_name(node: ast.AST) -> str:
+    """Best-effort static worker name from a spawn's first argument.
+    Handles the repo's two idioms: a plain string constant, and the
+    replica-suffix concatenation `"serve-loop" + suffix` — the left
+    Constant is the stable identity the inventory tests assert on."""
+    if isinstance(node, ast.Constant):
+        return str(node.value)
+    if isinstance(node, ast.BinOp) and isinstance(node.left, ast.Constant):
+        return str(node.left.value)
+    return ""
+
+
 def _parse_annotation(node: Optional[ast.AST]) -> Tuple[Optional[str], Optional[str]]:
     """(type name, element type name) from an annotation expression.
     Understands Name/Attribute, Optional[T], and List/Sequence/Tuple[T]
@@ -405,8 +417,7 @@ class _Program:
             for kw in call.keywords:
                 if kw.arg == "on_restart":
                     entries.extend(self._resolve_entry(mod, cls, fn, kw.value))
-            name = call.args[0].value if isinstance(call.args[0], ast.Constant) \
-                else ""
+            name = _spawn_name(call.args[0])
             self.roots.append(ThreadRoot(
                 root_id=f"spawn:{rel}:{call.lineno}", kind="spawn",
                 name=str(name), path=mod.path, line=call.lineno,

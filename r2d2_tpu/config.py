@@ -198,6 +198,28 @@ class R2D2Config:
     # The ladder's SLO target: p99 above this (or attainment below the
     # controller's low-water band) counts as a pressured evaluation.
     serve_degrade_slo_ms: float = 50.0
+    # Depth-2 serve pipeline (serve/server.py). When True (default) each
+    # batch is split into STAGE (host assembly into preallocated
+    # per-bucket staging buffers, RNG draws in arrival order, then the
+    # async jitted step dispatch + donated in-place carry commit) and
+    # COMPLETE (a supervised per-replica "serve-complete" worker
+    # materializes q/action in dispatch order, resolves client futures,
+    # and feeds the tap, the degrade window, and metrics) — so the serve
+    # thread stages and dispatches batch k+1 while the device still runs
+    # batch k. Bounded to depth 2 so cache assign/commit bookkeeping and
+    # same-session ordering stay correct; RNG draws happen at stage time
+    # in arrival order, so served actions are BITWISE identical to the
+    # serial path. False restores the strictly serial pre-pipeline loop
+    # (one thread stages, steps, and resolves), bit-identically.
+    serve_pipeline: bool = True
+    # Serve metrics cadence in seconds: the per-batch serve metrics dict
+    # (which includes a full cache.stats() sweep) is logged at most this
+    # often, plus forced logs on arm or params-version changes so
+    # reload/degrade events are never invisible. Batches skipped between
+    # logs are counted (metrics_skipped rides in the logged dict) so
+    # rates stay computable. 0.0 logs every batch — the pre-pipeline
+    # behavior.
+    serve_log_interval: float = 0.0
 
     # Live-loop learning plane (liveloop/). When True the serve plane
     # grows a TransitionTap: every served step's (obs, action, reward,
@@ -548,6 +570,11 @@ class R2D2Config:
                 "serve_degrade_slo_ms is the degradation ladder's p99 "
                 "latency target in milliseconds (serve/degrade.py); it "
                 "must be > 0"
+            )
+        if self.serve_log_interval < 0.0:
+            raise ValueError(
+                "serve_log_interval is the serve metrics cadence in "
+                "seconds (0.0 logs every batch); it must be >= 0"
             )
         if not 0.0 <= self.liveloop_explore_fraction <= 1.0:
             raise ValueError(

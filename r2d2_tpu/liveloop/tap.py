@@ -33,14 +33,20 @@ eviction seam (fresh admission without client reset) is encoded as a
 terminal rather than a bootstrap cut, since the recurrent carry is
 genuinely lost there.
 
-Capture cost on the serve loop is one fused device gather of the batch
+Capture cost on the serve side is one fused device gather of the batch
 rows' post-step carries (`gather_carry_rows`, jitted and covered by the
 jaxpr entry-point gate) plus a bounded deque append; accumulation itself
-runs on the supervised "liveloop-tap" thread. The deque sheds drop-oldest
-(counted) under pressure, and sessions seen in a dropped record are
-re-seeded at next sight with their partial block cut cleanly
-(bootstrapped from the pending Q) — a drop costs data, never correctness
-of what is emitted.
+runs on the supervised "liveloop-tap" thread. Under the depth-2 serve
+pipeline the two halves split across its stages: the serve thread calls
+`gather_rows` at DISPATCH time — the gather must be stream-ordered right
+after the carry commit, before a later donated step can consume the
+stores — and the serve-complete worker passes the pre-gathered rows to
+`observe_batch(rows=...)` when it materializes the batch. The serial
+path keeps the legacy shape (observe_batch gathers internally when
+`rows` is None). The deque sheds drop-oldest (counted) under pressure,
+and sessions seen in a dropped record are re-seeded at next sight with
+their partial block cut cleanly (bootstrapped from the pending Q) — a
+drop costs data, never correctness of what is emitted.
 """
 
 from __future__ import annotations
@@ -143,6 +149,15 @@ class TransitionTap:
 
     # ------------------------------------------------------------ serve side
 
+    def gather_rows(self, h_store, c_store, slots):
+        """Dispatch the fused carry gather on the CALLER's thread (the
+        serve thread, at dispatch time) and return the still-async device
+        pair for a later `observe_batch(rows=...)`. The pipelined server
+        needs the gather ordered on the device stream before the next
+        donated step consumes the stores; materialization happens on the
+        completion side, off the serve thread."""
+        return _gather(h_store, c_store, jnp.asarray(slots))
+
     def observe_batch(
         self,
         sids: Sequence[str],
@@ -157,12 +172,19 @@ class TransitionTap:
         h_store,
         c_store,
         slots: np.ndarray,
+        rows=None,
     ) -> None:
         """Record one served batch (first n = len(sids) rows of each array
         are real; pads were already sliced off by the caller or are sliced
-        here). Called on the serve loop — one jitted gather + D2H + append."""
+        here). `rows` (an (h_rows, c_rows) pair from `gather_rows`) skips
+        the internal carry gather — the pipelined server pre-gathers at
+        dispatch time and h_store/c_store may then be None. One D2H wait +
+        bounded append either way."""
         n = len(sids)
-        h_rows, c_rows = _gather(h_store, c_store, jnp.asarray(slots[:n]))
+        if rows is not None:
+            h_rows, c_rows = rows
+        else:
+            h_rows, c_rows = _gather(h_store, c_store, jnp.asarray(slots[:n]))
         rec = BatchRecord(
             sids=list(sids),
             obs=np.asarray(obs[:n]),

@@ -19,6 +19,16 @@ One session appears at most ONCE per batch: the recurrent state gathered
 at batch start is per-session, so a second in-flight request of the same
 session must observe the first one's updated carry — it is deferred to the
 next batch (FIFO within the session).
+
+The batcher also owns the STAGING side of the serve pipeline
+(`BucketStaging` / `StagedBatch`): per-bucket, double-buffered,
+preallocated host arrays that batch assembly writes into instead of
+allocating fresh `np.stack`/`np.concatenate` outputs per batch. The serve
+loop hands the jitted step a `StagedBatch`, not raw requests; because the
+pipeline is bounded to depth 2 (server.py's completion semaphore), a
+bucket's two buffer sets alternate safely — set A is only re-staged after
+the batch that last used it has fully completed, which matters on
+backends where `jnp.asarray` aliases host memory instead of copying.
 """
 
 from __future__ import annotations
@@ -53,6 +63,107 @@ class ServeRequest:
     # conditions the dueling head and bounds exploration draws to the
     # task's native actions. 0 is the single-task default.
     task: int = 0
+
+
+@dataclasses.dataclass
+class StagedBatch:
+    """One batch staged into preallocated buffers, ready for H2D + the
+    jitted step. All arrays are bucket-length views of a `BucketStaging`
+    buffer set (except `explore`/`randoms`, which are freshly drawn on
+    the exploring path to keep the RNG stream bit-exact) — the first `n`
+    rows are real, the rest are pads."""
+
+    requests: List["ServeRequest"]
+    n: int
+    bucket: int
+    obs: np.ndarray        # (bucket, *obs_shape), request dtype
+    rewards: np.ndarray    # (bucket,) f32
+    reset_mask: np.ndarray  # (bucket,) bool — client reset | fresh | pad
+    slots: np.ndarray      # (bucket,) i32 — cache rows; pads -> scratch
+    task: Optional[np.ndarray]  # (bucket,) i32, or None (single-task)
+    eps: np.ndarray        # (bucket,) f32 per-row exploration epsilon
+    explore: np.ndarray    # (bucket,) bool
+    randoms: np.ndarray    # (bucket,) int — random actions where exploring
+
+
+class BucketStaging:
+    """Preallocated per-bucket staging arrays for zero-copy batch assembly.
+
+    Two buffer SETS per bucket, used alternately: with the serve pipeline
+    bounded to depth 2, the set staged for batch k is not reused before
+    batch k has completed, so in-flight H2D reads (which may alias these
+    buffers on CPU backends) never observe the next batch's writes.
+
+    `stage()` fills the request-derived rows with single vectorized
+    buffer writes — no per-batch `np.stack`/`np.concatenate`/`fromiter`
+    allocations once a bucket's buffers are warm. The caller (the serve
+    loop) fills the cache/RNG-derived fields (slots, fresh-OR into the
+    reset mask, epsilon overrides, exploration draws) into the same
+    buffers. Single-threaded by contract: only the serve loop stages.
+    """
+
+    def __init__(self, buckets: Sequence[int], num_tasks: int = 1):
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.num_tasks = int(num_tasks)
+        self._sets: dict = {}   # (bucket, flip) -> buffer dict
+        self._flip = {b: 0 for b in self.buckets}
+
+    def _alloc(self, bucket: int, row: np.ndarray) -> dict:
+        return {
+            "obs": np.zeros((bucket, *row.shape), row.dtype),
+            "rewards": np.zeros(bucket, np.float32),
+            "reset": np.zeros(bucket, bool),
+            "slots": np.zeros(bucket, np.int32),
+            "task": np.zeros(bucket, np.int32),
+            "eps": np.zeros(bucket, np.float32),
+            "explore": np.zeros(bucket, bool),
+            "randoms": np.zeros(bucket, np.int64),
+        }
+
+    def stage(self, requests: List["ServeRequest"], bucket: int,
+              obs_rows: List[np.ndarray], default_eps: float) -> StagedBatch:
+        """Assemble `requests` (whose obs rows arrive pre-padded to one
+        common geometry) into the bucket's next buffer set. Pads zero the
+        trailing rows (reset=True so the scratch row's garbage never
+        compounds). Buffers are reallocated only when the obs
+        shape/dtype changes (first batch, or a served-geometry change)."""
+        n = len(requests)
+        key = (bucket, self._flip[bucket])
+        self._flip[bucket] ^= 1
+        bufs = self._sets.get(key)
+        row0 = obs_rows[0]
+        if (
+            bufs is None
+            or bufs["obs"].shape[1:] != row0.shape
+            or bufs["obs"].dtype != row0.dtype
+        ):
+            bufs = self._alloc(bucket, row0)
+            self._sets[key] = bufs
+        obs = bufs["obs"]
+        np.stack(obs_rows, out=obs[:n])
+        obs[n:] = 0
+        rewards = bufs["rewards"]
+        rewards[:n] = [r.reward for r in requests]
+        rewards[n:] = 0.0
+        reset = bufs["reset"]
+        reset[:n] = [r.reset for r in requests]
+        reset[n:] = True
+        task = None
+        if self.num_tasks > 1:
+            task = bufs["task"]
+            task[:n] = [r.task for r in requests]
+            task[n:] = 0
+        eps = bufs["eps"]
+        eps[:] = default_eps
+        explore = bufs["explore"]
+        explore[:] = False
+        randoms = bufs["randoms"]
+        randoms[:] = 0
+        return StagedBatch(
+            requests=requests, n=n, bucket=bucket, obs=obs,
+            rewards=rewards, reset_mask=reset, slots=bufs["slots"],
+            task=task, eps=eps, explore=explore, randoms=randoms,
+        )
 
 
 class MicroBatcher:

@@ -82,16 +82,16 @@ class _RequestHandler(socketserver.StreamRequestHandler):
                     resp = {"ok": True}
                 else:
                     # host-side JSON decode, no device values in sight
-                    obs = np.asarray(req["obs"], np.uint8)  # r2d2: disable=host-sync-in-hot-path
+                    obs = np.asarray(req["obs"], np.uint8)  # r2d2: disable=blocking-host-sync-in-serve-step
                     eps = req.get("epsilon")
                     # epsilon only when the request carries one: requests
                     # without the field make the exact pre-override call,
                     # so servers exposing the old submit surface still work
-                    kwargs = {} if eps is None else {"epsilon": float(eps)}  # r2d2: disable=host-sync-in-hot-path
+                    kwargs = {} if eps is None else {"epsilon": float(eps)}  # r2d2: disable=blocking-host-sync-in-serve-step
                     fut = server.submit(
                         str(req["session"]), obs,
-                        reward=float(req.get("reward", 0.0)),  # r2d2: disable=host-sync-in-hot-path
-                        reset=bool(req.get("reset", False)),  # r2d2: disable=host-sync-in-hot-path
+                        reward=float(req.get("reward", 0.0)),  # r2d2: disable=blocking-host-sync-in-serve-step
+                        reset=bool(req.get("reset", False)),  # r2d2: disable=blocking-host-sync-in-serve-step
                         **kwargs,
                     )
                     result = fut.result(timeout=30.0)
@@ -102,7 +102,7 @@ class _RequestHandler(socketserver.StreamRequestHandler):
                     }
                     if req.get("want_q"):
                         # result.q is already host numpy (server reads it back)
-                        resp["q"] = np.asarray(result.q).tolist()  # r2d2: disable=host-sync-in-hot-path
+                        resp["q"] = np.asarray(result.q).tolist()  # r2d2: disable=blocking-host-sync-in-serve-step
             except Exception as e:  # answer in-band; keep the stream alive
                 resp = {"error": f"{type(e).__name__}: {e}"}
             self.wfile.write((json.dumps(resp) + "\n").encode())

@@ -21,7 +21,13 @@ Routing rules (documented in ARCHITECTURE.md):
 
 Each replica keeps the compile-once-per-bucket property independently (its
 jitted step is specialized to its own device; `trace_count` per replica
-stays <= len(buckets)). Hot reload is published to ALL replicas under one
+stays <= len(buckets)), and under config.serve_pipeline each replica's own
+`start()` spawns its depth-2 pipeline pair — "serve-loop-<name>" staging
+and dispatching, "serve-complete-<name>" materializing results — so the
+fleet overlaps host staging with device steps on every chip independently;
+the fleet stats() sums the per-replica `completed_batches` /
+`metrics_skipped` counters alongside the batch counters. Hot reload is
+published to ALL replicas under one
 shared version number inside one critical section: the checkpoint is
 restored ONCE on host, then `PolicyServer.publish` runs per replica
 (re-quantizing per replica under serve_quantization="int8" and placing
@@ -505,6 +511,7 @@ class MultiDeviceServer:
         "cache_imports", "cache_spill_sheds",
         "requests", "batches", "rejected", "shed", "deferrals",
         "queue_depth", "trace_count", "quantized_leaves", "arm_switches",
+        "completed_batches", "metrics_skipped",
     )
 
     def stats(self) -> Dict[str, object]:

@@ -148,7 +148,7 @@ def arrival_trace(spec: ScenarioSpec) -> List[Arrival]:
     t = 0.0
     while True:
         # host numpy RNG throughout: no device values in the trace builder
-        t += float(rng.exponential(1.0 / peak))  # r2d2: disable=host-sync-in-hot-path
+        t += float(rng.exponential(1.0 / peak))  # r2d2: disable=blocking-host-sync-in-serve-step
         if t >= spec.duration_s:
             break
         if rng.random() >= spec.rate_at(t) / peak:
@@ -159,7 +159,7 @@ def arrival_trace(spec: ScenarioSpec) -> List[Arrival]:
             slot_gen[slot] += 1
             slot_sid[slot] = f"s{spec.seed}-{slot}-{slot_gen[slot]}"
             slot_left[slot] = _draw_session_length(rng, spec)
-            slot_slow[slot] = bool(rng.random() < spec.slow_frac)  # r2d2: disable=host-sync-in-hot-path
+            slot_slow[slot] = bool(rng.random() < spec.slow_frac)  # r2d2: disable=blocking-host-sync-in-serve-step
             slot_started[slot] = False
         reset = not slot_started[slot]
         slot_started[slot] = True

@@ -4,12 +4,25 @@ The inference-side counterpart of the training workers (SEED RL's
 centralized inference, Espeholt et al. 2020): ONE thread owns the device
 and the session cache, pulling micro-batches from the batcher, advancing
 all sessions in a single jitted `net.act` step, and resolving each
-request's Future with the chosen action. Two supervised workers run under
+request's Future with the chosen action. The supervised workers run under
 `utils/supervision.Supervisor` exactly like the training-side actor loops:
 
-- ``serve-loop``   — batch formation + the jitted step; a raising
-  iteration fails only the in-flight batch's futures (recovery hook) and
-  the loop restarts with the session cache intact;
+- ``serve-loop``   — batch formation + STAGE (host assembly into the
+  batcher's preallocated staging buffers, RNG draws in arrival order) +
+  DISPATCH (the async jitted step and the donated in-place carry
+  commit); a raising iteration fails only the in-flight batches' futures
+  (recovery hook) and the loop restarts with the session cache intact;
+- ``serve-complete`` — (cfg.serve_pipeline, the default) materializes
+  each dispatched batch's q/action in dispatch order, resolves client
+  futures, and feeds the tap, the degrade window, and metrics — so the
+  serve thread stages and dispatches batch k+1 while the device still
+  runs batch k. A depth-2 semaphore bounds how far staging runs ahead:
+  same-session ordering and the staging buffers' double-buffer reuse
+  both rely on batch k being complete before batch k+2 stages. With
+  cfg.serve_pipeline=False there is no completion worker and the serve
+  loop completes each batch inline — the strictly serial pre-pipeline
+  path, bit-identical because both modes share one stage/dispatch body
+  and the completion order is FIFO either way;
 - ``ckpt-watcher`` — polls the orbax series (utils/checkpoint.py) and
   atomically publishes new params.
 
@@ -31,6 +44,7 @@ tests can pin traces <= len(buckets).
 from __future__ import annotations
 
 import dataclasses
+import queue
 import threading
 import time
 from concurrent.futures import Future
@@ -43,7 +57,7 @@ import numpy as np
 from r2d2_tpu.config import R2D2Config
 from r2d2_tpu.learner import init_train_state
 from r2d2_tpu.models.r2d2 import R2D2Network
-from r2d2_tpu.serve.batcher import MicroBatcher, ServeRequest
+from r2d2_tpu.serve.batcher import BucketStaging, MicroBatcher, ServeRequest, StagedBatch
 from r2d2_tpu.serve.degrade import DegradeConfig, DegradeController
 from r2d2_tpu.serve.state_cache import RecurrentStateCache
 from r2d2_tpu.utils.checkpoint import latest_checkpoint_step, restore_checkpoint
@@ -89,6 +103,31 @@ class ServeResult:
             f"ServeResult(action={self.action}, ckpt_step={self.ckpt_step}, "
             f"params_version={self.params_version})"
         )
+
+
+@dataclasses.dataclass
+class _PipelineRecord:
+    """One dispatched batch in flight between DISPATCH and COMPLETE.
+
+    `q`/`action` are device arrays (futures under JAX async dispatch —
+    `copy_to_host_async` was already started); `staged` pins the staging
+    buffer set the batch was assembled in so the double-buffer flip
+    cannot hand it back out before this record completes (the depth-2
+    semaphore releases only after completion); `tap_rows` are the
+    batch rows' committed carries, gathered at dispatch time on the
+    serve thread so completion never touches stores a later donated
+    step may already have consumed."""
+
+    batch: List[ServeRequest]
+    n: int
+    bucket: int
+    ckpt_step: int
+    version: int
+    arm: str
+    q: object
+    action: object
+    staged: StagedBatch
+    tap_rows: Optional[tuple]
 
 
 _REF_JITS: Dict[R2D2Network, object] = {}
@@ -254,6 +293,33 @@ class PolicyServer:
             queue_depth=serve_cfg.queue_depth,
         )
         self._rng = np.random.default_rng(serve_cfg.seed)
+        # preallocated per-bucket staging buffers (serve/batcher.py): batch
+        # assembly writes into these instead of allocating per batch. Two
+        # sets per bucket, flipped per staging — with the depth-2 pipeline
+        # bound, a set is never re-staged before the batch that used it
+        # fully completed.
+        self._staging = BucketStaging(serve_cfg.buckets, num_tasks=cfg.num_tasks)
+        # the pipeline depth bound: acquired before a batch stages,
+        # released after it completes. Depth 2 = one batch on the device +
+        # one staged/dispatched behind it.
+        self._depth_sem = threading.Semaphore(2)
+        # stage/dispatch -> complete handoff (FIFO preserves dispatch
+        # order, which is completion order)
+        self._complete_q: "queue.Queue[_PipelineRecord]" = queue.Queue()
+        self._complete_worker = None
+        self.completed_batches = 0
+        # deferred serve metrics (cfg.serve_log_interval > 0): batches that
+        # skipped the metrics row, so rates stay computable from the rows
+        # that did log
+        self.metrics_skipped = 0
+        self._metrics_last_t = float("-inf")
+        self._metrics_last_arm: Optional[str] = None
+        self._metrics_last_version: Optional[int] = None
+        # hoisted once: per-task action dims for native exploration draws
+        self._task_dims = (
+            np.asarray(cfg.task_action_dims, np.int64)
+            if cfg.task_action_dims else None
+        )
         # live-loop capture hooks (liveloop/loop.py installs both; None —
         # the default — keeps _run_batch byte-for-byte the pre-liveloop
         # path): tap records served batches, eps_assigner maps sessions
@@ -489,15 +555,47 @@ class PolicyServer:
             self.tap.observe_evict(session_id)
 
     def _run_batch(self, batch: List[ServeRequest]) -> None:
+        # the batch joins the in-flight set BEFORE any work: a crash
+        # anywhere past this line reaches _serve_recover, which fails these
+        # futures so no client blocks forever
         with self._state_lock:
-            self._inflight = batch
+            self._inflight = self._inflight + list(batch)
+        if self.cfg.serve_pipeline and self._complete_worker is not None:
+            # depth bound: at most 2 batches between stage and complete.
+            # Bounded waits so a wedged completion worker cannot pin this
+            # thread through a shutdown.
+            while not self._depth_sem.acquire(timeout=0.25):
+                if self.supervisor is not None and self.supervisor.stop.is_set():
+                    raise RuntimeError("server stopping; batch not staged")
+            try:
+                rec = self._stage_and_dispatch(batch)
+            except BaseException:
+                self._depth_sem.release()
+                raise
+            self._complete_q.put(rec)
+        else:
+            # serial path (cfg.serve_pipeline=False, or a bare _run_batch
+            # with no completion worker running): same stage/dispatch body,
+            # completed inline — the strictly serial pre-pipeline loop
+            rec = self._stage_and_dispatch(batch)
+            self._complete(rec)
+
+    def _stage_and_dispatch(self, batch: List[ServeRequest]) -> _PipelineRecord:
+        """STAGE + DISPATCH, on the serve thread: assemble the batch into
+        the preallocated staging buffers (RNG draws at stage time in
+        arrival order — the exact stream the serial path consumes), then
+        dispatch the async jitted step and commit the donated carry
+        stores. Host-blocking materialization is banned here (the
+        `blocking-host-sync-in-serve-step` lint enforces it); everything
+        that must wait on the device lives in _complete."""
         # single read of the publish cell: the whole batch — and the
-        # results' provenance — come from one (params, arm) pair
+        # results' provenance — come from one (params, arm) pair; a reload
+        # landing between stage and complete changes NOTHING for this
+        # batch (mid-pipeline provenance invariant)
         params, ckpt_step, version, arm = self._published
         step_fn = self._step_for(arm)
         n = len(batch)
         bucket = self.batcher.bucket_for(n)
-        pad = bucket - n
         slots, fresh = self.cache.assign([r.session_id for r in batch])
 
         obs_rows = [r.obs for r in batch]
@@ -507,113 +605,203 @@ class PolicyServer:
             # row to the union geometry the compiled step expects, so one
             # bucket serves the whole family without per-shape retraces
             obs_rows = [_pad_obs(o, target) for o in obs_rows]
-        obs = np.stack(
-            obs_rows + [np.zeros_like(obs_rows[0])] * pad
-        )
-        rewards = np.fromiter(
-            (r.reward for r in batch), np.float32, count=n
-        )
-        rewards = np.concatenate([rewards, np.zeros(pad, np.float32)])
+        # zero-copy assembly: single vectorized writes into this bucket's
+        # staging set (obs stack, rewards, reset|fresh, slots, task) —
+        # no per-batch np.stack/np.concatenate allocs, no per-row loops
+        staged = self._staging.stage(batch, bucket, obs_rows, self.serve_cfg.epsilon)
         # a row starts from zero state when the client asked for a reset OR
         # the cache admitted it fresh (new session, or evicted + returned);
-        # pad rows reset too so the scratch row's garbage never compounds
-        reset_mask = np.concatenate(
-            [np.array([r.reset for r in batch], bool) | fresh, np.ones(pad, bool)]
-        )
-        slots_full = np.concatenate(
-            [slots, np.full(pad, self.cache.pad_slot, np.int32)]
-        )
-        # multi-task conditioning rides per request (a serve fleet hosts
-        # sessions of EVERY task at once); pad rows take task 0 — they
-        # target the scratch slot, so their q values are never read
-        task_full = None
-        if self.cfg.num_tasks > 1:
-            task_full = np.zeros(bucket, np.int32)
-            for i, r in enumerate(batch):
-                task_full[i] = r.task
+        # pad rows were pre-set to reset so the scratch row never compounds
+        staged.reset_mask[:n] |= fresh
+        staged.slots[:n] = slots
+        staged.slots[n:] = self.cache.pad_slot
         # per-row exploration: request override > per-session assignment
         # (liveloop's ladder) > the ServeConfig.epsilon fleet default.
         # RNG discipline keeps the legacy stream bit-exact: the coin and
         # random-action draws happen iff ANY row explores, in the same
         # order and count as the old scalar path — all-zero rows (the
         # default config) draw nothing, a uniform fleet epsilon draws
-        # exactly what it used to.
-        eps_row = np.full(bucket, self.serve_cfg.epsilon, np.float32)
+        # exactly what it used to. epsilon_for runs in arrival order
+        # (sticky ladder rungs assign on first call).
         assigner = self.eps_assigner
-        if assigner is not None or any(r.epsilon is not None for r in batch):
-            for i, r in enumerate(batch):
-                if r.epsilon is not None:
-                    eps_row[i] = r.epsilon
-                elif assigner is not None:
-                    eps_row[i] = assigner.epsilon_for(r.session_id)
-        if float(eps_row.max()) > 0.0:
-            explore = self._rng.random(bucket) < eps_row
-            if task_full is not None and self.cfg.task_action_dims:
+        if assigner is not None:
+            staged.eps[:n] = [
+                r.epsilon if r.epsilon is not None
+                else assigner.epsilon_for(r.session_id)
+                for r in batch
+            ]
+        elif any(r.epsilon is not None for r in batch):
+            staged.eps[:n] = [
+                self.serve_cfg.epsilon if r.epsilon is None else r.epsilon
+                for r in batch
+            ]
+        if float(staged.eps.max()) > 0.0:
+            staged.explore[:] = self._rng.random(bucket) < staged.eps
+            if staged.task is not None and self._task_dims is not None:
                 # exploration stays NATIVE per row: a drawn action must be
                 # legal for the row's task, not just the union head
-                dims = np.asarray(self.cfg.task_action_dims, np.int64)
-                randoms = self._rng.integers(0, dims[task_full])
+                staged.randoms[:] = self._rng.integers(
+                    0, self._task_dims[staged.task]
+                )
             else:
-                randoms = self._rng.integers(0, self.cfg.action_dim, bucket)
-        else:
-            explore = np.zeros(bucket, bool)
-            randoms = np.zeros(bucket, np.int64)
+                staged.randoms[:] = self._rng.integers(
+                    0, self.cfg.action_dim, bucket
+                )
 
         h, c, la, lr = self.cache.arrays()
         step_args = [
             params, h, c, la, lr,
-            jnp.asarray(obs), jnp.asarray(rewards), jnp.asarray(slots_full),
-            jnp.asarray(reset_mask), jnp.asarray(explore),
-            jnp.asarray(randoms, jnp.int32),
+            jnp.asarray(staged.obs), jnp.asarray(staged.rewards),
+            jnp.asarray(staged.slots), jnp.asarray(staged.reset_mask),
+            jnp.asarray(staged.explore),
+            jnp.asarray(staged.randoms, jnp.int32),
         ]
-        if task_full is not None:
-            step_args.append(jnp.asarray(task_full))
+        if staged.task is not None:
+            step_args.append(jnp.asarray(staged.task))
         q, action, h, c, la, lr = step_fn(*step_args)
-        q_np = np.asarray(q)
-        act_np = np.asarray(action)
-        # stores commit BEFORE futures resolve: a client's next request for
-        # the same session (only admissible in a later batch) always sees
-        # this batch's carry
+        # JAX async dispatch: q/action come back as futures. Start the D2H
+        # copy NOW so it overlaps the remaining dispatch work and the next
+        # batch's staging; _complete's materialization then finds the
+        # bytes already on host (or waits the residue).
+        if hasattr(q, "copy_to_host_async"):
+            q.copy_to_host_async()
+            action.copy_to_host_async()
+        # stores commit at DISPATCH time, before the next batch can stage:
+        # a same-session follow-up (only admissible in a later batch)
+        # gathers from these arrays, and the device stream orders the
+        # donated in-place update ahead of any later step that reads it
         self.cache.commit(h, c, la, lr)
-        t_done = time.monotonic()
-        for i, r in enumerate(batch):
-            r.future.set_result(
-                ServeResult(int(act_np[i]), q_np[i], ckpt_step, version,
-                            bucket=bucket)
-            )
-        with self._state_lock:
-            self._inflight = []
+        tap_rows = None
         if self.tap is not None:
-            # live-loop capture, after the clients have their answers: one
-            # fused gather of the batch rows' committed carries + a bounded
-            # (drop-oldest) append; accumulation runs on the liveloop-tap
-            # thread, never here
+            # gather the batch rows' committed carries HERE, on the serve
+            # thread: on donating backends batch k's stores are consumed
+            # by step k+1, so a completion-time gather could read freed
+            # buffers. The gather is itself async — dispatch-ordered after
+            # the commit, materialized by the tap/completion side.
+            tap_rows = self.tap.gather_rows(h, c, staged.slots[:n])
+        return _PipelineRecord(
+            batch=batch, n=n, bucket=bucket, ckpt_step=ckpt_step,
+            version=version, arm=arm, q=q, action=action, staged=staged,
+            tap_rows=tap_rows,
+        )
+
+    def _complete(self, rec: _PipelineRecord) -> None:
+        """COMPLETE: materialize q/action (the only host-blocking reads in
+        the serve path), resolve client futures, retire the batch from the
+        in-flight set, and feed the tap, the degrade window, and metrics.
+        Runs on the serve-complete worker (pipelined), or inline on the
+        serve thread (serial); records arrive in dispatch order either
+        way."""
+        q_np = np.asarray(rec.q)
+        act_np = np.asarray(rec.action)
+        t_done = time.monotonic()
+        for i, r in enumerate(rec.batch):
+            # .done() guard: _serve_recover may have failed these futures
+            # after a serve-loop crash while this record was still queued
+            if not r.future.done():
+                r.future.set_result(
+                    ServeResult(int(act_np[i]), q_np[i], rec.ckpt_step,
+                                rec.version, bucket=rec.bucket)
+                )
+        with self._state_lock:
+            done = set(map(id, rec.batch))
+            self._inflight = [r for r in self._inflight if id(r) not in done]
+            self.completed_batches += 1
+        n = rec.n
+        if self.tap is not None:
+            # live-loop capture, after the clients have their answers. The
+            # staging buffers are REUSED (double-buffered), so the tap gets
+            # copies of the buffer-backed rows — its records must survive
+            # the next staging of this bucket — plus the carry rows
+            # pre-gathered at dispatch time
+            staged = rec.staged
             self.tap.observe_batch(
-                [r.session_id for r in batch], obs, act_np, q_np,
-                rewards, reset_mask, eps_row, ckpt_step, version,
-                h, c, slots_full,
+                [r.session_id for r in rec.batch],
+                staged.obs[:n].copy(), act_np[:n], q_np[:n],
+                staged.rewards[:n].copy(), staged.reset_mask[:n].copy(),
+                staged.eps[:n].copy(), rec.ckpt_step, rec.version,
+                None, None, staged.slots[:n].copy(), rows=rec.tap_rows,
             )
         if self.degrade is not None:
             # feed the ladder's latency window (per answered request, the
             # same queue-to-resolve latency clients experience)
-            for r in batch:
+            for r in rec.batch:
                 self.degrade.observe(t_done - r.t_enqueue)
         if self.metrics is not None:
-            self.metrics.log(
-                {
-                    "plane": "serve",
-                    "batch_occupancy": n,
-                    "bucket": bucket,
-                    "queue_depth": self.batcher.qsize(),
-                    "latency_s_oldest": t_done - batch[0].t_enqueue,
-                    "ckpt_step": ckpt_step,
-                    "params_version": version,
-                    "serve_arm": arm,
-                    "reloads": self.reloads,
-                    "trace_count": self.trace_count,
-                    **self.cache.stats(),
-                }
+            self._log_serve_metrics(rec, t_done)
+
+    def _log_serve_metrics(self, rec: _PipelineRecord, t_done: float) -> None:
+        """Deferred serve metrics: the full stats dict (queue probe +
+        cache.stats()) is built only when a row is due —
+        cfg.serve_log_interval=0.0 (default) logs every batch, the
+        pre-pipeline behavior; a positive interval logs on that cadence
+        plus forced rows on every arm change and reload (version bump) so
+        provenance edges are never silent. Skipped batches are counted so
+        rates stay computable between rows."""
+        interval = self.cfg.serve_log_interval
+        with self._state_lock:
+            force = (
+                rec.arm != self._metrics_last_arm
+                or rec.version != self._metrics_last_version
             )
+            due = interval <= 0.0 or (t_done - self._metrics_last_t) >= interval
+            if not (due or force):
+                self.metrics_skipped += 1
+                return
+            self._metrics_last_t = t_done
+            self._metrics_last_arm = rec.arm
+            self._metrics_last_version = rec.version
+            completed = self.completed_batches
+            skipped = self.metrics_skipped
+        # the dict build (batcher/cache probes take their own locks) stays
+        # OUTSIDE the state lock
+        self.metrics.log(
+            {
+                "plane": "serve",
+                "batch_occupancy": rec.n,
+                "bucket": rec.bucket,
+                "queue_depth": self.batcher.qsize(),
+                "latency_s_oldest": t_done - rec.batch[0].t_enqueue,
+                "ckpt_step": rec.ckpt_step,
+                "params_version": rec.version,
+                "serve_arm": rec.arm,
+                "reloads": self.reloads,
+                "trace_count": self.trace_count,
+                "completed_batches": completed,
+                "metrics_skipped": skipped,
+                **self.cache.stats(),
+            }
+        )
+
+    def _fail_record(self, rec: _PipelineRecord) -> None:
+        """Completion-side recovery: retire a record whose completion
+        raised, failing any still-unresolved futures so clients retry.
+        Session state is safe — the carry committed at dispatch."""
+        with self._state_lock:
+            dead = set(map(id, rec.batch))
+            self._inflight = [r for r in self._inflight if id(r) not in dead]
+        for r in rec.batch:
+            if not r.future.done():
+                r.future.set_exception(
+                    RuntimeError("serve completion failed; retry the request")
+                )
+
+    def _complete_iteration(self) -> None:
+        """Supervised serve-complete worker body: complete one dispatched
+        batch (bounded wait so shutdown never blocks). The depth slot is
+        released in ALL cases — a record either completes or is failed,
+        never left holding pipeline depth."""
+        try:
+            rec = self._complete_q.get(timeout=0.25)
+        except queue.Empty:
+            return
+        try:
+            self._complete(rec)
+        except BaseException:
+            self._fail_record(rec)
+            raise
+        finally:
+            self._depth_sem.release()
 
     def _serve_iteration(self) -> None:
         # straggler-replica drill: a "stall:S" schedule here wedges THIS
@@ -720,6 +908,14 @@ class PolicyServer:
         # lambda indirection so tests can monkeypatch _serve_iteration and
         # exercise the restart path on the live worker
         suffix = f"-{self.name}" if self.name else ""
+        if self.cfg.serve_pipeline:
+            # spawned BEFORE the serve loop so the first batch already
+            # sees a completion worker and takes the pipelined path
+            self._complete_worker = self.supervisor.spawn(
+                "serve-complete" + suffix,
+                lambda: self._complete_iteration(),
+                max_restarts=self.serve_cfg.max_restarts,
+            )
         self._serve_worker = self.supervisor.spawn(
             "serve-loop" + suffix,
             lambda: self._serve_iteration(),
@@ -753,6 +949,22 @@ class PolicyServer:
         if self.supervisor is not None:
             self.supervisor.shutdown(timeout)
             self.supervisor = None
+        self._complete_worker = None
+        # drain the pipeline: records the completion worker never reached
+        # are completed inline — their steps already dispatched, so their
+        # clients still deserve answers (falling back to _fail_record only
+        # if completion itself raises)
+        while True:
+            try:
+                rec = self._complete_q.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                self._complete(rec)
+            except Exception:
+                self._fail_record(rec)
+            finally:
+                self._depth_sem.release()
         for r in self.batcher.drain():
             if not r.future.done():
                 r.future.set_exception(RuntimeError("server stopped"))
@@ -770,6 +982,8 @@ class PolicyServer:
             "arm_switches": self.arm_switches,
             "serve_quantization": self.cfg.serve_quantization,
             "quantized_leaves": self.quantized_leaves,
+            "completed_batches": self.completed_batches,
+            "metrics_skipped": self.metrics_skipped,
         }
         out.update(self.batcher.stats())
         out.update(self.cache.stats())

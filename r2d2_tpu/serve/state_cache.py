@@ -45,6 +45,16 @@ scatter) is single-writer by contract — only the serve loop touches the
 device rows, and `assign` is only ever called from that loop. The
 host-side maps (slots, spill index, counters) are lock-protected so
 `reset` / `evict` / `stats` may be called from any thread.
+
+Under the depth-2 serve pipeline (config.serve_pipeline) both halves of
+a batch's cache interaction — `assign` at STAGE time and `commit` at
+DISPATCH time — still run back-to-back on the one serve thread, so the
+single-writer contract is untouched: batch k+1's assign happens strictly
+after batch k's commit in program order, and the arrays handed to step
+k+1 already reference batch k's (possibly still-executing) donated
+outputs — the device stream, not the host, orders the actual row
+updates. The completion worker never calls into this class; it only
+reads host copies materialized from step outputs.
 """
 
 from __future__ import annotations
@@ -142,7 +152,11 @@ class RecurrentStateCache:
         one request per session per batch.
 
         Serve-loop thread only: demotion reads and promotion scatters
-        touch the device rows.
+        touch the device rows. In the pipelined server this is the STAGE
+        half of the batch's cache interaction — it runs after the
+        previous batch's dispatch-time commit on the same thread, so the
+        slots it hands out gather that batch's committed (possibly
+        still-executing) arrays.
         """
         if len(set(session_ids)) != len(session_ids):
             raise ValueError("duplicate session ids in one batch")
@@ -234,6 +248,9 @@ class RecurrentStateCache:
         """Host-side gather of the promoted sessions' slab rows, taken
         BEFORE any of this batch's demotions write the slab (numpy fancy
         indexing copies, so the rows are immediately reusable)."""
+        # host-list -> index array: pure host work, no device handle in
+        # sight — the serve-step rule's _stage* net is wider than this
+        # r2d2: disable=blocking-host-sync-in-serve-step
         rows = np.array([r for _, r in moves], np.int64)
         return (self._spill_h[rows], self._spill_c[rows],
                 self._spill_la[rows], self._spill_lr[rows])
@@ -376,7 +393,11 @@ class RecurrentStateCache:
         or main during warmup — never concurrently) calls commit, so these
         swaps deliberately take no lock; guarding them would serialize the
         serve loop against stats() for device-array pointer writes that
-        nothing else mutates."""
+        nothing else mutates. In the pipelined server this is the
+        DISPATCH half: it runs right after the async step dispatch and
+        BEFORE the next batch stages, with the arrays still futures — the
+        device stream orders the in-place update, the completion worker
+        never touches these references."""
         # r2d2: disable=cross-thread-unguarded-write  (single-writer contract above)
         self.h, self.c = h, c
         # r2d2: disable=cross-thread-unguarded-write  (single-writer contract above)

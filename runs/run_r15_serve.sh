@@ -1,0 +1,82 @@
+#!/bin/bash
+# Round-15 pipelined-serving chain: the measurement side of the depth-2
+# serve pipeline PR (serve/server.py stage/dispatch/complete split,
+# batcher.BucketStaging zero-copy staging, deferred serve metrics).
+# Three rungs, the headline written to BENCH_r15.json:
+#
+#   1. parity gate — the pipeline test file (bitwise pipelined-vs-serial
+#      at fp32 AND bf16, mixed-task buckets, mid-pipeline hot reload,
+#      same-session streaks across the depth) plus the serve/liveloop
+#      suites the pipeline must not disturb, plus the full static
+#      analysis CLI (the new blocking-host-sync-in-serve-step lint and
+#      the concurrency pass over the serve-complete worker). A parity or
+#      thread-safety regression aborts: a rate search over a server that
+#      answers differently pipelined is measuring the wrong thing.
+#   2. rate search — bench.py --mode serve --rate-search: double-then-
+#      bisect to the maximum sustained Poisson arrival rate whose window
+#      holds --slo-target attainment, pipelined vs serial over ONE
+#      reused server per arm, plus the in-process bitwise parity probe
+#      and the pipeline-on replica-kill cell.
+#   3. scenario spot check — one steady + one replica_kill scenario pass
+#      with the pipeline at its default (on) confirming the chaos plane
+#      still holds under the new threading.
+#
+# PRE-REGISTERED read: pipelined max_rate_at_slo STRICTLY exceeds the
+# serial arm's (the overlap buys real capacity, not just different
+# numbers), bitwise_action_parity is true (it buys it without changing a
+# single action), and the kill cell's sessions_lost == 0 (mid-pipeline
+# batches drain through migration without dropping carries).
+cd /root/repo
+
+. runs/lib.sh
+
+OUT=BENCH_r15.json
+
+echo "=== RUNG 1: parity + thread-safety gate ==="
+python -m pytest tests/test_serve_pipeline.py tests/test_serve.py \
+  tests/test_serve_spill.py tests/test_liveloop.py -q -p no:cacheprovider
+RC=$?
+echo "=== PARITY_PYTEST EXIT: $RC ==="
+python -m r2d2_tpu.analysis.cli --jaxpr --concurrency
+RCA=$?
+echo "=== ANALYSIS EXIT: $RCA ==="
+if [ $RC -ne 0 ] || [ $RCA -ne 0 ]; then
+  echo "=== ABORT: parity gate failed; the rate search would be noise ==="
+  exit 1
+fi
+
+echo "=== RUNG 2: max-sustained-rate search (pipelined vs serial) ==="
+python bench.py --mode serve --rate-search --serve-seconds 5 \
+  --sessions 64 --slo-ms 150 --slo-target 0.98 --rate-start 32 \
+  --serve-out "$OUT" | tee runs/bench_serve_r15.jsonl
+RC=$?
+echo "=== RATE_SEARCH EXIT: $RC ==="
+if [ $RC -ne 0 ]; then
+  echo "=== ABORT: rate search failed ==="
+  exit 1
+fi
+
+echo "=== RUNG 3: scenario spot check (pipeline on) ==="
+python bench.py --mode scenarios --scenario-rate 30 --scenario-seconds 2 \
+  --scenario-sessions 16 | tee runs/bench_scenarios_r15.jsonl
+echo "=== SCENARIOS EXIT: $? ==="
+
+python - "$OUT" <<'PY'
+import json, sys
+report = json.load(open(sys.argv[1]))
+arms = report["arms"]
+pipe = arms["pipelined"]["max_rate_at_slo"]
+ser = arms["serial"]["max_rate_at_slo"]
+assert pipe > ser, f"pipeline bought nothing: pipelined {pipe} vs serial {ser}"
+assert report["bitwise_action_parity"] is True, "pipelined actions diverged"
+kill = report["replica_kill"]
+assert kill["sessions_lost"] == 0, f"kill cell lost {kill['sessions_lost']}"
+assert kill.get("replica_kills", 1) >= 1, "kill never fired; cell is vacuous"
+print(f"r15: max_rate_at_slo pipelined {pipe} vs serial {ser} "
+      f"({pipe / max(ser, 1e-9):.2f}x), parity ok, sessions_lost 0")
+PY
+RC=$?
+echo "=== R15_ASSERT EXIT: $RC ==="
+[ $RC -ne 0 ] && exit 1
+
+echo R15_SERVE_ALL_DONE

