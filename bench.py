@@ -1920,9 +1920,117 @@ def _load_r09_breakdown():
         return None
 
 
+def _load_r14_breakdown():
+    """The committed round-14 breakdown (BENCH_r14.json): baseline for the
+    vs_r14 column — the pre-manual-partitioning step whose loss_grad phase
+    was ~100% of the train step (frac 1.027)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_r14.json")
+    try:
+        with open(path) as fh:
+            return json.load(fh)["parsed"]["breakdown"]
+    except (OSError, KeyError, ValueError):
+        return None
+
+
+def _model_fits_table(cfg, hbm_gb: float = 16.0):
+    """Largest-model-that-fits probe per mesh shape (ISSUE 16): for each
+    (dp, tp, fsdp) shape and each config.MODEL_PRESETS entry, sum the
+    PER-DEVICE TrainState bytes under the sharding table (tp splits the
+    Megatron kernels, fsdp the Adam moments) plus the peak sequence-
+    backward residual of the arm choose_backward_arm picks for whatever
+    HBM remains. Analytic (abstract shapes, no allocation), so the table
+    is exact arithmetic on any host — activations/XLA temps are NOT
+    modeled, making "fits" an upper bound on feasibility, not a promise.
+
+    Mesh shapes are abstract (axis sizes only): the probe is sharding
+    arithmetic, so it covers slices larger than this host."""
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+
+    from r2d2_tpu.config import MODEL_PRESETS, apply_model_preset
+    from r2d2_tpu.learner import init_train_state
+    from r2d2_tpu.ops.pallas_lstm import (
+        choose_backward_arm,
+        seq_backward_residual_bytes,
+    )
+    from r2d2_tpu.parallel.sharding_map import process_name, spec_for
+
+    class _AbstractMesh:
+        """Duck-types the two attrs spec_for reads (axis_names/shape)."""
+
+        axis_names = ("dp", "tp", "fsdp")
+
+        def __init__(self, dp, tp, fsdp):
+            self.shape = {"dp": dp, "tp": tp, "fsdp": fsdp}
+
+    budget = int(hbm_gb * (1 << 30))
+    T = cfg.burn_in_steps + cfg.learning_steps + cfg.forward_steps
+    # ascending by state size so "largest fit" is the last that fits
+    order = [p for p in ("base", "deep", "wide", "deep_wide", "xl")
+             if p in MODEL_PRESETS]
+    table = {}
+    for dp, tp, fsdp in [(1, 1, 1), (8, 1, 1), (4, 2, 1), (2, 2, 2),
+                         (4, 4, 2), (2, 8, 4)]:
+        mesh = _AbstractMesh(dp, tp, fsdp)
+        rows, largest = {}, None
+        for preset in order:
+            pcfg = apply_model_preset(cfg, preset)
+            if pcfg.hidden_dim % tp:
+                rows[preset] = {"fits": False, "reason": f"hidden_dim % tp={tp}"}
+                continue
+            template = jax.eval_shape(
+                lambda k, c=pcfg: init_train_state(c, k)[1],
+                jax.random.PRNGKey(0),
+            )
+            state_bytes = 0
+            for path, leaf in jtu.tree_flatten_with_path(template)[0]:
+                spec = spec_for(process_name(path), leaf, mesh)
+                div = 1
+                for entry in spec:
+                    if entry is None:
+                        continue
+                    for ax in (entry if isinstance(entry, tuple) else (entry,)):
+                        div *= mesh.shape[ax]
+                size = int(np.prod(leaf.shape)) if leaf.shape else 1
+                state_bytes += size * jnp.dtype(leaf.dtype).itemsize // div
+            B_local = max(pcfg.batch_size // (dp * fsdp), 1)
+            H = pcfg.hidden_dim
+            dtype = pcfg.resolved_compute_dtype
+            arm, stride = choose_backward_arm(
+                T, B_local, H, dtype, max(budget - state_bytes, 1)
+            )
+            dz_item = 4 if arm == "default" else jnp.dtype(dtype).itemsize
+            peak = (
+                seq_backward_residual_bytes(T, B_local, H, dtype, stride)[
+                    "carry_residual_bytes"
+                ]
+                + T * B_local * 4 * H * dz_item
+            )
+            total = state_bytes + peak
+            fits = total <= budget
+            rows[preset] = {
+                "state_bytes": state_bytes,
+                "backward_arm": arm,
+                **({"ckpt_stride": stride} if arm == "ckpt" else {}),
+                "peak_residual_bytes": peak,
+                "total_bytes": total,
+                "fits": fits,
+            }
+            if fits:
+                largest = preset
+        table[f"dp{dp}_tp{tp}_fsdp{fsdp}"] = {
+            "largest_fit": largest,
+            "models": rows,
+        }
+    return {"hbm_gb": hbm_gb, "seq_len": T, "batch": cfg.batch_size,
+            "per_mesh_shape": table}
+
+
 def breakdown_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0,
-                   precision: str = "bf16", backward_arm: str = "default",
-                   ckpt_every: int = 0):
+                   precision: str = "bf16", backward_arm: str = "auto",
+                   ckpt_every: int = 0, hbm_gb: float = 16.0,
+                   model_preset: str = ""):
     """Per-phase learner step breakdown: the denominator map for kernel
     work. Times the train step's constituent programs as SEPARATELY
     jitted pieces on one synthetic DeviceBatch —
@@ -1958,6 +2066,10 @@ def breakdown_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0,
     )
     if batch:
         cfg = cfg.replace(batch_size=batch)
+    if model_preset:
+        from r2d2_tpu.config import apply_model_preset
+
+        cfg = apply_model_preset(cfg, model_preset)
     # Backward-arm selection (ISSUE 14): time the pallas backward kernels
     # themselves instead of the scan VJP. Only meaningful on a real TPU —
     # on CPU the pallas path runs in interpret mode and the timings say
@@ -1969,6 +2081,16 @@ def breakdown_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0,
     )
     if seq_T % ckpt_S:
         raise SystemExit(f"--ckpt-every {ckpt_S} does not divide T={seq_T}")
+    # "auto" routes through config.resolve_backward_arm — the budget-driven
+    # selector the trainer itself runs (ISSUE 16) — so BENCH rows record
+    # the arm the selector actually picked, not a hand-chosen one.
+    arm_mode = backward_arm
+    if backward_arm == "auto":
+        backward_arm, auto_stride = cfg.replace(
+            backward_arm="auto"
+        ).resolve_backward_arm()
+        if backward_arm == "ckpt" and auto_stride:
+            ckpt_S = auto_stride
     if backward_arm == "fused_dwh":
         cfg = cfg.replace(lstm_backend="pallas", seq_fused_dwh=True)
     elif backward_arm == "ckpt":
@@ -2065,6 +2187,8 @@ def breakdown_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0,
         "precision": cfg.precision,
         "fused_sequence": cfg.fused_sequence,
         "backward_arm": backward_arm,
+        "backward_arm_mode": arm_mode,
+        "model_preset": model_preset or "base",
         "phases": {
             name: {
                 "ms": round(ms, 3),
@@ -2105,6 +2229,35 @@ def breakdown_main(core: str = "lstm", lru_chunk: int = 0, batch: int = 0,
         }
     else:
         report["vs_r09"] = None
+
+    # vs_r14: same apples-to-apples gating against the round-14 baseline
+    # — the column that shows what the manual-partition round moved
+    # (r14's loss_grad was ~the whole step: frac 1.027)
+    base14 = _load_r14_breakdown()
+    if (
+        base14
+        and base14.get("batch") == B
+        and base14.get("precision") == cfg.precision
+        and base14.get("core") == report["core"]
+    ):
+        report["vs_r14"] = {
+            "step_ms": round(step_ms - base14["value"], 3),
+            "phases": {
+                name: {
+                    "ms": round(ms - base14["phases"][name]["ms"], 3),
+                    "frac_of_step": round(
+                        ms / step_ms - base14["phases"][name]["frac_of_step"], 3
+                    ),
+                }
+                for name, ms in times.items()
+                if name in base14.get("phases", {})
+            },
+        }
+    else:
+        report["vs_r14"] = None
+
+    # largest-model-that-fits per mesh shape (config.MODEL_PRESETS sizing)
+    report["model_fits"] = _model_fits_table(cfg, hbm_gb=hbm_gb)
 
     # Peak-residual-bytes row: what each backward arm pins in HBM across
     # the forward/backward boundary at THESE shapes, from the same
@@ -2511,17 +2664,30 @@ if __name__ == "__main__":
              "(e.g. BENCH_r12.json)",
     )
     p.add_argument(
-        "--backward-arm", default="default",
-        choices=["default", "fused_dwh", "ckpt"],
+        "--backward-arm", default="auto",
+        choices=["auto", "default", "fused_dwh", "ckpt"],
         help="breakdown mode: which seq-backward arm the timed programs "
              "run (fused_dwh / ckpt force lstm_backend=pallas; only "
-             "meaningful on TPU — on CPU pallas runs in interpret mode)",
+             "meaningful on TPU — on CPU pallas runs in interpret mode). "
+             "auto (the default) runs config.resolve_backward_arm's "
+             "budget-driven selection and stamps the pick into the row",
+    )
+    p.add_argument(
+        "--hbm-gb", type=float, default=16.0,
+        help="breakdown mode: per-device HBM budget for the largest-"
+             "model-that-fits table (analytic; activations not modeled)",
     )
     p.add_argument(
         "--ckpt-every", type=int, default=0,
         help="breakdown mode: checkpoint segment length S for the ckpt "
              "arm (0 = largest proper divisor of T); also sets the S the "
              "analytic residual row reports",
+    )
+    p.add_argument(
+        "--model-preset", default="",
+        help="breakdown mode: grow the benched model via "
+             "config.MODEL_PRESETS (wide/deep/xl/deep_wide) before "
+             "timing — the 'grow the brain' rung",
     )
     args = p.parse_args()
     enable_compilation_cache(args.compile_cache)
@@ -2539,7 +2705,8 @@ if __name__ == "__main__":
     elif args.mode == "breakdown":
         breakdown_main(args.core, args.lru_chunk, args.batch, precision,
                        backward_arm=args.backward_arm,
-                       ckpt_every=args.ckpt_every)
+                       ckpt_every=args.ckpt_every, hbm_gb=args.hbm_gb,
+                       model_preset=args.model_preset)
     elif args.mode == "serve":
         if args.rate_search:
             serve_rate_search_main(

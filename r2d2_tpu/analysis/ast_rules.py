@@ -82,6 +82,11 @@ Rule catalog (ids, severities — the table in ARCHITECTURE.md mirrors this):
   both stalls the dispatch pipeline per iteration and silently forks the
   host tree away from the device tree. The in-jit device ops
   (replay/device_sum_tree.py module functions) are not flagged.
+- raw-shard-map-import   (error)    a `jax.experimental.shard_map` import
+  anywhere outside parallel/jax_compat.py: every shard_map must come
+  through the version shim (check_rep/auto vs check_vma/axis_names), and
+  the manual tp×fsdp train step depends on the shim's axis_names=None ->
+  fully-manual defaulting.
 """
 
 from __future__ import annotations
@@ -106,6 +111,7 @@ ALL_RULES = (
     "snapshot-missing-topology",
     "lock-discipline",
     "host-tree-in-hot-loop",
+    "raw-shard-map-import",
 )
 
 # hot-path modules for the host-sync rule: the learner/collection dispatch
@@ -880,6 +886,50 @@ def _rule_lock_discipline(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+def _rule_raw_shard_map_import(tree: ast.Module, path: str) -> List[Finding]:
+    """Every shard_map must come through parallel/jax_compat.shard_map —
+    the version shim that maps the old check_rep/auto API onto the new
+    check_vma/axis_names one. A raw `jax.experimental.shard_map` import
+    anywhere else would pin one jax era's signature and silently diverge
+    from the shim's manual/auto-axis semantics (the tp×fsdp manual train
+    step depends on axis_names=None meaning FULLY manual)."""
+    norm = path.replace(os.sep, "/")
+    if norm.endswith("parallel/jax_compat.py"):
+        return []
+    out: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        out.append(
+            Finding(
+                rule="raw-shard-map-import",
+                severity="error",
+                path=path,
+                line=node.lineno,
+                col=node.col_offset,
+                message=f"{what} bypasses the parallel/jax_compat shim; "
+                "raw jax.experimental.shard_map pins one jax era's "
+                "signature (check_rep vs check_vma) and skips the shim's "
+                "manual-axis defaulting",
+                hint="from r2d2_tpu.parallel.jax_compat import shard_map",
+            )
+        )
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.startswith("jax.experimental.shard_map"):
+                flag(node, f"`from {mod} import ...`")
+            elif mod == "jax.experimental" and any(
+                a.name == "shard_map" for a in node.names
+            ):
+                flag(node, "`from jax.experimental import shard_map`")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("jax.experimental.shard_map"):
+                    flag(node, f"`import {a.name}`")
+    return out
+
+
 _RULES = (
     _rule_host_sync,
     _rule_serve_step_host_sync,
@@ -891,6 +941,7 @@ _RULES = (
     _rule_snapshot_topology,
     _rule_lock_discipline,
     _rule_host_tree_in_hot_loop,
+    _rule_raw_shard_map_import,
 )
 
 

@@ -73,11 +73,14 @@ def _net_and_state(precision: str):
 def _stacked_batch_struct(precision: str, num_steps: int):
     """ShapeDtypeStructs of a (K, B, ...) stacked DeviceBatch at tiny_test
     shapes — tracing needs only avals, not data."""
+    return _stacked_struct_from_cfg(_cfg(precision), num_steps)
+
+
+def _stacked_struct_from_cfg(cfg, num_steps: int):
     import jax
 
     from r2d2_tpu.learner import DeviceBatch
 
-    cfg = _cfg(precision)
     K, B, T, L = num_steps, cfg.batch_size, cfg.seq_len, cfg.learning_steps
     sds = jax.ShapeDtypeStruct
     return DeviceBatch(
@@ -1159,6 +1162,229 @@ def scan_donation(precision: str) -> List[Finding]:
     return check_train_state_donation(precision) + check_store_field_dtypes(precision)
 
 
+# --------------------------------------- manual tp x fsdp / auto-arm entries
+
+
+@functools.lru_cache(maxsize=None)
+def _manual_cfg(precision: str, dp: int, tp: int, fsdp: int):
+    """tiny_test pinned to the tp x fsdp cell — the mesh shape PR 14's
+    validate() used to block, now served by the explicit shard_map path.
+    lstm_backend="scan" because tp shards the cell kernels
+    (models/r2d2.from_config resolves pallas off under tp_shards_params)."""
+    return _cfg(precision).replace(
+        lstm_backend="scan", dp_size=dp, tp_size=tp, fsdp_size=fsdp
+    )
+
+
+def _manual_batch_struct(precision: str, dp: int, tp: int, fsdp: int):
+    """Single (unstacked) DeviceBatch avals at tiny_test shapes — the
+    manual step consumes one host-plane batch per call (train._HostPlane
+    lifts exactly this layout onto the (dp, fsdp) data axes)."""
+    import jax
+
+    from r2d2_tpu.learner import DeviceBatch
+
+    cfg = _manual_cfg(precision, dp, tp, fsdp)
+    B, T, L = cfg.batch_size, cfg.seq_len, cfg.learning_steps
+    sds = jax.ShapeDtypeStruct
+    return DeviceBatch(
+        obs=sds((B, T, *cfg.obs_shape), np.uint8),
+        last_action=sds((B, T), np.int32),
+        last_reward=sds((B, T), np.float32),
+        hidden=sds((B, 2, cfg.hidden_dim), cfg.state_dtype),
+        action=sds((B, L), np.int32),
+        n_step_reward=sds((B, L), np.float32),
+        gamma=sds((B, L), np.float32),
+        burn_in_steps=sds((B,), np.int32),
+        learning_steps=sds((B,), np.int32),
+        forward_steps=sds((B,), np.int32),
+        is_weights=sds((B,), np.float32),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def manual_train_step_jaxpr(precision: str, dp: int, tp: int, fsdp: int) -> str:
+    """Jaxpr text of the explicitly-partitioned (shard_map) train step on
+    the dp x tp x fsdp mesh: per-shard AD under the 1/tp loss scaling, the
+    tp gate-seam all_gathers, the dp(+tp) psum / fsdp psum_scatter
+    gradient reduction, sharded Adam, and the fsdp all_gather back to
+    replicated params — all explicit collectives in the trace instead of
+    GSPMD-inferred ones (the inference that miscompiled this cell)."""
+    import jax
+
+    from r2d2_tpu.learner import init_train_state, make_manual_train_step
+    from r2d2_tpu.parallel.mesh import make_mesh
+
+    cfg = _manual_cfg(precision, dp, tp, fsdp)
+    _net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(dp=dp, tp=tp, fsdp=fsdp)
+    step = make_manual_train_step(cfg, mesh, donate=False)
+    return str(
+        jax.make_jaxpr(step)(state, _manual_batch_struct(precision, dp, tp, fsdp))
+    )
+
+
+def check_manual_train_step_donation(
+    precision: str, dp: int, tp: int, fsdp: int
+) -> List[Finding]:
+    """Donation contract of the manual path's production build
+    (donate_argnums=(0,)): every TrainState leaf must reappear in (shape,
+    dtype) or the collectives force a second resident copy of the model +
+    moments per device."""
+    import jax
+
+    from r2d2_tpu.learner import init_train_state, make_manual_train_step
+    from r2d2_tpu.parallel.mesh import make_mesh
+
+    label = f"manual_train_step[dp={dp},tp={tp},fsdp={fsdp},{precision}].donation"
+    cfg = _manual_cfg(precision, dp, tp, fsdp)
+    _net, state = init_train_state(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(dp=dp, tp=tp, fsdp=fsdp)
+    step = make_manual_train_step(cfg, mesh, donate=True)
+    out_state, _, _ = jax.eval_shape(
+        step, state, _manual_batch_struct(precision, dp, tp, fsdp)
+    )
+    return compare_donated_leaves(state, out_state, label)
+
+
+def scan_manual_train_step(
+    precision: str, dp: int = 2, tp: int = 2, fsdp: int = 2
+) -> List[Finding]:
+    """The tp x fsdp train step (learner.make_manual_train_step): the
+    shard_mapped program holds the same dtype contracts as the golden path
+    (no f64; fp32 plane bf16-free; bf16 plane keeps its fp32 loss/target/
+    priority islands), no host callbacks, and still donates the whole
+    TrainState. No-op when the platform has fewer than dp*tp*fsdp
+    devices."""
+    import jax
+
+    if len(jax.devices()) < dp * tp * fsdp:
+        return []
+    label = f"manual_train_step[dp={dp},tp={tp},fsdp={fsdp},{precision}]"
+    text = manual_train_step_jaxpr(precision, dp, tp, fsdp)
+    out = check_no_float64(text, label)
+    out += check_no_host_callback(text, label)
+    if precision == "fp32":
+        out += check_no_bf16(text, label)
+    else:
+        out += check_fp32_island(text, label)
+    out += check_manual_train_step_donation(precision, dp, tp, fsdp)
+    return out
+
+
+# Budget-discriminable trace shapes for backward_arm="auto": at tiny_test
+# geometry (T=10, B=8, H=32) every arm fits inside the 1 MB budget floor
+# and auto always resolves to "default".
+_AUTO_ARM_H = 512
+_AUTO_ARM_B = 32
+_AUTO_ARM_BUDGET_MB = {
+    # Integer-MB budgets that land choose_backward_arm on each arm at
+    # (T=10, B=32, H=512): bf16 thresholds are default 3.44 MB / fused
+    # 2.19 MB; fp32 default and fused coincide at 3.75 MB (dz_proj ==
+    # dz_f32 — fused buys nothing at fp32, auto skips it by design, so
+    # the fp32 fused cell pins the arm via backward_arm="fused_dwh").
+    ("fp32", "ckpt"): 3,
+    ("bf16", "fused_dwh"): 3,
+    ("bf16", "ckpt"): 2,
+}
+
+
+@functools.lru_cache(maxsize=None)
+def _auto_arm_cfg(precision: str, arm: str):
+    """tiny config whose `backward_arm` knob RESOLVES to the given arm —
+    the trace exercises the new selection path end-to-end
+    (config.resolve_backward_arm -> models/r2d2.from_config), not the
+    legacy seq_fused_dwh / seq_grad_checkpoint knobs the r14 traces pin."""
+    cfg = _cfg(precision).replace(
+        lstm_backend="pallas", hidden_dim=_AUTO_ARM_H, batch_size=_AUTO_ARM_B
+    )
+    mb = _AUTO_ARM_BUDGET_MB.get((precision, arm))
+    if mb is None:
+        return cfg.replace(backward_arm=arm)
+    return cfg.replace(backward_arm="auto", backward_residual_budget_mb=mb)
+
+
+@functools.lru_cache(maxsize=None)
+def _auto_arm_net_and_state(precision: str, arm: str):
+    import jax
+
+    from r2d2_tpu.learner import init_train_state
+
+    return init_train_state(_auto_arm_cfg(precision, arm), jax.random.PRNGKey(0))
+
+
+@functools.lru_cache(maxsize=None)
+def auto_backward_arm_train_step_jaxpr(precision: str, arm: str) -> str:
+    """Jaxpr text of the stacked train step with the backward arm chosen
+    by the budget knob rather than the legacy flags."""
+    import jax
+
+    from r2d2_tpu.learner import make_stacked_batch_train_step
+
+    cfg = _auto_arm_cfg(precision, arm)
+    net, state = _auto_arm_net_and_state(precision, arm)
+    step = make_stacked_batch_train_step(cfg, net, _NUM_STEPS, donate=False)
+    return str(
+        jax.make_jaxpr(step)(state, _stacked_struct_from_cfg(cfg, _NUM_STEPS))
+    )
+
+
+def check_auto_arm_donation(precision: str, arm: str) -> List[Finding]:
+    import jax
+
+    from r2d2_tpu.learner import make_stacked_batch_train_step
+
+    label = f"auto_backward_arm[{arm}][{precision}].donation"
+    cfg = _auto_arm_cfg(precision, arm)
+    net, state = _auto_arm_net_and_state(precision, arm)
+    step = make_stacked_batch_train_step(cfg, net, _NUM_STEPS, donate=True)
+    out_state, _, _ = jax.eval_shape(
+        step, state, _stacked_struct_from_cfg(cfg, _NUM_STEPS)
+    )
+    return compare_donated_leaves(state, out_state, label)
+
+
+def scan_auto_backward_arms(precision: str) -> List[Finding]:
+    """The backward_arm selection path end-to-end: for each non-default
+    arm, a config whose budget (or explicit knob, for the fp32 fused cell
+    auto cannot reach) resolves to it, traced under the same contracts as
+    the legacy-knob arms — no f64, the precision plane's dtype contract,
+    the 3-launch budget, full TrainState donation. A selection drift (the
+    residual accounting moving so the pinned budget stops landing on the
+    arm) is itself a finding, not a silently weaker gate."""
+    out: List[Finding] = []
+    for arm in ("fused_dwh", "ckpt"):
+        label = f"auto_backward_arm[{arm}][{precision}]"
+        cfg = _auto_arm_cfg(precision, arm)
+        resolved, _stride = cfg.resolve_backward_arm()
+        if resolved != arm:
+            out.append(
+                _finding(
+                    "jaxpr-auto-arm-resolution", label,
+                    f"backward_arm={cfg.backward_arm!r} with budget="
+                    f"{cfg.backward_residual_budget_mb}MB resolved to "
+                    f"{resolved!r}, expected {arm!r} — the residual "
+                    "accounting moved under the gate's pinned budgets",
+                    hint="re-derive _AUTO_ARM_BUDGET_MB from "
+                    "ops/pallas_lstm.seq_backward_residual_bytes",
+                )
+            )
+            continue
+        text = auto_backward_arm_train_step_jaxpr(precision, arm)
+        out += check_no_float64(text, label)
+        if precision == "fp32":
+            out += check_no_bf16(text, label)
+        else:
+            out += check_fp32_island(text, label)
+        out += check_kernel_launch_count(
+            text, label, 3,
+            "train step (online fwd + target fwd + one backward kernel — "
+            "arm selection must not add launches)",
+        )
+        out += check_auto_arm_donation(precision, arm)
+    return out
+
+
 def scan_entry_points(
     precisions: Sequence[str] = ("fp32", "bf16"),
 ) -> List[Finding]:
@@ -1174,6 +1400,8 @@ def scan_entry_points(
         out += scan_act_select(p)
         out += scan_fused_unroll(p)
         out += scan_backward_arms(p)
+        out += scan_auto_backward_arms(p)
+        out += scan_manual_train_step(p)
         out += scan_superstep(p)
         out += scan_serve_step(p)
         out += scan_multi_serve_step(p)

@@ -283,6 +283,31 @@ class R2D2Config:
     # HBM for the outside matmul to read). Requires seq_len % S == 0.
     # 0 = off. Pallas-backend knob; the scan backend has scan_chunk.
     seq_grad_checkpoint: int = 0
+    # Backward-arm selector for the fused sequence kernel. The explicit
+    # knobs above (seq_fused_dwh / seq_grad_checkpoint) always win; when
+    # both are off this knob decides which backward the kernel runs:
+    #   "default"   — the bit-identical default backward.
+    #   "fused_dwh" — force the fused-dWh arm.
+    #   "ckpt"      — force the checkpointed arm; the stride S is the
+    #                 smallest divisor >= 2 of seq_len whose residual
+    #                 footprint fits the budget below (least recompute
+    #                 within budget), falling back to the largest divisor.
+    #   "auto"      — pick the first arm whose peak backward-residual
+    #                 bytes (ops/pallas_lstm.seq_backward_residual_bytes
+    #                 carries + the dz pre-activation-grad array) fit
+    #                 backward_residual_budget_mb: default, then
+    #                 fused_dwh, then ckpt. Resolved per-device (the
+    #                 batch slice after dp/fsdp sharding).
+    # These are Pallas sequence-kernel backwards: on the scan backend (or
+    # the lru core) every choice resolves to ("default", 0) — scan_chunk
+    # is that backend's rematerialization knob.
+    backward_arm: str = "auto"
+    # Per-device budget in MiB for the sequence backward's residuals,
+    # read by backward_arm="auto"/"ckpt". The default keeps every
+    # shipped preset on the default arm (default_atari peaks at ~61 MiB
+    # at batch 64), so auto only engages once model presets grow the
+    # residual footprint past one chip's comfort zone.
+    backward_residual_budget_mb: int = 128
 
     # --- parallelism ------------------------------------------------------
     # Data-parallel learner shards the batch over the "dp" mesh axis;
@@ -294,7 +319,40 @@ class R2D2Config:
     # residents after backward residuals) over their first divisible dim.
     # Params stay replicated over fsdp (ZeRO-1 style): grads are computed
     # from whole params, only the Adam moments live sharded. CLI: --fsdp.
+    # Under partitioning="manual" the axis is promoted to ZeRO-2: the
+    # batch ALSO shards over fsdp and gradients reduce-scatter onto the
+    # moment shards (learner.make_manual_train_step).
     fsdp_size: int = 1
+    # Train-step partitioning strategy on a device mesh:
+    #   "gspmd"  — plain jit (or dp-manual shard_map planes): the XLA
+    #              SPMD partitioner propagates the param shardings. The
+    #              historical path; miscompiles the recurrent scan when
+    #              tp-sharded params meet a 3-axis mesh (PR 14).
+    #   "manual" — the whole train step runs inside ONE shard_map that is
+    #              manual over EVERY mesh axis, with per-leaf
+    #              PartitionSpecs from the sharding_map table
+    #              (learner.make_manual_train_step): tp splits the
+    #              LSTM/head kernels with explicit all-gather/psum seams
+    #              at the gate matmuls, the batch shards over dp x fsdp,
+    #              and gradients reduce-scatter over fsdp (ZeRO-2). The
+    #              SPMD partitioner never sees the scan, which is what
+    #              makes tp x fsdp compose.
+    #   "auto"   — "manual" exactly on the tp>1 x fsdp>1 cell (where
+    #              GSPMD cannot go), else "gspmd" (every existing plane
+    #              keeps its bit-exact program).
+    partitioning: str = "auto"
+    # Named model-size presets (config.MODEL_PRESETS): "base" keeps the
+    # run preset's own dims; "wide"/"xl" grow hidden_dim, "deep"/
+    # "deep_wide" stack encoder_depth extra latent layers. Applied as
+    # plain field overrides by apply_model_preset() (train.py
+    # --model-preset); bench.py's largest-model-that-fits probe sizes
+    # them against each mesh shape's per-device HBM.
+    model_preset: str = "base"
+    # Extra Dense(latent)+relu layers appended to the encoder trunk after
+    # the (possibly tp-sharded) latent projection — the deeper-encoder
+    # dial (models/encoders.py). The extra layers are replicated under
+    # tp (no new sharding rules). 0 = the historical trunks, bit-exact.
+    encoder_depth: int = 0
     # chunk size for remat'd long-sequence scans. SCAN-BACKEND KNOB ONLY:
     # the Pallas unroll stores no per-gate residuals (gates are recomputed
     # in its backward kernel), so it has nothing to remat — when the pallas
@@ -436,8 +494,71 @@ class R2D2Config:
         The "sharded" shard_map plane composes the same way — its maps are
         manual over dp ONLY (axis_names={"dp"}), leaving tp GSPMD-auto, so
         tp-sharded params partition the per-dp-shard update body (learner.
-        make_sharded_fused_*). Only the multihost plane pins tp=1."""
+        make_sharded_fused_*). Only the multihost plane pins tp=1.
+
+        Under resolved_partitioning="manual" the params are STILL
+        tp-sharded (same table, same placement) — only the partitioner
+        changes — so every caller's placement/backend decision holds."""
         return self.tp_size > 1 and self.replay_plane != "multihost"
+
+    @property
+    def resolved_partitioning(self) -> str:
+        """"manual" or "gspmd" — the effective train-step partitioning.
+        "auto" resolves to manual exactly on the tp x fsdp cell GSPMD
+        miscompiles; everywhere else the historical paths keep their
+        bit-exact programs."""
+        if self.partitioning != "auto":
+            return self.partitioning
+        return "manual" if (self.tp_size > 1 and self.fsdp_size > 1) else "gspmd"
+
+    def resolve_backward_arm(self, batch_size: Optional[int] = None):
+        """-> (arm, ckpt_stride): the backward arm the fused sequence
+        kernel actually runs, with arm in {"default", "fused_dwh",
+        "ckpt"} and ckpt_stride the checkpoint segment length (0 unless
+        arm == "ckpt").
+
+        Explicit legacy knobs (seq_grad_checkpoint / seq_fused_dwh) win
+        verbatim. Otherwise `backward_arm` decides; "auto" budgets the
+        per-device peak residual bytes via ops/pallas_lstm.
+        choose_backward_arm. Non-pallas backends (and the lru core)
+        always resolve to ("default", 0) — the arms are Pallas sequence-
+        kernel backwards. Deferred imports keep config import-light."""
+        if self.seq_grad_checkpoint > 0:
+            return ("ckpt", self.seq_grad_checkpoint)
+        if self.seq_fused_dwh:
+            return ("fused_dwh", 0)
+        if (
+            self.backward_arm == "default"
+            or self.recurrent_core != "lstm"
+            or not self.fused_sequence
+        ):
+            return ("default", 0)
+        backend = self.lstm_backend
+        if backend == "auto":
+            if self.tp_shards_params:
+                backend = "scan"  # models/r2d2.from_config's resolution
+            else:
+                import jax
+
+                backend = "pallas" if jax.default_backend() == "tpu" else "scan"
+        if backend != "pallas":
+            return ("default", 0)
+        from r2d2_tpu.ops.pallas_lstm import choose_backward_arm
+
+        B = self.batch_size if batch_size is None else batch_size
+        # residuals live per device: the batch shards over dp (and over
+        # fsdp too under manual partitioning's ZeRO-2 data layout)
+        shards = max(self.dp_size, 1)
+        if self.resolved_partitioning == "manual":
+            shards *= max(self.fsdp_size, 1)
+        return choose_backward_arm(
+            self.seq_len,
+            max(B // shards, 1),
+            self.hidden_dim,
+            self.resolved_compute_dtype,
+            self.backward_residual_budget_mb * (1 << 20),
+            mode=self.backward_arm,
+        )
 
     @property
     def seq_len(self) -> int:
@@ -659,14 +780,74 @@ class R2D2Config:
                 "per its P() in_specs; fsdp_size > 1 is a single-controller "
                 "mesh feature (parallel/sharding_map.py)"
             )
-        if self.fsdp_size > 1 and self.tp_size > 1:
+        if self.partitioning not in ("auto", "gspmd", "manual"):
             raise ValueError(
-                "fsdp_size > 1 composes with dp only for now: tp-sharded "
-                "params on a 3-axis mesh miscompile the recurrent scan "
-                "under the current XLA SPMD partitioner (the forward's "
-                "values change — caught by tests/test_sharding_map.py's "
-                "equivalence probe). Shard optimizer state over fsdp xor "
-                "kernels over tp"
+                f"unknown partitioning {self.partitioning!r}; 'gspmd' is "
+                "the historical XLA-SPMD path, 'manual' the explicitly "
+                "shard_mapped train step, 'auto' picks manual exactly on "
+                "the tp x fsdp cell"
+            )
+        if self.fsdp_size > 1 and self.tp_size > 1:
+            # the tp x fsdp cell: supported ONLY by the manual-partition
+            # step — under GSPMD it stays precisely blocked
+            if self.resolved_partitioning != "manual":
+                raise ValueError(
+                    "partitioning='gspmd' composes fsdp with dp only: "
+                    "tp-sharded params on a 3-axis mesh miscompile the "
+                    "recurrent scan under the XLA SPMD partitioner (the "
+                    "forward's values change — caught by tests/"
+                    "test_sharding_map.py's equivalence probe). Use "
+                    "partitioning='manual' (or leave it 'auto'), which "
+                    "takes the partitioner out of the loop by running the "
+                    "step in one explicitly-partitioned shard_map"
+                )
+        if self.resolved_partitioning == "manual":
+            if self.replay_plane != "host":
+                raise ValueError(
+                    "partitioning='manual' is the host-batch train step "
+                    "(learner.make_manual_train_step); the device/sharded/"
+                    "tiered/multihost planes keep their own shard_map or "
+                    "GSPMD programs — use replay_plane='host'"
+                )
+            if self.tp_size > 1 and self.hidden_dim % self.tp_size != 0:
+                raise ValueError(
+                    f"manual tp splits the latent/gate/head kernels into "
+                    f"contiguous column slices; hidden_dim={self.hidden_dim} "
+                    f"must divide by tp_size={self.tp_size}"
+                )
+            shards = max(self.dp_size, 1) * max(self.fsdp_size, 1)
+            if self.batch_size % shards != 0:
+                raise ValueError(
+                    f"partitioning='manual' shards the batch over dp x fsdp "
+                    f"(ZeRO-2 data layout); batch_size={self.batch_size} "
+                    f"must divide by dp_size*fsdp_size={shards}"
+                )
+        if self.backward_arm not in ("auto", "default", "fused_dwh", "ckpt"):
+            raise ValueError(
+                f"unknown backward_arm {self.backward_arm!r}; 'auto' "
+                "budgets peak residual bytes, or force 'default'/"
+                "'fused_dwh'/'ckpt'"
+            )
+        if self.backward_residual_budget_mb < 1:
+            raise ValueError(
+                "backward_residual_budget_mb is the per-device residual "
+                "budget backward_arm='auto' selects against; it must be "
+                ">= 1"
+            )
+        if (
+            self.backward_arm in ("fused_dwh", "ckpt")
+            and self.recurrent_core != "lstm"
+        ):
+            raise ValueError(
+                "backward_arm forces a fused LSTM sequence-kernel "
+                "backward; it requires recurrent_core='lstm'"
+            )
+        if self.encoder_depth < 0:
+            raise ValueError("encoder_depth must be >= 0 (extra latent layers)")
+        if self.model_preset not in MODEL_PRESETS:
+            raise ValueError(
+                f"unknown model_preset {self.model_preset!r}; one of "
+                f"{sorted(MODEL_PRESETS)} (config.MODEL_PRESETS)"
             )
         # Functional-family geometry guards: an episode cap shorter than
         # the env's first possible reward means NO signal ever fires —
@@ -948,6 +1129,40 @@ PRESETS = {
     "long_context": long_context,
     "tiny_test": tiny_test,
 }
+
+
+# --------------------------------------------------------------------------
+# Model-size presets — the "grow the brain" dials (ISSUE 16). Orthogonal to
+# the run PRESETS above: a run preset fixes the task/replay geometry, a
+# model preset scales the net within it. Values are plain field overrides
+# (apply_model_preset), so the resulting config is fully explicit; bench.py
+# --mode breakdown's largest-model-that-fits table sizes each preset's
+# sharded TrainState + backward residuals against per-device HBM for every
+# mesh shape, which is how a preset gets picked for a given slice.
+MODEL_PRESETS = {
+    # historical dims of whatever run preset is active
+    "base": {},
+    # wider LSTM/latent: 4x the core matmul FLOPs/bytes of hidden 512 —
+    # the first rung that NEEDS tp on 16 GB chips at batch 64
+    "wide": {"hidden_dim": 1024},
+    # 2048-wide core: ~16x base core size; tp x fsdp territory
+    "xl": {"hidden_dim": 2048},
+    # deeper encoder at base width: 2 extra replicated latent layers
+    "deep": {"encoder_depth": 2},
+    # the multi-task family recipe: wide core + deeper trunk
+    "deep_wide": {"hidden_dim": 1024, "encoder_depth": 2},
+}
+
+
+def apply_model_preset(cfg: R2D2Config, name: Optional[str] = None) -> R2D2Config:
+    """Overlay a MODEL_PRESETS entry onto `cfg` (default: its own
+    cfg.model_preset field) and stamp the name, re-validating."""
+    name = cfg.model_preset if name is None else name
+    if name not in MODEL_PRESETS:
+        raise ValueError(
+            f"unknown model_preset {name!r}; one of {sorted(MODEL_PRESETS)}"
+        )
+    return cfg.replace(model_preset=name, **MODEL_PRESETS[name])
 
 
 def parse_overrides(pairs) -> dict:

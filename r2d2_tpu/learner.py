@@ -33,6 +33,7 @@ from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import jax.tree_util as jtu
 import optax
 from flax import struct
 
@@ -88,7 +89,11 @@ class DeviceBatch(NamedTuple):
         )
 
 
-def make_optimizer(cfg: R2D2Config) -> optax.GradientTransformation:
+def _adam(cfg: R2D2Config) -> optax.GradientTransformation:
+    """The Adam tail of the optimizer chain — split out so the manual-
+    partition step can run EXACTLY these numerics on moment SHARDS (its
+    global-norm clip needs cross-shard psums, but Adam is elementwise, so
+    the same transformation applies per-shard unchanged)."""
     if cfg.lr_schedule == "cosine":
         # decays over training_steps then HOLDS at lr*lr_final_frac (a
         # resumed run past the horizon keeps the floor, it does not
@@ -99,9 +104,13 @@ def make_optimizer(cfg: R2D2Config) -> optax.GradientTransformation:
         )
     else:
         lr = cfg.lr
+    return optax.adam(lr, eps=cfg.adam_eps)
+
+
+def make_optimizer(cfg: R2D2Config) -> optax.GradientTransformation:
     return optax.chain(
         optax.clip_by_global_norm(cfg.grad_norm),
-        optax.adam(lr, eps=cfg.adam_eps),
+        _adam(cfg),
     )
 
 
@@ -554,6 +563,198 @@ def make_sharded_fused_train_step(
         in_specs=(P(), P("dp"), P("dp"), P("dp"), P("dp")),
         out_specs=(P(), P(), P("dp")),
         axis_names={"dp"},
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_manual_train_step(cfg: R2D2Config, mesh, donate: bool = True):
+    """Fully-manual shard_map train step over ALL mesh axes — the tp×fsdp
+    path that GSPMD miscompiles (PR 14: tp-sharded params on a 3-axis mesh
+    break the recurrent scan's forward; config.resolved_partitioning routes
+    here instead of blocking).
+
+    Partitioning (every spec read from parallel/sharding_map's table, so
+    this step and the GSPMD planes cannot disagree about placement):
+
+      tp    Megatron splits inside the per-shard network itself
+            (R2D2Network.from_config(manual_tp=tp)): column-parallel gate
+            kernels with an explicit per-step all-gather seam at the gate
+            matmul (models/lstm._gates), column/row dueling heads with a
+            psum seam (models/r2d2.RowDense), column-parallel encoder
+            Dense_0. Params replicated over dp and fsdp.
+      dp    batch data parallelism, explicit gradient psum.
+      fsdp  ZeRO-2: the batch ALSO splits over fsdp (manual_data_axes), so
+            each fsdp member owns gradients for a distinct batch slice and
+            the gradient lands on the Adam moment shards via a TRUE
+            reduce-scatter (psum_scatter); Adam runs on shards; updates
+            all-gather back to replicated params.
+
+    Gradient correctness under manual tp (validated bit-level against the
+    unsharded reference): the per-device AD gradient equals the derivative
+    of the SUM of all tp members' objectives w.r.t. the local shard, so
+    with the loss scaled by 1/tp inside value_and_grad, tp-SHARDED leaves'
+    local grads are already exact per-shard (no collective), while
+    REPLICATED leaves (convs, row-parallel biases, deeper encoder Dense,
+    LRU params) need an extra psum over tp to sum their members'
+    contributions.
+
+    The global-norm clip reproduces optax.clip_by_global_norm exactly:
+    per-leaf shard sum-of-squares are psum'd over exactly the axes that
+    leaf is sharded over (tp for table-sharded leaves, fsdp for scattered
+    ones), summed, sqrt'd — the same global norm every device, then the
+    identical where/scale formula. Adam itself is elementwise, so the
+    _adam(cfg) tail runs unchanged on moment shards.
+
+    Signature: jitted (state, batch) -> (state, metrics, priorities) where
+    state leaves are placed per train_state_shardings(mesh) and batch
+    leaves are sharded over (dp, fsdp) on their leading axis
+    (parallel.manual_batch_sharding)."""
+    from jax.sharding import PartitionSpec as P
+    from r2d2_tpu.parallel.jax_compat import shard_map
+    from r2d2_tpu.parallel.mesh import manual_data_axes
+    from r2d2_tpu.parallel.sharding_map import (
+        moment_spec_for,
+        process_name,
+        spec_for,
+        tree_pspecs,
+    )
+
+    tp = int(mesh.shape.get("tp", 1))
+    data_axes = manual_data_axes(mesh)
+    n_data = 1
+    for a in data_axes:
+        n_data *= int(mesh.shape[a])
+    if cfg.batch_size % n_data != 0:
+        raise ValueError(
+            f"batch_size {cfg.batch_size} not divisible by dp*fsdp={n_data}"
+        )
+    has_fsdp = "fsdp" in mesh.axis_names and int(mesh.shape["fsdp"]) > 1
+
+    # the per-shard network: kernels declared at their LOCAL (1/tp) widths,
+    # collective seams inside the module bodies
+    local_net = R2D2Network.from_config(cfg, manual_tp=tp)
+    loss_fn = make_loss_fn(cfg, local_net)
+    adam = _adam(cfg)
+
+    # abstract GLOBAL TrainState -> spec trees + per-param-leaf grad plan
+    template = jax.eval_shape(
+        lambda k: init_train_state(cfg, k)[1], jax.random.PRNGKey(0)
+    )
+    state_specs = tree_pspecs(template, mesh)
+    params_treedef = jax.tree.structure(template.params)
+    grad_plan = []  # aligned with jax.tree.leaves(params): (tp_sharded, fdim)
+    for path, leaf in jtu.tree_flatten_with_path(template.params)[0]:
+        name = process_name(path)
+        pspec = tuple(spec_for(name, leaf, mesh))
+        mspec = tuple(moment_spec_for(name, leaf, mesh))
+        tp_sharded = tp > 1 and "tp" in pspec
+        fdim = mspec.index("fsdp") if (has_fsdp and "fsdp" in mspec) else None
+        grad_plan.append((tp_sharded, fdim))
+
+    batch_spec = P(data_axes)
+    in_batch = DeviceBatch(*([batch_spec] * len(DeviceBatch._fields)))
+    if cfg.num_tasks <= 1:
+        in_batch = in_batch._replace(task=None)
+
+    def body(state: TrainState, b: DeviceBatch):
+        if cfg.zero_state_replay:
+            b = b._replace(hidden=jnp.zeros_like(b.hidden))
+        denom = jnp.sum(b.learning_steps).astype(jnp.float32)
+        denom = jnp.maximum(jax.lax.psum(denom, data_axes), 1.0)
+
+        def objective(params, target_params, b, denom):
+            loss, extras = loss_fn(params, target_params, b, denom)
+            # 1/tp balances AD's accumulation across the tp group (see
+            # docstring); exact no-op at tp=1
+            return loss / tp, extras
+
+        (loss, (priorities, aux)), grads = jax.value_and_grad(
+            objective, has_aux=True
+        )(state.params, state.target_params, b, denom)
+
+        # summing the scaled per-member losses over every axis recovers the
+        # global loss (tp members carry identical copies at weight 1/tp)
+        loss = jax.lax.psum(loss, data_axes + ("tp",))
+        aux = jax.tree.map(lambda x: jax.lax.psum(x, data_axes), aux)
+
+        # gradient reduction per the plan: dp always; +tp for replicated
+        # leaves; fsdp by reduce-scatter onto the moment shard's dim when
+        # it has one (ZeRO-2), full psum otherwise
+        def reduce_grad(g, tp_sharded, fdim):
+            axes = ["dp"]
+            if tp > 1 and not tp_sharded:
+                axes.append("tp")
+            if has_fsdp and fdim is None:
+                axes.append("fsdp")
+            g = jax.lax.psum(g, tuple(axes))
+            if has_fsdp and fdim is not None:
+                g = jax.lax.psum_scatter(
+                    g, "fsdp", scatter_dimension=fdim, tiled=True
+                )
+            return g
+
+        flat_g = [
+            reduce_grad(g, tps, fd)
+            for g, (tps, fd) in zip(jax.tree.leaves(grads), grad_plan)
+        ]
+
+        # global-norm clip == optax.clip_by_global_norm on the full grads:
+        # group leaves by which axes still shard them after reduction
+        partial_sq: Dict[tuple, jnp.ndarray] = {}
+        for g, (tps, fd) in zip(flat_g, grad_plan):
+            axes = []
+            if tps:
+                axes.append("tp")
+            if fd is not None:
+                axes.append("fsdp")
+            key = tuple(axes)
+            sq = jnp.sum(jnp.square(g))
+            partial_sq[key] = partial_sq.get(key, 0.0) + sq
+        total_sq = jnp.float32(0.0)
+        for axes, sq in partial_sq.items():
+            total_sq = total_sq + (jax.lax.psum(sq, axes) if axes else sq)
+        gnorm = jnp.sqrt(total_sq)
+        trigger = gnorm < cfg.grad_norm
+        flat_g = [
+            jnp.where(trigger, g, (g / gnorm.astype(g.dtype)) * cfg.grad_norm)
+            for g in flat_g
+        ]
+        grads = jax.tree.unflatten(params_treedef, flat_g)
+
+        # Adam on shards; updates gather back to replicated param layout
+        clip_state, adam_state = state.opt_state
+        updates, adam_state = adam.update(grads, adam_state)
+        if has_fsdp:
+            flat_u = [
+                jax.lax.all_gather(u, "fsdp", axis=fd, tiled=True)
+                if fd is not None
+                else u
+                for u, (_, fd) in zip(jax.tree.leaves(updates), grad_plan)
+            ]
+            updates = jax.tree.unflatten(params_treedef, flat_u)
+        params = optax.apply_updates(state.params, updates)
+
+        step = state.step + 1
+        sync = (step % cfg.target_net_update_interval) == 0
+        target_params = jax.tree.map(
+            lambda t, p: jnp.where(sync, p, t), state.target_params, params
+        )
+        metrics = {"loss": loss, "grad_norm": gnorm, **aux}
+        new_state = TrainState(
+            params=params,
+            target_params=target_params,
+            opt_state=(clip_state, adam_state),
+            step=step,
+        )
+        return new_state, metrics, priorities
+
+    sharded = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(state_specs, in_batch),
+        out_specs=(state_specs, P(), batch_spec),
+        axis_names=None,  # fully manual over EVERY mesh axis
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())

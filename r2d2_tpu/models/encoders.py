@@ -10,6 +10,21 @@ latent of `latent_dim` features.
 - ImpalaEncoder: the IMPALA-ResNet stack (Espeholt et al. 2018) for the
   Procgen preset (BASELINE.json config 4).
 - MLPEncoder: tiny trunk for unit tests.
+
+Two growth/parallelism dials shared by every trunk (ISSUE 16):
+
+depth    (config.encoder_depth) extra Dense(latent)+relu layers appended
+         after the latent projection — auto-named Dense_1, Dense_2, ...
+         by nn.compact, which the sharding table leaves REPLICATED (only
+         Dense_0 has a column-parallel rule), so deeper trunks need no
+         new sharding rules. depth=0 is the historical trunk, bit-exact.
+tp_size  manual tensor parallelism (learner.make_manual_train_step's
+         shard_map): > 1 builds the SHARD-LOCAL trunk — the latent
+         Dense_0 goes column-parallel (features = latent/tp, matching
+         the table's contiguous column slices; its bias shards with the
+         output axis) and the latent is re-gathered over `tp_axis` after
+         the relu (elementwise, so relu-then-gather == gather-then-relu
+         bit-exactly). Convs stay replicated, exactly as the table says.
 """
 
 from __future__ import annotations
@@ -17,12 +32,27 @@ from __future__ import annotations
 from typing import Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+
+
+def _latent_tail(x, latent_dim, dtype, depth, tp_size, tp_axis):
+    """Shared latent projection: column-parallel Dense_0 (+gather under
+    tp), then `depth` replicated Dense(latent)+relu layers."""
+    x = nn.relu(nn.Dense(latent_dim // tp_size, dtype=dtype)(x))
+    if tp_size > 1:
+        x = jax.lax.all_gather(x, tp_axis, axis=x.ndim - 1, tiled=True)
+    for _ in range(depth):
+        x = nn.relu(nn.Dense(latent_dim, dtype=dtype)(x))
+    return x
 
 
 class NatureEncoder(nn.Module):
     latent_dim: int = 512
     dtype: jnp.dtype = jnp.float32
+    depth: int = 0
+    tp_size: int = 1
+    tp_axis: str = "tp"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -31,8 +61,9 @@ class NatureEncoder(nn.Module):
         x = nn.relu(nn.Conv(64, (4, 4), strides=(2, 2), padding="VALID", dtype=self.dtype)(x))
         x = nn.relu(nn.Conv(64, (3, 3), strides=(1, 1), padding="VALID", dtype=self.dtype)(x))
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(self.latent_dim, dtype=self.dtype)(x))
-        return x
+        return _latent_tail(
+            x, self.latent_dim, self.dtype, self.depth, self.tp_size, self.tp_axis
+        )
 
 
 class ResidualBlock(nn.Module):
@@ -52,6 +83,9 @@ class ImpalaEncoder(nn.Module):
     latent_dim: int = 512
     channels: Sequence[int] = (16, 32, 32)
     dtype: jnp.dtype = jnp.float32
+    depth: int = 0
+    tp_size: int = 1
+    tp_axis: str = "tp"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -63,26 +97,48 @@ class ImpalaEncoder(nn.Module):
             x = ResidualBlock(ch, dtype=self.dtype)(x)
         x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(self.latent_dim, dtype=self.dtype)(x))
-        return x
+        return _latent_tail(
+            x, self.latent_dim, self.dtype, self.depth, self.tp_size, self.tp_axis
+        )
 
 
 class MLPEncoder(nn.Module):
     latent_dim: int = 32
     dtype: jnp.dtype = jnp.float32
+    depth: int = 0
+    tp_size: int = 1
+    tp_axis: str = "tp"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         x = x.astype(self.dtype).reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(self.latent_dim, dtype=self.dtype)(x))
-        return x
+        return _latent_tail(
+            x, self.latent_dim, self.dtype, self.depth, self.tp_size, self.tp_axis
+        )
 
 
-def make_encoder(name: str, latent_dim: int, dtype, impala_channels=(16, 32, 32)):
+def make_encoder(
+    name: str,
+    latent_dim: int,
+    dtype,
+    impala_channels=(16, 32, 32),
+    depth: int = 0,
+    tp_size: int = 1,
+    tp_axis: str = "tp",
+):
+    if tp_size > 1 and latent_dim % tp_size != 0:
+        raise ValueError(
+            f"latent_dim={latent_dim} must divide by tp_size={tp_size} "
+            "(column-parallel latent projection)"
+        )
+    kw = dict(
+        latent_dim=latent_dim, dtype=dtype, depth=depth,
+        tp_size=tp_size, tp_axis=tp_axis,
+    )
     if name == "nature":
-        return NatureEncoder(latent_dim=latent_dim, dtype=dtype)
+        return NatureEncoder(**kw)
     if name == "impala":
-        return ImpalaEncoder(latent_dim=latent_dim, channels=tuple(impala_channels), dtype=dtype)
+        return ImpalaEncoder(**kw)
     if name == "mlp":
-        return MLPEncoder(latent_dim=latent_dim, dtype=dtype)
+        return MLPEncoder(**kw)
     raise ValueError(f"unknown encoder {name!r}")

@@ -55,13 +55,27 @@ class LSTM(nn.Module):
     # (scan_chunk is its rematerialization knob).
     fused_dwh: bool = False
     grad_checkpoint: int = 0
+    # Manual tensor parallelism (learner.make_manual_train_step's
+    # shard_map): > 1 builds the SHARD-LOCAL module — wi/wh/b carry this
+    # device's contiguous 4H/tp column slice, matching the sharding_map
+    # table's column-parallel layout — and _gates re-gathers the
+    # per-shard gate pre-activations over `tp_axis` before the
+    # (replicated) gate/carry math. Scan backend only: the fused Pallas
+    # kernel computes gates in-kernel and cannot host the seam.
+    tp_size: int = 1
+    tp_axis: str = "tp"
 
     def setup(self):
         H = self.hidden_dim
         scale = 1.0 / np.sqrt(H)
-        self.wi = self.param("wi", _uniform_init(scale), (self.in_dim, 4 * H))
-        self.wh = self.param("wh", _uniform_init(scale), (H, 4 * H))
-        self.b = self.param("b", _uniform_init(scale), (4 * H,))
+        if (4 * H) % self.tp_size != 0:
+            raise ValueError(
+                f"LSTM gate width 4*{H} must divide by tp_size={self.tp_size}"
+            )
+        cols = 4 * H // self.tp_size
+        self.wi = self.param("wi", _uniform_init(scale), (self.in_dim, cols))
+        self.wh = self.param("wh", _uniform_init(scale), (H, cols))
+        self.b = self.param("b", _uniform_init(scale), (cols,))
 
     def _params(self):
         return self.wi, self.wh, self.b
@@ -69,6 +83,14 @@ class LSTM(nn.Module):
     def _gates(self, proj: jnp.ndarray, h: jnp.ndarray, wh: jnp.ndarray, c: jnp.ndarray):
         H = self.hidden_dim
         z = proj + h @ wh
+        if self.tp_size > 1:
+            # tp seam: each shard holds a contiguous 4H/tp column slice
+            # of the gate pre-activations (column-parallel wi/wh/b). One
+            # tiled all-gather reconstructs the full z BIT-exactly — the
+            # within-shard matmul reductions are untouched, the gather
+            # only concatenates finished columns — after which gate math
+            # and the (h, c) carry are replicated across tp.
+            z = jax.lax.all_gather(z, self.tp_axis, axis=z.ndim - 1, tiled=True)
         i = jax.nn.sigmoid(z[..., :H])
         f = jax.nn.sigmoid(z[..., H : 2 * H])
         g = jnp.tanh(z[..., 2 * H : 3 * H])
@@ -102,12 +124,19 @@ class LSTM(nn.Module):
         h, c = h.astype(self.dtype), c.astype(self.dtype)
 
         # one MXU-sized matmul for every timestep's input projection
-        proj = (xs.reshape(B * T, D) @ wi + b).reshape(B, T, 4 * self.hidden_dim)
-        proj_t = jnp.swapaxes(proj, 0, 1)  # (T, B, 4H) time-major for scan
+        # (wi.shape[-1] = 4H/tp — the shard-local column count)
+        proj = (xs.reshape(B * T, D) @ wi + b).reshape(B, T, wi.shape[-1])
+        proj_t = jnp.swapaxes(proj, 0, 1)  # (T, B, 4H/tp) time-major for scan
 
         use_pallas = self.backend == "pallas" or (
             self.backend == "auto" and jax.default_backend() == "tpu"
         )
+        if use_pallas and self.tp_size > 1:
+            raise ValueError(
+                "the shard-local (manual-tp) LSTM needs its all-gather "
+                "seam inside the step body; use the scan backend "
+                "(config.validate routes tp here via tp_shards_params)"
+            )
         if use_pallas:
             from r2d2_tpu.ops.pallas_lstm import (
                 lstm_seq_unroll,
@@ -178,7 +207,7 @@ class LSTM(nn.Module):
                 return jax.lax.scan(step, carry, chunk_xs)
 
             p_chunks = proj_t[:main_len].reshape(
-                n_full, chunk, B, 4 * self.hidden_dim
+                n_full, chunk, B, proj_t.shape[-1]
             )
             ts = jnp.arange(T, dtype=jnp.int32)
             if burn_in is None:

@@ -44,6 +44,31 @@ from r2d2_tpu.models.lru import LRU
 from r2d2_tpu.models.lstm import LSTM, Carry
 
 
+class RowDense(nn.Module):
+    """Row-parallel Dense for the manual-tp dueling head outs: the kernel
+    holds this shard's contiguous (in/tp, out) ROW slice, the partial
+    products all-reduce over `tp_axis`, and the REPLICATED bias is added
+    once AFTER the psum (a per-shard bias would count tp times). Param
+    names ("kernel"/"bias") and initializers match nn.Dense, so the
+    sharding table's `*.adv_out.kernel*` row rules and existing global
+    checkpoints line up slice-for-slice. Used only inside
+    learner.make_manual_train_step's shard_map (tp_size > 1); the tp=1
+    golden path keeps plain nn.Dense modules bit-exactly."""
+
+    features: int
+    tp_axis: str = "tp"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features),
+        )
+        bias = self.param("bias", nn.initializers.zeros_init(), (self.features,))
+        return jax.lax.psum(x @ kernel, self.tp_axis) + bias
+
+
 class R2D2Network(nn.Module):
     action_dim: int
     hidden_dim: int = 512
@@ -74,15 +99,30 @@ class R2D2Network(nn.Module):
     # the union action space. 1 = the single-task golden path, bit-exact.
     num_tasks: int = 1
     task_action_dims: Tuple[int, ...] = ()
+    # extra replicated Dense(latent)+relu encoder layers
+    # (config.encoder_depth / MODEL_PRESETS "deep*")
+    encoder_depth: int = 0
+    # manual tensor parallelism: > 1 builds the SHARD-LOCAL network for
+    # learner.make_manual_train_step's shard_map body — every param is
+    # declared at its per-device shard shape from the sharding_map
+    # table's layout (column-parallel latent/gate/hidden kernels,
+    # row-parallel head outs via RowDense, convs/biases-of-row-outs
+    # replicated), with explicit all-gather/psum seams in the module
+    # math. Only meaningful inside a shard_map manual over "tp"; 1 keeps
+    # the historical global modules bit-exactly.
+    tp_size: int = 1
 
     @classmethod
-    def from_config(cls, cfg: R2D2Config) -> "R2D2Network":
+    def from_config(cls, cfg: R2D2Config, manual_tp: int = 1) -> "R2D2Network":
         # GSPMD cannot partition around the Pallas unroll, so auto resolves
         # to scan exactly where the kernels are tp-sharded (shard_map
         # planes keep params replicated and keep the fused kernel)
         backend = cfg.lstm_backend
         if cfg.tp_shards_params and backend == "auto":
             backend = "scan"
+        # the fused-kernel backward arm actually run: explicit legacy
+        # knobs verbatim, else the backward_arm budget selector
+        arm, stride = cfg.resolve_backward_arm()
         return cls(
             action_dim=cfg.action_dim,
             hidden_dim=cfg.hidden_dim,
@@ -100,18 +140,27 @@ class R2D2Network(nn.Module):
             lru_r_min=cfg.lru_r_min,
             lru_r_max=cfg.lru_r_max,
             fused_sequence=cfg.fused_sequence,
-            seq_fused_dwh=cfg.seq_fused_dwh,
-            seq_grad_checkpoint=cfg.seq_grad_checkpoint,
+            seq_fused_dwh=(arm == "fused_dwh"),
+            seq_grad_checkpoint=(stride if arm == "ckpt" else 0),
             num_tasks=cfg.num_tasks,
             task_action_dims=tuple(cfg.task_action_dims),
+            encoder_depth=cfg.encoder_depth,
+            tp_size=manual_tp,
         )
 
     def setup(self):
         dtype = jnp.dtype(self.compute_dtype)
-        self.enc = make_encoder(self.encoder, self.hidden_dim, dtype, self.impala_channels)
+        tp = self.tp_size
+        self.enc = make_encoder(
+            self.encoder, self.hidden_dim, dtype, self.impala_channels,
+            depth=self.encoder_depth, tp_size=tp,
+        )
         # core input = concat(latent, one-hot action, reward) (model.py:59)
         core_in = self.hidden_dim + self.action_dim + 1
         if self.recurrent_core == "lru":
+            # the LRU's params are all replicated under the sharding
+            # table, so the shard-local net reuses the global module
+            # unchanged (enc + heads carry all the tp math)
             self.core = LRU(
                 self.hidden_dim, in_dim=core_in, dtype=dtype,
                 chunk=self.lru_chunk,
@@ -126,13 +175,24 @@ class R2D2Network(nn.Module):
                 backend=self.lstm_backend,
                 fused_dwh=self.seq_fused_dwh,
                 grad_checkpoint=self.seq_grad_checkpoint,
+                tp_size=tp,
             )
         else:
             raise ValueError(f"unknown recurrent_core {self.recurrent_core!r}")
-        self.adv_hidden = nn.Dense(self.hidden_dim)
-        self.adv_out = nn.Dense(self.action_dim)
-        self.val_hidden = nn.Dense(self.hidden_dim)
-        self.val_out = nn.Dense(1)
+        if tp > 1:
+            # Megatron column/row pair per head: the hidden's column
+            # slice feeds this shard's relu'd activations straight into
+            # the out's row slice; one psum per head (inside RowDense)
+            # closes the seam. Matches the table's *.adv/val_* rules.
+            self.adv_hidden = nn.Dense(self.hidden_dim // tp)
+            self.adv_out = RowDense(self.action_dim)
+            self.val_hidden = nn.Dense(self.hidden_dim // tp)
+            self.val_out = RowDense(1)
+        else:
+            self.adv_hidden = nn.Dense(self.hidden_dim)
+            self.adv_out = nn.Dense(self.action_dim)
+            self.val_hidden = nn.Dense(self.hidden_dim)
+            self.val_out = nn.Dense(1)
 
     # ----------------------------------------------------------------- util
 

@@ -814,3 +814,59 @@ def seq_backward_residual_bytes(T: int, B: int, H: int, proj_dtype,
         "c_residual_bytes": T * B * H * 4,
         "carry_residual_bytes": T * B * H * (itemsize + 4),
     }
+
+
+def choose_backward_arm(
+    T: int, B: int, H: int, proj_dtype, budget_bytes: int, mode: str = "auto"
+) -> Tuple[str, int]:
+    """Pick the sequence backward arm from a peak-residual-bytes budget.
+
+    Returns (arm, ckpt_stride) with arm in {"default", "fused_dwh",
+    "ckpt"} and ckpt_stride the checkpoint segment length S (0 unless
+    arm == "ckpt"). Peak = the carry residuals above + the dz
+    pre-activation-grad array the backward materializes: full float32
+    (T, B, 4H) under the default arm (dz feeds the outside dWh matmul in
+    f32), proj-dtype under the fused/ckpt arms (dz only feeds dproj once
+    dWh is accumulated in-kernel). This is exactly the accounting
+    bench.py's `backward_arms` rows report as peak_residual_bytes.
+
+    mode="auto" walks the arms cheapest-recompute-first: default, then
+    fused_dwh, then ckpt with the SMALLEST divisor stride S >= 2 of T
+    whose peak fits (least recompute within budget; larger S means fewer
+    checkpoints but whole-segment gate recompute). When no stride fits,
+    the largest divisor (minimum possible residual) is used — the budget
+    is a selection dial, not a hard allocator. mode="fused_dwh"/"ckpt"/
+    "default" force that arm (ckpt still auto-picks S)."""
+    itemsize = jnp.dtype(proj_dtype).itemsize
+    dz_f32 = T * B * 4 * H * 4
+    dz_proj = T * B * 4 * H * itemsize
+    carry_full = seq_backward_residual_bytes(T, B, H, proj_dtype)[
+        "carry_residual_bytes"
+    ]
+
+    def ckpt_stride() -> int:
+        divisors = [s for s in range(2, T + 1) if T % s == 0]
+        for s in divisors:
+            peak = (
+                seq_backward_residual_bytes(T, B, H, proj_dtype, s)[
+                    "carry_residual_bytes"
+                ]
+                + dz_proj
+            )
+            if peak <= budget_bytes:
+                return s
+        return divisors[-1] if divisors else T
+
+    if mode == "default":
+        return ("default", 0)
+    if mode == "fused_dwh":
+        return ("fused_dwh", 0)
+    if mode == "ckpt":
+        return ("ckpt", ckpt_stride())
+    if mode != "auto":
+        raise ValueError(f"unknown backward-arm mode {mode!r}")
+    if carry_full + dz_f32 <= budget_bytes:
+        return ("default", 0)
+    if carry_full + dz_proj <= budget_bytes:
+        return ("fused_dwh", 0)
+    return ("ckpt", ckpt_stride())

@@ -11,23 +11,31 @@ communication schedule.
 from r2d2_tpu.parallel.mesh import (
     batch_sharding,
     make_mesh,
+    manual_batch_sharding,
+    manual_data_axes,
     replicated_sharding,
     shard_batch,
 )
 from r2d2_tpu.parallel.sharding_map import (
     DEFAULT_RULES,
+    moment_spec_for,
     serve_param_shardings,
     train_state_shardings,
+    tree_pspecs,
     tree_shardings,
 )
 
 __all__ = [
     "make_mesh",
     "batch_sharding",
+    "manual_batch_sharding",
+    "manual_data_axes",
     "replicated_sharding",
     "shard_batch",
     "DEFAULT_RULES",
+    "moment_spec_for",
     "train_state_shardings",
+    "tree_pspecs",
     "tree_shardings",
     "serve_param_shardings",
 ]

@@ -58,6 +58,22 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("dp"))
 
 
+def manual_data_axes(mesh: Mesh) -> tuple:
+    """Mesh axes the manual-partition train step shards the BATCH over:
+    dp always, fsdp too when the mesh carries it. Splitting the batch
+    over fsdp is what promotes the axis from ZeRO-1 to ZeRO-2 — each
+    fsdp member computes gradients for a DISTINCT batch slice, so the
+    gradient reduce-scatter onto the moment shards is a true reduction
+    (scattering replicated gradients would multiply them by fsdp)."""
+    return ("dp", "fsdp") if "fsdp" in mesh.axis_names else ("dp",)
+
+
+def manual_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch sharding for the manual-partition train step: leading axis
+    over (dp, fsdp) — see manual_data_axes."""
+    return NamedSharding(mesh, P(manual_data_axes(mesh)))
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -147,6 +147,27 @@ def spec_for(name: str, leaf, mesh: Mesh, rules=None) -> P:
     return P(*axes)
 
 
+def tree_pspecs(tree, mesh: Mesh, rules=None):
+    """Per-leaf bare PartitionSpecs (no device placement) for ANY pytree
+    via the same wildcard table — the in/out specs of the manual-
+    partition train step's shard_map (learner.make_manual_train_step).
+    One table drives BOTH the GSPMD placement (tree_shardings below) and
+    the manual partitioning, so the two paths cannot disagree about
+    where a leaf lives."""
+    return jtu.tree_map_with_path(
+        lambda p, l: spec_for(process_name(p), l, mesh, rules), tree
+    )
+
+
+def moment_spec_for(param_name: str, leaf, mesh: Mesh, rules=None) -> P:
+    """The spec a param's Adam mu/nu mirror gets: its table axes plus the
+    positional fsdp dim. `param_name` is the processed name within the
+    variables tree (e.g. "params.core.wh"). The manual step's ZeRO-2
+    reduce-scatter reads each gradient leaf's scatter dimension from
+    here, so gradient shards land exactly on the moment shards."""
+    return spec_for(f"opt_state.*.*.mu.{param_name}", leaf, mesh, rules)
+
+
 def tree_shardings(tree, mesh: Mesh, rules=None):
     """Per-leaf NamedShardings for ANY pytree (params, a full TrainState,
     a quantized serve tree) via the wildcard table."""

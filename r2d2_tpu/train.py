@@ -49,13 +49,19 @@ from r2d2_tpu.learner import (
     make_batch_train_step,
     make_fused_train_step,
     make_gather_step,
+    make_manual_train_step,
     make_sharded_fused_train_step,
     make_sharded_gather_step,
     make_stacked_batch_train_step,
     make_train_step,
 )
 from r2d2_tpu.ops.epsilon import epsilon_ladder
-from r2d2_tpu.parallel.mesh import make_mesh, replicated_sharding, shard_batch
+from r2d2_tpu.parallel.mesh import (
+    make_mesh,
+    manual_batch_sharding,
+    replicated_sharding,
+    shard_batch,
+)
 from r2d2_tpu.replay.device_store import DeviceReplayBuffer
 from r2d2_tpu.replay.replay_buffer import ReplayBuffer
 from r2d2_tpu.replay.sharded_store import ShardedDeviceReplay
@@ -160,14 +166,26 @@ class _HostPlane:
     """Host numpy replay; batches ship host->device each update. With a
     mesh, batches shard over dp and XLA inserts the gradient psum. Batches
     are copied out of the store at sample time, so queued items can never
-    go stale (pipelined == inline here)."""
+    go stale (pipelined == inline here).
+
+    partitioning="manual" (the tp×fsdp path GSPMD can't compile — see
+    learner.make_manual_train_step): the step is an explicit shard_map over
+    every mesh axis and the batch additionally splits over fsdp (ZeRO-2),
+    so this plane lifts batches with manual_batch_sharding instead of the
+    dp-only shard_batch."""
 
     steps_per_update = 1
 
     def __init__(self, tr: "Trainer"):
         self.tr = tr
         self.replay = ReplayBuffer(tr.cfg)
-        self.step_fn = make_train_step(tr.cfg, tr.net)
+        self.manual = (
+            tr.mesh is not None and tr.cfg.resolved_partitioning == "manual"
+        )
+        if self.manual:
+            self.step_fn = make_manual_train_step(tr.cfg, tr.mesh)
+        else:
+            self.step_fn = make_train_step(tr.cfg, tr.net)
 
     def sample(self, pipelined: bool = False):
         with span("replay/sample"):
@@ -176,7 +194,10 @@ class _HostPlane:
             def lift():
                 fault_point("host_plane.h2d")
                 dev = DeviceBatch.from_sampled(b)
-                if self.tr.mesh is not None:
+                if self.manual:
+                    sh = manual_batch_sharding(self.tr.mesh)
+                    dev = jax.tree.map(lambda x: jax.device_put(x, sh), dev)
+                elif self.tr.mesh is not None:
                     dev = DeviceBatch(*shard_batch(self.tr.mesh, tuple(dev)))
                 return dev
 
@@ -706,6 +727,12 @@ class Trainer:
                                   devices=jax.devices()[:n_mesh],
                                   fsdp=cfg.fsdp_size)
 
+        # resolved sequence-backward arm (config-static): stamped into
+        # every metrics record so runs are attributable to the arm the
+        # auto-selector actually picked (bench.py stamps BENCH rows the
+        # same way)
+        self._backward_arm, self._backward_arm_stride = cfg.resolve_backward_arm()
+
         self.net, self.state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
         if self.mesh is not None:
             if cfg.replay_plane != "multihost":
@@ -1233,6 +1260,12 @@ class Trainer:
                 "q_mean": float(m["q_mean"]),
                 "episodes": n_ep,
                 "mean_return": (r_sum / n_ep) if n_ep else None,
+                "backward_arm": self._backward_arm,
+                **(
+                    {"backward_arm_stride": self._backward_arm_stride}
+                    if self._backward_arm == "ckpt"
+                    else {}
+                ),
                 **(extra or {}),
             }
         )
@@ -1606,6 +1639,11 @@ def main(argv=None):
                         "shards the Adam mu/nu trees over a third mesh axis "
                         "(parallel/sharding_map.py); replay snapshots are "
                         "fsdp-agnostic, so --resume/--reshard compose freely")
+    p.add_argument("--model-preset", default=None,
+                   help="named model-size preset (config.MODEL_PRESETS: "
+                        "wide/xl widen the LSTM, deep/deep_wide add encoder "
+                        "Dense layers) applied over the run preset; "
+                        "--set still wins on individual fields")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--reshard", action="store_true",
                    help="on --resume, a replay snapshot saved under a "
@@ -1644,6 +1682,10 @@ def main(argv=None):
         initialize_distributed()
 
     cfg = PRESETS[args.preset]()
+    if args.model_preset:
+        from r2d2_tpu.config import apply_model_preset
+
+        cfg = apply_model_preset(cfg, args.model_preset)
     overrides = {}
     if args.env:
         overrides["env_name"] = args.env

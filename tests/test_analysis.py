@@ -618,6 +618,67 @@ def test_backward_arm_gate_both_precisions():
     assert "pallas_call" in ckpt
 
 
+def test_manual_train_step_gate_both_precisions():
+    """The explicitly-partitioned tp x fsdp train step (ISSUE 16:
+    learner.make_manual_train_step on the dp2 x tp2 x fsdp2 mesh) traces
+    clean at fp32 AND bf16 — no f64, no host callbacks, fp32 plane
+    bf16-free, bf16 plane keeps its islands, full TrainState donation —
+    and the trace shows the EXPLICIT collective program (the whole point
+    of leaving GSPMD): the shard_map body with gate-seam all_gathers, the
+    psum gradient reductions, and the ZeRO-2 reduce-scatter."""
+    from r2d2_tpu.analysis import jaxpr_rules
+
+    for precision in ("fp32", "bf16"):
+        findings = jaxpr_rules.scan_manual_train_step(precision)
+        assert findings == [], render_text(findings)
+    text = jaxpr_rules.manual_train_step_jaxpr("fp32", 2, 2, 2)
+    assert "shard_map" in text
+    assert "all_gather" in text  # tp gate seam + ZeRO-2 update re-gather
+    assert "psum" in text  # data-axis (and replicated-leaf tp) reductions
+    assert "reduce_scatter" in text  # ZeRO-2 grads onto moment shards
+
+
+def test_auto_backward_arm_gate_both_precisions():
+    """The backward_arm budget-selection path (ISSUE 16: backward_arm=
+    "auto" + backward_residual_budget_mb, resolved by config.
+    resolve_backward_arm into models/r2d2.from_config): each reachable
+    non-default cell traces clean at both precisions under the same
+    contracts as the legacy-knob arms, including the 3-launch budget."""
+    from r2d2_tpu.analysis import jaxpr_rules
+
+    for precision in ("fp32", "bf16"):
+        findings = jaxpr_rules.scan_auto_backward_arms(precision)
+        assert findings == [], render_text(findings)
+    # the gate's pinned budgets genuinely land on the arms they claim
+    arm, stride = jaxpr_rules._auto_arm_cfg("bf16", "fused_dwh").resolve_backward_arm()
+    assert (arm, stride) == ("fused_dwh", 0)
+    arm, stride = jaxpr_rules._auto_arm_cfg("fp32", "ckpt").resolve_backward_arm()
+    assert arm == "ckpt" and stride >= 2
+
+
+def test_raw_shard_map_import_fires_and_shim_exempt():
+    """Every shard_map must come through parallel/jax_compat.py (the
+    check_rep/auto vs check_vma/axis_names shim): a raw import anywhere
+    else is an error finding, in every spelling; the shim itself and the
+    blessed re-export are clean."""
+    for src in (
+        "from jax.experimental.shard_map import shard_map\n",
+        "from jax.experimental import shard_map\n",
+        "import jax.experimental.shard_map as shmap\n",
+    ):
+        findings, _ = lint(src)
+        assert rules_of(findings) == ["raw-shard-map-import"], src
+    # the shim file is the one place the raw import is the point
+    findings, _ = lint(
+        "from jax.experimental.shard_map import shard_map\n",
+        path="parallel/jax_compat.py",
+    )
+    assert findings == []
+    # the blessed path never fires
+    findings, _ = lint("from r2d2_tpu.parallel.jax_compat import shard_map\n")
+    assert findings == []
+
+
 def test_kernel_launch_count_checker_fires_on_budget_overrun():
     """Negative fixture for the per-arm launch budget: a program with one
     launch too many (the classic regression: dWh split back out into a

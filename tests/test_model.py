@@ -170,3 +170,42 @@ def test_bfloat16_compute_path():
     # heads must still emit float32 (loss math stays f32)
     assert q_learn.dtype == jnp.float32
     assert np.isfinite(np.asarray(q_learn)).all()
+
+
+def test_model_presets_grow_the_brain():
+    """config.MODEL_PRESETS: named sizes for the largest-model-that-fits
+    probe (bench.py fits table). Applying one changes exactly the fields
+    it names; encoder_depth grows real Dense layers."""
+    from r2d2_tpu.config import MODEL_PRESETS, apply_model_preset
+
+    base = tiny_test()
+    assert apply_model_preset(base, "base") .hidden_dim == base.hidden_dim
+    wide = apply_model_preset(base, "wide")
+    assert wide.hidden_dim == 1024 and wide.model_preset == "wide"
+    deep = apply_model_preset(base, "deep")
+    assert deep.encoder_depth == 2 and deep.hidden_dim == base.hidden_dim
+    assert set(MODEL_PRESETS) >= {"base", "wide", "xl", "deep", "deep_wide"}
+    with pytest.raises(ValueError, match="model_preset"):
+        base.replace(model_preset="nope")
+
+
+def test_encoder_depth_adds_dense_layers():
+    cfg = tiny_test().replace(encoder_depth=2)
+    net, params = make_net(cfg)
+    enc = params["params"]["enc"]
+    assert {"Dense_0", "Dense_1", "Dense_2"} <= set(enc)
+    # extra layers are square latent->latent and REPLICATED under tp (no
+    # sharding rule claims Dense_1+ — pinned so the manual-tp step's
+    # grad psum grouping stays correct)
+    from r2d2_tpu.parallel.sharding_map import DEFAULT_RULES, match_axes
+
+    assert enc["Dense_1"]["kernel"].shape == (cfg.hidden_dim, cfg.hidden_dim)
+    assert match_axes("params.enc.Dense_1.kernel", DEFAULT_RULES) == ()
+    rng = np.random.default_rng(7)
+    obs, la, lr, hid = random_inputs(cfg, rng)
+    ones = jnp.ones((1,), jnp.int32)
+    q_learn, _, _ = net.apply(
+        params, obs, la, lr, hid,
+        ones * cfg.burn_in_steps, ones * cfg.learning_steps, ones * cfg.forward_steps,
+    )
+    assert np.isfinite(np.asarray(q_learn)).all()

@@ -595,3 +595,97 @@ class TestScanChunkRemainder:
         np.testing.assert_allclose(np.asarray(outs_a), np.asarray(outs_b), atol=1e-6)
         np.testing.assert_allclose(np.asarray(h_a), np.asarray(h_b), atol=1e-6)
         np.testing.assert_allclose(np.asarray(c_a), np.asarray(c_b), atol=1e-6)
+
+
+class TestChooseBackwardArm:
+    """choose_backward_arm (ops/pallas_lstm.py) + config.resolve_backward_arm:
+    the auto-selector that picks the sequence backward from the peak-
+    residual-bytes budget (ISSUE 16 satellite). Pure shape math — no
+    kernel runs."""
+
+    T, B, H = 84, 8, 512
+
+    def _peaks(self, dtype):
+        d = seq_backward_residual_bytes(self.T, self.B, self.H, dtype)
+        dz_f32 = self.T * self.B * 4 * self.H * 4
+        dz_proj = self.T * self.B * 4 * self.H * jnp.dtype(dtype).itemsize
+        return d["carry_residual_bytes"], dz_f32, dz_proj
+
+    def test_auto_prefers_default_when_budget_fits(self):
+        from r2d2_tpu.ops.pallas_lstm import choose_backward_arm
+
+        carry, dz_f32, _ = self._peaks(jnp.bfloat16)
+        arm, stride = choose_backward_arm(
+            self.T, self.B, self.H, jnp.bfloat16, carry + dz_f32
+        )
+        assert (arm, stride) == ("default", 0)
+
+    def test_auto_steps_down_to_fused_dwh_then_ckpt(self):
+        from r2d2_tpu.ops.pallas_lstm import choose_backward_arm
+
+        carry, dz_f32, dz_proj = self._peaks(jnp.bfloat16)
+        # budget excludes the f32 dz residual but fits the bf16 one
+        arm, stride = choose_backward_arm(
+            self.T, self.B, self.H, jnp.bfloat16, carry + dz_f32 - 1
+        )
+        assert (arm, stride) == ("fused_dwh", 0)
+        # budget below even the fused arm: checkpointing, with the
+        # SMALLEST divisor stride of T=84 whose peak fits
+        arm, stride = choose_backward_arm(
+            self.T, self.B, self.H, jnp.bfloat16, carry + dz_proj - 1
+        )
+        assert arm == "ckpt"
+        assert stride >= 2 and self.T % stride == 0
+        ck = seq_backward_residual_bytes(self.T, self.B, self.H, jnp.bfloat16, stride)
+        assert ck["carry_residual_bytes"] + dz_proj <= carry + dz_proj - 1
+
+    def test_explicit_modes_pass_through(self):
+        from r2d2_tpu.ops.pallas_lstm import choose_backward_arm
+
+        assert choose_backward_arm(10, 4, 16, jnp.float32, 1, "default") == ("default", 0)
+        assert choose_backward_arm(10, 4, 16, jnp.float32, 1, "fused_dwh") == ("fused_dwh", 0)
+        arm, stride = choose_backward_arm(10, 4, 16, jnp.float32, 1, "ckpt")
+        assert arm == "ckpt" and 10 % stride == 0
+        with pytest.raises(ValueError, match="backward-arm"):
+            choose_backward_arm(10, 4, 16, jnp.float32, 1, "nope")
+
+    def test_config_resolution_legacy_knobs_win(self):
+        cfg = tiny_test().replace(lstm_backend="pallas", seq_fused_dwh=True)
+        assert cfg.resolve_backward_arm() == ("fused_dwh", 0)
+        cfg = tiny_test().replace(lstm_backend="pallas", seq_grad_checkpoint=5)
+        assert cfg.resolve_backward_arm() == ("ckpt", 5)
+
+    def test_config_resolution_non_pallas_is_default(self):
+        # scan backend (and the CPU test backend's auto resolution) has no
+        # Pallas sequence backward to pick between
+        assert tiny_test().replace(lstm_backend="scan").resolve_backward_arm() == ("default", 0)
+        assert tiny_test().resolve_backward_arm() == ("default", 0)
+        lru = tiny_test().replace(recurrent_core="lru", lstm_backend="auto")
+        assert lru.resolve_backward_arm() == ("default", 0)
+
+    def test_config_resolution_budget_divides_by_data_shards(self):
+        """The per-device residual budget sees B/(dp*fsdp) under manual
+        partitioning — a model that needs ckpt on one chip can ride the
+        default arm once the batch shards."""
+        carry, dz_f32, _ = self._peaks(jnp.bfloat16)
+        budget_mb = -(-(carry + dz_f32) // (1 << 20))  # ceil to MB: fits 1 shard
+        base = dict(
+            lstm_backend="pallas",
+            precision="bf16",
+            hidden_dim=self.H,
+            batch_size=8 * self.B,
+            burn_in_steps=40,
+            learning_steps=40,
+            block_length=40,
+            forward_steps=4,  # seq_len = 84
+            backward_residual_budget_mb=int(budget_mb),
+        )
+        crowded = tiny_test().replace(**base)
+        arm_1chip, _ = crowded.resolve_backward_arm()
+        assert arm_1chip != "default"  # 8x the batch per device
+        sharded = tiny_test().replace(
+            **base, dp_size=4, fsdp_size=2, replay_plane="host",
+            partitioning="manual",
+        )
+        assert sharded.resolved_partitioning == "manual"
+        assert sharded.resolve_backward_arm() == ("default", 0)
