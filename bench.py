@@ -1207,6 +1207,256 @@ def scenarios_main(
     print(json.dumps(report))
 
 
+def autoscale_main(
+    core: str = "lstm",
+    lru_chunk: int = 0,
+    sessions: int = 64,
+    seconds: float = 16.0,
+    base_rate: float = 0.0,
+    slo_ms: float = 50.0,
+    out_path: str = "",
+    seed: int = 0,
+):
+    """Elastic-fleet economics (ROADMAP item 1): the PR 11 diurnal
+    scenario against the AUTOSCALED fleet (starts at min_replicas=1,
+    grows under sustained SLO pressure, drains back when healthy —
+    serve/autoscale.py) and against a PEAK-SIZED STATIC fleet of
+    max_replicas=2, same seeded arrival trace for both.
+
+    base_rate=0 first calibrates one replica's capacity with a short
+    saturating steady probe, then offers base = capacity/2.6 so the 3x
+    diurnal crest (~1.15x one replica) forces a scale-up while the edges
+    sit comfortably inside one replica. The elastic arm must ride through >= 1
+    scale-up AND >= 1 scale-down with zero lost sessions (the drain
+    migrates through the spill tier), attain the SLO no worse than the
+    static fleet, and spend fewer chip-seconds (the integral of active
+    replicas over the measured horizon; the static fleet holds 2 for all
+    of it). Emits one `serve_autoscale_diurnal` row -> BENCH_r17.json.
+
+    Replicas share the first local device when only one is visible —
+    control-loop behavior (signals, dwells, migration, interlock) is
+    device-count-independent; only the chip-seconds ECONOMICS read
+    differently on real multi-device hardware (noted in the row)."""
+    import tempfile
+
+    from r2d2_tpu.serve import (
+        MultiDeviceServer,
+        ScenarioRunner,
+        ScenarioSpec,
+        ServeConfig,
+    )
+    from r2d2_tpu.utils.compilation_cache import enable_compilation_cache
+
+    # the probe fleet compiles every bucket shape first; with the cache
+    # on, BOTH arms' warmups and — critically — the mid-scenario
+    # add_replica warmup become cache hits instead of stealing the
+    # serving core for whole seconds at the crest. Floor at 0: these
+    # bucket programs compile in tens of milliseconds each, far under
+    # the default persistence threshold, but a dozen of them mid-run is
+    # exactly the scale-up latency this bench is measuring
+    if enable_compilation_cache(tempfile.mkdtemp(prefix="autoscale_bench_cc_")):
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    cfg0 = _system_cfg(core=core, lru_chunk=lru_chunk, precision="fp32")
+    cfg0 = cfg0.replace(
+        # drain-wave sizing rule: a scale-down exports the victim's WHOLE
+        # row set — live sessions plus every churned-out session no
+        # client ever disconnected — into one survivor's slab, so the
+        # slab must hold the scenario's full distinct-session population
+        # (events / session_mean_requests, with slack), not just the
+        # concurrent slots. Undersize it and a mid-traffic drain reports
+        # real rows as sessions_lost.
+        serve_spill=16 * sessions,
+        serve_degrade=True,
+        serve_degrade_slo_ms=slo_ms,
+    )
+    serve_cfg = ServeConfig(
+        # two shapes, not five: a scale-up warms every bucket MID-CREST
+        # on the serving silicon, so each extra bucket is stolen
+        # capacity exactly when the fleet can least afford it
+        buckets=(4, 16),
+        max_wait_ms=2.0,
+        # a tight queue bound makes queue_frac a fast PREDICTIVE pressure
+        # signal (the autoscaler's primary scale-up trigger): 25% of 64
+        # is a backlog the replica still clears inside the SLO, so the
+        # scale-up fires before attainment pays for it
+        queue_depth=64,
+        # the whole session population must fit ONE replica's HBM rows:
+        # the elastic arm starts at a single replica, and judging it on
+        # spill-slab thrash would measure the cache, not the autoscaler
+        cache_capacity=max(32, sessions),
+        poll_interval_s=0.5,
+    )
+    d0 = jax.local_devices()[0]
+
+    if base_rate <= 0:
+        # capacity probe: saturate ONE replica (degrade off: no shedding
+        # valve) and read the answered throughput as its capacity
+        probe_cfg = cfg0.replace(serve_degrade=False).validate()
+        probe = MultiDeviceServer(probe_cfg, serve_cfg, devices=[d0])
+        probe.warmup()
+        probe.start(watch_checkpoints=False)
+        try:
+            # two passes, keep the MIN: the probe's noise is one-sided in
+            # its damage — a cold reading just pads the crest's headroom,
+            # but a hot one inflates base_rate past what the fleet can
+            # absorb and charges the miss to the autoscaler
+            reads = []
+            for rep in range(2):
+                prow = ScenarioRunner(
+                    probe,
+                    ScenarioSpec(name="probe", duration_s=2.0,
+                                 base_rate=1200.0, sessions=sessions,
+                                 seed=seed + 7 + rep),
+                    slo_ms=slo_ms,
+                ).run()
+                reads.append(float(prow["throughput_rps"]))
+        finally:
+            probe.stop()
+        capacity = max(min(reads), 20.0)
+        # the probe reads SATURATED throughput (deep batches amortize
+        # dispatch) and is itself noisy run-to-run; sustainable
+        # interactive rate is lower than either reading. base =
+        # capacity/5 keeps the 3x crest inside one replica's interactive
+        # comfort even on an optimistic probe — the scale-up trigger is
+        # the PREDICTIVE p99 headroom margin, not a queue backlog, so
+        # the crest never needs to strain a replica for the second one
+        # to be bought in time
+        base_rate = round(capacity / 5.0, 1)
+        print(
+            f"[autoscale] calibrated: one replica ~{capacity:.0f} rps -> "
+            f"base_rate={base_rate} (peak {3 * base_rate:.0f})",
+            file=sys.stderr,
+        )
+    else:
+        capacity = 0.0
+
+    spec = ScenarioSpec(
+        name="diurnal", duration_s=seconds, base_rate=base_rate,
+        rate_profile="diurnal", peak_mult=3.0, sessions=sessions,
+        # short sessions = realistic churn: new sessions keep arriving
+        # through the crest, so a freshly activated replica picks up
+        # load through least-loaded routing instead of idling behind
+        # the incumbents' affinity
+        session_mean_requests=8.0,
+        seed=seed + 1,
+    )
+    arms = {}
+    chip_seconds = {}
+    horizon = 0.0
+    trace = []
+
+    for arm in ("autoscale", "static"):
+        if arm == "autoscale":
+            cfg = cfg0.replace(
+                serve_autoscale=True, serve_devices=1,
+                autoscale_min_replicas=1, autoscale_max_replicas=2,
+                # predictive up (p99 past HALF the SLO budget on the ramp
+                # buys the replica while every request is still inside the
+                # SLO — waiting for a queue backlog makes the trigger a
+                # timing lottery and the warmup window a miss window),
+                # modest down-dwell (2 s of unbroken health): the
+                # drain-requires-idle hold carries the real guard — a
+                # drain is a migration wave and only fires once a
+                # replica is truly quiet, i.e. in the post-scenario
+                # tail, where it pays nothing and starts the
+                # chip-second savings sooner
+                autoscale_pressure_margin=0.5,
+                autoscale_dwell_up=2, autoscale_dwell_down=8,
+                autoscale_cooldown_s=1.0, autoscale_interval_s=0.25,
+                autoscale_idle_age_s=0.5,
+            ).validate()
+            server = MultiDeviceServer(cfg, serve_cfg, devices=[d0])
+        else:
+            cfg = cfg0.replace(serve_devices=2).validate()
+            server = MultiDeviceServer(cfg, serve_cfg, devices=[d0, d0])
+        t0 = time.perf_counter()
+        server.warmup()
+        print(f"[autoscale:{arm}] warmup in {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+        server.start(watch_checkpoints=False)
+        try:
+            before = server.stats()
+            server.degrade.reset_window()
+            row = ScenarioRunner(
+                server, spec, slo_ms=slo_ms, timeline=True
+            ).run()
+            if arm == "autoscale":
+                # post-scenario idle tail: the drain decision needs
+                # dwell_down healthy ticks (+ the stale-window horizon if
+                # the tail produced no fresh samples) — the scale-DOWN
+                # half of the elastic round trip
+                deadline = time.monotonic() + 20.0
+                while time.monotonic() < deadline:
+                    st = server.autoscale.stats()
+                    if st["autoscale_scale_downs"] >= 1:
+                        break
+                    time.sleep(0.1)
+                # measured horizon: fleet start -> now, the window the
+                # chip-second integral covers; the static fleet is
+                # charged 2 replicas over the SAME horizon
+                end = time.monotonic()
+                chip_seconds[arm] = round(
+                    server.autoscale.chip_seconds(until=end), 2
+                )
+                horizon = round(end - server.autoscale._t0, 2)
+                trace = server.autoscale.replica_trace()
+                auto_stats = server.autoscale.stats()
+            after = server.stats()
+        finally:
+            server.stop()
+        arms[arm] = {
+            **row,
+            "sessions_lost": after["sessions_lost"] - before["sessions_lost"],
+            "sessions_migrated": after["sessions_migrated"]
+            - before["sessions_migrated"],
+            "shed": after["shed"] - before["shed"],
+            "replicas_added": after.get("replicas_added", 0),
+            "replicas_killed": after.get("replicas_killed", 0),
+            "degrade_rung_ups": after.get("degrade_rung_ups", 0),
+            "degrade_gated_holds": after.get("degrade_gated_holds", 0),
+        }
+        print(
+            f"[autoscale:{arm}] slo={row['slo_attainment']:.3f} "
+            f"p99={row.get('p99_latency_ms') and round(row['p99_latency_ms'], 1)}ms "
+            f"errors={row['errors_total']} "
+            f"lost={arms[arm]['sessions_lost']}",
+            file=sys.stderr,
+        )
+    chip_seconds["static"] = round(2.0 * horizon, 2)
+    report = {
+        "metric": "serve_autoscale_diurnal",
+        "unit": "comparison",
+        "value": round(
+            1.0 - chip_seconds["autoscale"] / max(chip_seconds["static"],
+                                                  1e-9),
+            4,
+        ),  # fraction of chip-seconds the elastic fleet saved
+        "slo_ms": slo_ms,
+        "base_rate": base_rate,
+        "peak_rate": round(3 * base_rate, 1),
+        "capacity_rps_one_replica": round(capacity, 1),
+        "duration_s": seconds,
+        "sessions": sessions,
+        "seed": seed,
+        "scale_ups": auto_stats["autoscale_scale_ups"],
+        "scale_downs": auto_stats["autoscale_scale_downs"],
+        "autoscale_evaluations": auto_stats["autoscale_evaluations"],
+        "replica_trace": trace,
+        "chip_seconds": chip_seconds,
+        "horizon_s": horizon,
+        "shared_device": len(jax.local_devices()) < 2,
+        "arms": arms,
+        "core": cfg0.recurrent_core
+        + (f"_c{cfg0.lru_chunk}" if cfg0.lru_chunk else ""),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"[autoscale] report -> {out_path}", file=sys.stderr)
+    print(json.dumps(report))
+
+
 def liveloop_main(
     core: str = "lstm",
     lru_chunk: int = 0,
@@ -2468,7 +2718,7 @@ if __name__ == "__main__":
         "--mode", default="learner",
         choices=["learner", "system", "fused", "long_context", "serve",
                  "recovery", "breakdown", "scenarios", "liveloop",
-                 "multitask"],
+                 "multitask", "autoscale"],
         help="learner: fused-update throughput on synthetic replay (the "
              "driver's default metric). system: concurrent on-device "
              "collection + learning via threads. fused: the same full "
@@ -2493,7 +2743,12 @@ if __name__ == "__main__":
              "per session over wall-clock at a fixed arrival rate. "
              "multitask: one task-conditioned learner over the pure-JAX "
              "env family (multitask/); per-task trained-vs-random return "
-             "matrix + frames/sec, written to BENCH_r13.json.",
+             "matrix + frames/sec, written to BENCH_r13.json. "
+             "autoscale: the elastic fleet (serve/autoscale.py) vs a "
+             "peak-sized static fleet on the diurnal scenario — SLO "
+             "attainment, sessions_lost through one scale-up and one "
+             "scale-down, replica-count trace, and chip-seconds, written "
+             "to BENCH_r17.json.",
     )
     p.add_argument(
         "--mt-updates", type=int, default=600,
@@ -2639,6 +2894,31 @@ if __name__ == "__main__":
              "(e.g. BENCH_r11.json)",
     )
     p.add_argument(
+        "--autoscale-seconds", type=float, default=16.0,
+        help="autoscale mode: diurnal scenario duration (long enough for "
+             "the crest to buy a replica and the falling edge to drain "
+             "it)",
+    )
+    p.add_argument(
+        "--autoscale-rate", type=float, default=0.0,
+        help="autoscale mode: diurnal BASE rate in requests/s (peak is "
+             "3x); 0 auto-calibrates to half of one replica's measured "
+             "capacity",
+    )
+    p.add_argument(
+        "--autoscale-sessions", type=int, default=64,
+        help="autoscale mode: concurrent session slots",
+    )
+    p.add_argument(
+        "--autoscale-seed", type=int, default=0,
+        help="autoscale mode: seed for the deterministic arrival trace",
+    )
+    p.add_argument(
+        "--autoscale-out", default="",
+        help="autoscale mode: also write the report JSON here "
+             "(e.g. BENCH_r17.json)",
+    )
+    p.add_argument(
         "--liveloop-rate", type=float, default=60.0,
         help="liveloop mode: fixed aggregate arrival rate in requests/s "
              "(Poisson-paced per session)",
@@ -2734,6 +3014,13 @@ if __name__ == "__main__":
                        seconds=args.scenario_seconds,
                        base_rate=args.scenario_rate, slo_ms=args.slo_ms,
                        out_path=args.scenario_out, seed=args.scenario_seed)
+    elif args.mode == "autoscale":
+        autoscale_main(args.core, args.lru_chunk,
+                       sessions=args.autoscale_sessions,
+                       seconds=args.autoscale_seconds,
+                       base_rate=args.autoscale_rate, slo_ms=args.slo_ms,
+                       out_path=args.autoscale_out,
+                       seed=args.autoscale_seed)
     elif args.mode == "system":
         system_main(args.core, args.lru_chunk, precision,
                     args.priority_plane, args.superstep)

@@ -740,15 +740,19 @@ def check_store_field_dtypes(precision: str) -> List[Finding]:
 
 
 def check_trace_budget(trace_count: int, buckets: Sequence[int],
-                       label: str = "serve_step") -> List[Finding]:
-    """The serve step may trace at most once per batch bucket; more means
-    an unstable cache key (a recompile per request shape) slipped in."""
-    if trace_count > len(buckets):
+                       label: str = "serve_step",
+                       arms: int = 1) -> List[Finding]:
+    """The serve step may trace at most once per batch bucket PER weight
+    arm (`arms` > 1 when a degrade ladder pre-warms its quality arms'
+    executables at warmup); more means an unstable cache key (a recompile
+    per request shape) slipped in."""
+    if trace_count > arms * len(buckets):
         return [
             _finding(
                 "jaxpr-trace-budget", label,
                 f"serve step traced {trace_count} times for "
-                f"{len(buckets)} bucket shape(s): some input's shape/dtype "
+                f"{len(buckets)} bucket shape(s) x {arms} arm(s): some "
+                "input's shape/dtype "
                 "or a static arg is varying per call",
                 hint="pad requests to the bucket shapes; keep every other "
                 "input's aval fixed",

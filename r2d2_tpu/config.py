@@ -198,6 +198,54 @@ class R2D2Config:
     # The ladder's SLO target: p99 above this (or attainment below the
     # controller's low-water band) counts as a pressured evaluation.
     serve_degrade_slo_ms: float = 50.0
+    # Elastic autoscaler (serve/autoscale.py). When True the fleet runs a
+    # supervised "autoscaler" control loop that watches the same sliding-
+    # window signals the degrade ladder does (queue fraction, windowed
+    # p99, SLO attainment against serve_degrade_slo_ms) and scales the
+    # REPLICA SET instead of the quality ladder: sustained pressure for
+    # autoscale_dwell_up ticks spawns a warmed replica on a free device
+    # (MultiDeviceServer.add_replica — published under the fleet's shared
+    # params version, then routed), sustained health for
+    # autoscale_dwell_down ticks drains the least-loaded replica through
+    # the kill_replica migration path (sessions spill-migrate, zero loss).
+    # The degrade ladder stays the millisecond shock absorber: while a
+    # scale-up is pending/landing the ladder may step down quality; in
+    # steady state quality steps are gated off so capacity — not quality
+    # — answers sustained pressure. Default False: NO autoscaler object
+    # or thread exists and the fleet is byte-for-byte the static-size
+    # behavior (the golden serve/scenario rows stay bit-exact).
+    serve_autoscale: bool = False
+    # Fleet size bounds the autoscaler may move between. serve_devices is
+    # the STARTING size; min/max clamp every scale decision.
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 2
+    # Consecutive pressured/healthy evaluation ticks before a scale event
+    # (the autoscaler's hysteresis dwell, same contract as the ladder's).
+    autoscale_dwell_up: int = 2
+    autoscale_dwell_down: int = 12
+    # Seconds after any scale event during which no further event fires
+    # (replica warmup + router rebalance settle inside the cooldown).
+    autoscale_cooldown_s: float = 2.0
+    # Evaluation tick interval for the autoscaler worker, in seconds.
+    autoscale_interval_s: float = 0.25
+    # Scale-up pressure judges windowed p99 against THIS FRACTION of the
+    # SLO budget (serve_degrade_slo_ms), not the full budget: a replica
+    # takes seconds to warm, so capacity must be bought while latency
+    # still has headroom, not after misses start. Healthy/recovery
+    # verdicts (and the degrade ladder) still judge the full SLO.
+    autoscale_pressure_margin: float = 0.8
+    # A drain candidate must have gone this long without a request (its
+    # last_request_age_s idle signal) OR be the fleet's least-loaded
+    # replica while the whole fleet is healthy.
+    autoscale_idle_age_s: float = 1.0
+    # When True (default) a scale-down HOLDS until some replica is truly
+    # idle (zero in-flight work, no request for autoscale_idle_age_s):
+    # the fleet's health signals describe the fleet at its CURRENT size
+    # and are blind to what the smaller fleet would feel, so a
+    # comfortable fleet at a traffic crest must not drain a replica into
+    # the crest and pay the migration wave at peak. False: the healthy
+    # dwell alone decides and the least-loaded replica drains.
+    autoscale_drain_requires_idle: bool = True
     # Depth-2 serve pipeline (serve/server.py). When True (default) each
     # batch is split into STAGE (host assembly into preallocated
     # per-bucket staging buffers, RNG draws in arrival order, then the
@@ -696,6 +744,53 @@ class R2D2Config:
             raise ValueError(
                 "serve_log_interval is the serve metrics cadence in "
                 "seconds (0.0 logs every batch); it must be >= 0"
+            )
+        if self.autoscale_min_replicas < 1:
+            raise ValueError(
+                "autoscale_min_replicas must be >= 1 (the autoscaler may "
+                "never drain the last replica, serve/autoscale.py)"
+            )
+        if self.autoscale_max_replicas < self.autoscale_min_replicas:
+            raise ValueError(
+                "autoscale_max_replicas must be >= autoscale_min_replicas "
+                "(the fleet-size band the autoscaler moves inside)"
+            )
+        if self.autoscale_dwell_up < 1 or self.autoscale_dwell_down < 1:
+            raise ValueError(
+                "autoscale_dwell_up/autoscale_dwell_down are consecutive-"
+                "tick hysteresis dwells; both must be >= 1"
+            )
+        if self.autoscale_cooldown_s < 0.0:
+            raise ValueError(
+                "autoscale_cooldown_s is the post-scale-event quiet period "
+                "in seconds; it must be >= 0"
+            )
+        if self.autoscale_interval_s <= 0.0:
+            raise ValueError(
+                "autoscale_interval_s is the autoscaler's evaluation tick "
+                "interval in seconds; it must be > 0"
+            )
+        if self.autoscale_idle_age_s < 0.0:
+            raise ValueError(
+                "autoscale_idle_age_s is the drain candidate's idle "
+                "threshold in seconds; it must be >= 0"
+            )
+        if not 0.0 < self.autoscale_pressure_margin <= 1.0:
+            raise ValueError(
+                "autoscale_pressure_margin is the fraction of the SLO "
+                "budget at which scale-up pressure triggers; it must be "
+                "in (0, 1]"
+            )
+        if self.serve_autoscale and not (
+            self.autoscale_min_replicas
+            <= self.serve_devices
+            <= self.autoscale_max_replicas
+        ):
+            raise ValueError(
+                "serve_autoscale requires the starting fleet size "
+                f"(serve_devices={self.serve_devices}) to sit inside "
+                f"[autoscale_min_replicas={self.autoscale_min_replicas}, "
+                f"autoscale_max_replicas={self.autoscale_max_replicas}]"
             )
         if not 0.0 <= self.liveloop_explore_fraction <= 1.0:
             raise ValueError(

@@ -7,9 +7,15 @@ shapes, and a threaded serve loop with atomic checkpoint hot-reload —
 turning a trained R2D2 checkpoint into a low-latency policy service.
 """
 
+from r2d2_tpu.serve.autoscale import Autoscaler, AutoscaleConfig
 from r2d2_tpu.serve.batcher import MicroBatcher, QueueFullError, ServeRequest
 from r2d2_tpu.serve.client import LocalClient, PolicyClient
-from r2d2_tpu.serve.degrade import RUNGS, DegradeConfig, DegradeController
+from r2d2_tpu.serve.degrade import (
+    RUNGS,
+    DegradeConfig,
+    DegradeController,
+    SignalWindow,
+)
 from r2d2_tpu.serve.multi import MultiDeviceServer, SessionRouter
 from r2d2_tpu.serve.scenarios import (
     Arrival,
@@ -28,6 +34,8 @@ from r2d2_tpu.serve.state_cache import RecurrentStateCache
 
 __all__ = [
     "Arrival",
+    "AutoscaleConfig",
+    "Autoscaler",
     "DegradeConfig",
     "DegradeController",
     "LocalClient",
@@ -44,6 +52,7 @@ __all__ = [
     "ServeRequest",
     "ServeResult",
     "SessionRouter",
+    "SignalWindow",
     "arrival_trace",
     "builtin_scenarios",
     "reference_act",

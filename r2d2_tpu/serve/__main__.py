@@ -61,6 +61,12 @@ def main(argv=None) -> int:
                    help="host-RAM spill slab capacity in sessions "
                         "(default cfg.serve_spill; 0 disables — evicted "
                         "sessions restart fresh)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="elastic fleet (serve/autoscale.py): grow replicas "
+                        "under sustained SLO pressure, drain idle ones "
+                        "through session migration. Bounds and dwells via "
+                        "--set autoscale_min_replicas=1 "
+                        "autoscale_max_replicas=4 ... (config.py)")
     p.add_argument("--dryrun", type=int, default=0, metavar="N",
                    help="serve N synthetic requests in-process (no TCP) "
                         "and exit 0 — the multi-device smoke path")
@@ -84,6 +90,8 @@ def main(argv=None) -> int:
         cfg = cfg.replace(serve_devices=args.devices)
     if args.spill is not None:
         cfg = cfg.replace(serve_spill=args.spill)
+    if args.autoscale:
+        cfg = cfg.replace(serve_autoscale=True)
     cfg = cfg.validate()
     serve_cfg = ServeConfig(
         buckets=tuple(args.buckets),
@@ -94,11 +102,17 @@ def main(argv=None) -> int:
         epsilon=args.epsilon,
     )
     metrics = MetricsLogger(args.metrics) if args.metrics else None
-    if cfg.serve_devices > 1:
+    if cfg.serve_devices > 1 or cfg.serve_autoscale:
+        # an elastic fleet of 1 is still a fleet: add_replica/kill_replica
+        # and the router only exist on the multi-device server
         server = MultiDeviceServer(cfg, serve_cfg, checkpoint_dir=args.ckpt,
                                    metrics=metrics)
-        print(f"[serve] {cfg.serve_devices} replicas: "
-              f"{[str(d) for d in server.devices]}", file=sys.stderr)
+        print(f"[serve] {cfg.serve_devices} replicas"
+              + (" (elastic, "
+                 f"{cfg.autoscale_min_replicas}.."
+                 f"{cfg.autoscale_max_replicas})" if cfg.serve_autoscale
+                 else "")
+              + f": {[str(d) for d in server.devices]}", file=sys.stderr)
     else:
         server = PolicyServer(cfg, serve_cfg, checkpoint_dir=args.ckpt,
                               metrics=metrics)

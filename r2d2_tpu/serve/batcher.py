@@ -108,6 +108,24 @@ class BucketStaging:
         self._sets: dict = {}   # (bucket, flip) -> buffer dict
         self._flip = {b: 0 for b in self.buckets}
 
+    def warm(self, obs_shape: Sequence[int], dtype) -> None:
+        """Preallocate BOTH buffer sets for every bucket at the served obs
+        geometry. PolicyServer.warmup() calls this so a replica the
+        autoscaler adds mid-traffic pays its staging allocations before it
+        enters the routing rotation, not under its first live batches.
+        Buffers already warm at this geometry are kept."""
+        row = np.zeros(tuple(obs_shape), dtype)
+        for bucket in self.buckets:
+            for flip in (0, 1):
+                key = (bucket, flip)
+                bufs = self._sets.get(key)
+                if (
+                    bufs is None
+                    or bufs["obs"].shape[1:] != row.shape
+                    or bufs["obs"].dtype != row.dtype
+                ):
+                    self._sets[key] = self._alloc(bucket, row)
+
     def _alloc(self, bucket: int, row: np.ndarray) -> dict:
         return {
             "obs": np.zeros((bucket, *row.shape), row.dtype),
@@ -195,6 +213,12 @@ class MicroBatcher:
         self._admit_limit: Optional[int] = None
         self._shed_allowance = 0
         self._closed = False
+        # idle signal for the autoscaler's drain decision: monotonic stamp
+        # of the most recent submit() arrival (admitted OR shed — a
+        # shedding replica is overloaded, not idle). Plain attribute
+        # write/read: atomic, and staleness-by-one-request is fine for an
+        # idleness watermark.
+        self.last_submit_t = time.monotonic()
         self.batches = 0
         self.requests = 0
         self.rejected = 0
@@ -214,6 +238,7 @@ class MicroBatcher:
         loop's ServeResult. A full queue fails the future immediately with
         QueueFullError instead of blocking the client thread."""
         fut: Future = Future()
+        self.last_submit_t = time.monotonic()
         if self._closed:
             fut.set_exception(
                 QueueFullError("serve queue closed (replica retired)")
@@ -353,6 +378,7 @@ class MicroBatcher:
             batches = max(self.batches, 1)
             return {
                 "queue_depth": self.qsize(),
+                "last_request_age_s": time.monotonic() - self.last_submit_t,
                 "batches": self.batches,
                 "requests": self.requests,
                 "rejected": self.rejected,

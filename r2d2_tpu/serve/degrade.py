@@ -34,6 +34,15 @@ worker body follows). All mutable controller state lives under one lock;
 rung ACTIONS (publishing an arm does a quantize + H2D) run strictly
 outside it, per the blocking-under-lock rule the PR 10 analyzer enforces.
 
+The windowed latency machinery lives in `SignalWindow` so the elastic
+autoscaler (serve/autoscale.py) computes its scale signals over the SAME
+ring buffer semantics; when an autoscaler runs it installs
+`rung_up_gate` — the scale-vs-degrade interlock: quality-degrading rung
+steps fire only while a scale-up is in flight (or the fleet is pinned at
+max size), so in steady state capacity, not quality, answers sustained
+pressure. The ladder remains the millisecond shock absorber inside a
+scale event's reaction window; recovery steps are never gated.
+
 Default-off: with `config.serve_degrade` False no controller exists, no
 admission watermark is installed, and the publish path never deviates
 from the config arm — the serve plane is bit-identical to before this
@@ -55,6 +64,65 @@ RUNGS: Tuple[str, ...] = ("full", "admit", "bf16", "int8")
 # admission watermark per rung, as a fraction of the queue bound (rung 0
 # installs None: no admission control at all, the bit-identical default)
 _ADMIT_FRAC = {"admit": 0.5, "bf16": 0.375, "int8": 0.25}
+
+
+class SignalWindow:
+    """Sliding latency window + derived SLO signals, shared by the degrade
+    ladder and the elastic autoscaler (serve/autoscale.py).
+
+    A bounded ring buffer of per-request latencies (seconds) fed by the
+    serve completion path; `signals()` derives windowed p99 and SLO
+    attainment against `slo_ms`. Below `min_samples` the latency signals
+    abstain (p99 0.0, attainment 1.0) so a cold window never pressures a
+    controller. Thread-safe: observe() is called from serve loop(s) while
+    controllers read concurrently."""
+
+    def __init__(self, window: int, slo_ms: float, min_samples: int = 8):
+        self.window = int(window)
+        self.slo_ms = float(slo_ms)
+        self.min_samples = int(min_samples)
+        self._lock = threading.Lock()
+        self._buf: List[float] = []  # ring buffer of latency seconds
+        self._idx = 0
+        self._last_observe_t: Optional[float] = None
+
+    def observe(self, latency_s: float) -> None:
+        """One answered request's latency (serve-loop thread(s))."""
+        with self._lock:
+            if len(self._buf) < self.window:
+                self._buf.append(latency_s)
+            else:
+                self._buf[self._idx] = latency_s
+                self._idx = (self._idx + 1) % self.window
+            self._last_observe_t = time.monotonic()
+
+    def reset(self) -> None:
+        """Drop the window (scenario boundaries in the bench)."""
+        with self._lock:
+            self._buf = []
+            self._idx = 0
+            self._last_observe_t = None
+
+    def snapshot(self) -> np.ndarray:
+        with self._lock:
+            return np.asarray(self._buf, np.float64)
+
+    def signals(self) -> Dict[str, float]:
+        lats = self.snapshot()
+        with self._lock:
+            last = self._last_observe_t
+        # sample age lets a controller discount a window that stopped
+        # filling (an idle fleet produces no latencies — its last crest's
+        # p99 must not hold a pressure verdict forever)
+        age = float("inf") if last is None else time.monotonic() - last
+        out = {"p99_ms": 0.0, "attainment": 1.0, "samples": float(lats.size),
+               "age_s": age}
+        if lats.size >= self.min_samples:
+            out["p99_ms"] = float(np.percentile(lats, 99) * 1e3)
+            out["attainment"] = float(
+                np.count_nonzero(lats <= self.slo_ms / 1e3) / lats.size
+            )
+        return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,12 +156,19 @@ class DegradeController:
         self.server = server
         self.cfg = cfg
         self._lock = threading.Lock()
-        self._window: List[float] = []  # ring buffer of latency seconds
-        self._w_idx = 0
+        self.window = SignalWindow(cfg.window, cfg.slo_ms, cfg.min_samples)
         self._up_evals = 0
         self._down_evals = 0
         self._rung = 0
         self._pinned = False
+        # scale-vs-degrade interlock (serve/autoscale.py): when installed,
+        # a quality-degrading rung step (pressured rung-up) fires only
+        # while the gate returns True — i.e. while a scale-up is in flight
+        # or the fleet is already at max size. Recovery is never gated.
+        # None (default, and whenever no autoscaler exists) keeps the
+        # pre-interlock behavior exactly.
+        self.rung_up_gate = None
+        self.gated_holds = 0  # pressured dwells held back by the gate
         self.evaluations = 0
         self.rung_ups = 0
         self.rung_downs = 0
@@ -104,33 +179,20 @@ class DegradeController:
 
     def observe(self, latency_s: float) -> None:
         """One answered request's latency (serve-loop thread(s))."""
-        with self._lock:
-            if len(self._window) < self.cfg.window:
-                self._window.append(latency_s)
-            else:
-                self._window[self._w_idx] = latency_s
-                self._w_idx = (self._w_idx + 1) % self.cfg.window
+        self.window.observe(latency_s)
 
     def reset_window(self) -> None:
         """Drop the latency window (scenario boundaries in the bench)."""
+        self.window.reset()
         with self._lock:
-            self._window = []
-            self._w_idx = 0
             self._up_evals = 0
             self._down_evals = 0
 
     def signals(self) -> Dict[str, float]:
-        with self._lock:
-            lats = np.asarray(self._window, np.float64)
         depth = float(self.server.queue_depth())
         bound = max(float(self.server.queue_bound), 1.0)
-        out = {"queue_frac": depth / bound, "p99_ms": 0.0, "attainment": 1.0,
-               "samples": float(lats.size)}
-        if lats.size >= self.cfg.min_samples:
-            out["p99_ms"] = float(np.percentile(lats, 99) * 1e3)
-            out["attainment"] = float(
-                np.count_nonzero(lats <= self.cfg.slo_ms / 1e3) / lats.size
-            )
+        out = {"queue_frac": depth / bound}
+        out.update(self.window.signals())
         return out
 
     # --------------------------------------------------------------- ladder
@@ -196,6 +258,12 @@ class DegradeController:
             not have_lat or (sig["p99_ms"] <= cfg.slo_ms
                              and sig["attainment"] >= cfg.attain_high)
         )
+        # interlock probe BEFORE taking the controller lock: the gate reads
+        # autoscaler state under the autoscaler's own lock, and degrade-
+        # lock -> autoscale-lock nesting here with the reverse order
+        # anywhere else would be a lock-order cycle
+        gate = self.rung_up_gate
+        gate_open = gate is None or bool(gate())
         apply: Optional[int] = None
         stepped = False
         with self._lock:
@@ -213,10 +281,17 @@ class DegradeController:
                 # between the bands: hold both counters — the dead band is
                 # what keeps an oscillating signal from flapping the ladder
                 if self._up_evals >= cfg.dwell_up and self._rung < len(RUNGS) - 1:
-                    prev, self._rung = self._rung, self._rung + 1
-                    self._up_evals = 0
-                    self._stamp(prev, self._rung, "pressured")
-                    apply, stepped = self._rung, True
+                    if gate_open:
+                        prev, self._rung = self._rung, self._rung + 1
+                        self._up_evals = 0
+                        self._stamp(prev, self._rung, "pressured")
+                        apply, stepped = self._rung, True
+                    else:
+                        # scale-vs-degrade interlock: capacity (a pending
+                        # scale-up) answers sustained pressure; the dwell
+                        # is HELD, not reset, so the rung fires on the
+                        # first tick the gate opens
+                        self.gated_holds += 1
                 elif self._down_evals >= cfg.dwell_down and self._rung > 0:
                     prev, self._rung = self._rung, self._rung - 1
                     self._down_evals = 0
@@ -239,6 +314,7 @@ class DegradeController:
                 "degrade_rung_downs": self.rung_downs,
                 "degrade_evaluations": self.evaluations,
                 "degrade_pinned": self._pinned,
+                "degrade_gated_holds": self.gated_holds,
                 "degrade_transitions": [
                     {"t": round(t, 3), "from": a, "to": b, "reason": r}
                     for t, a, b, r in self.transitions[-16:]

@@ -67,6 +67,11 @@ class SessionRouter:
         # 0 = unbounded; otherwise LRU-drop the stalest affinity once the
         # map outgrows the fleet's total session capacity (see module doc)
         self.max_tracked = max_tracked
+        # per-ACTIVE-replica share of the bound: the fleet's session
+        # capacity changes when the autoscaler grows or drains the fleet,
+        # so the bound is recomputed from this share on every activate /
+        # deactivate / add_slot instead of frozen at construction size
+        self._per_replica = max_tracked // n_replicas if max_tracked else 0
         self._map: "OrderedDict[str, int]" = OrderedDict()
         self._counts = [0] * n_replicas
         # chaos plane: a killed replica is deactivated, never removed —
@@ -79,6 +84,20 @@ class SessionRouter:
         self.new_routes = 0  # sessions placed for the first time
         self.dropped = 0     # affinities LRU-dropped from the map
         self.reroutes = 0    # affinities moved off a deactivated replica
+
+    def _recompute_bound(self) -> None:
+        # caller holds self._lock. 0 stays unbounded forever.
+        if self._per_replica:
+            self.max_tracked = self._per_replica * max(sum(self._active), 1)
+
+    def _trim(self) -> None:  # r2d2: guarded-by(_lock)
+        # caller holds self._lock: LRU-drop down to the (possibly just
+        # shrunk) bound — a dropped session's capacity left the fleet
+        # with the replica that owned it (module-doc argument)
+        while self.max_tracked and len(self._map) > self.max_tracked:
+            _, old_replica = self._map.popitem(last=False)
+            self._counts[old_replica] -= 1
+            self.dropped += 1
 
     def route(self, session_id: str) -> int:
         """The replica index this session's requests must go to."""
@@ -100,20 +119,39 @@ class SessionRouter:
                 replica = ties[zlib.crc32(session_id.encode()) % len(ties)]
                 self._counts[replica] += 1
                 self._map[session_id] = replica
-                if self.max_tracked and len(self._map) > self.max_tracked:
-                    _, old_replica = self._map.popitem(last=False)
-                    self._counts[old_replica] -= 1
-                    self.dropped += 1
+                self._trim()
             self._map.move_to_end(session_id)
             self.routed += 1
             return replica
 
     def deactivate(self, replica: int) -> None:
-        """Take a replica out of rotation (kill path). Its existing
+        """Take a replica out of rotation (kill/drain path). Its existing
         affinities stay mapped until migrated (assign) or re-placed on
-        the session's next route()."""
+        the session's next route(); the LRU bound shrinks with the lost
+        capacity (stalest affinities past the new bound are dropped)."""
         with self._lock:
             self._active[replica] = False
+            self._recompute_bound()
+            self._trim()
+
+    def activate(self, replica: int) -> None:
+        """Put a replica (back) into rotation — the inverse of deactivate:
+        the scale-up path activates a freshly warmed-and-published replica
+        for placement, and the LRU bound grows with the new capacity."""
+        with self._lock:
+            self._active[replica] = True
+            self._recompute_bound()
+
+    def add_slot(self) -> int:
+        """Grow the replica set by one INACTIVE slot and return its index.
+        Two-step add (add_slot, then activate once the replica is warmed
+        and published) so route() can never place a session on a replica
+        that is not serving yet."""
+        with self._lock:
+            self.n_replicas += 1
+            self._counts.append(0)
+            self._active.append(False)
+            return self.n_replicas - 1
 
     def assign(self, session_id: str, replica: int) -> None:
         """Force a session's affinity (migration): move the mapping to
@@ -211,10 +249,18 @@ class MultiDeviceServer:
                 params = self._template.params  # fresh init (smoke serving)
         self._params_host = params  # raw (unquantized) host-side params
 
+        # ONE jitted-step cache for the whole fleet: replicas are clones
+        # (same config and net architecture; params and session stores are
+        # call arguments, not closure state), so a replica added
+        # mid-traffic by the autoscaler reuses the fleet's traced and
+        # compiled step executables — its warmup is a handful of cache
+        # hits, not a trace+compile stall on the serving cores
+        self._step_cache: Dict[bool, object] = {}
         self.replicas: List[PolicyServer] = [
             PolicyServer(
                 cfg, serve_cfg, params=params, metrics=metrics,
-                device=d, name=f"d{i}",
+                device=d, name=f"d{i}", step_cache=self._step_cache,
+                net=self.net, template=self._template,
             )
             for i, d in enumerate(self.devices)
         ]
@@ -248,6 +294,7 @@ class MultiDeviceServer:
                 r.degrade = self.degrade
                 r._degrade_owner = False
         self.replicas_killed = 0
+        self.replicas_added = 0
         self.sessions_migrated = 0
         self.sessions_lost = 0
         # sessions that re-placed on a survivor before their carry was
@@ -260,6 +307,14 @@ class MultiDeviceServer:
             max_delay=max(30.0, serve_cfg.poll_interval_s),
         )
         self.supervisor: Optional[Supervisor] = None
+        # elastic autoscaler (serve/autoscale.py): its own supervised
+        # thread root, started/stopped with the fleet. Default off: no
+        # object, no thread, byte-identical static-fleet behavior.
+        self.autoscale = None
+        if cfg.serve_autoscale:
+            from r2d2_tpu.serve.autoscale import Autoscaler
+
+            self.autoscale = Autoscaler(self)
 
     # ------------------------------------------------------------- serving
 
@@ -343,6 +398,93 @@ class MultiDeviceServer:
             self.sessions_restarted += restarted
         return {"migrated": migrated, "lost": lost, "restarted": restarted}
 
+    def _pick_device(self):
+        """A free local device if one exists; otherwise replicas share
+        round-robin (CPU fleets and tests co-locate replicas per device)."""
+        local = jax.local_devices()
+        free = [d for d in local if d not in self.devices]
+        if free:
+            return free[0]
+        return local[len(self.replicas) % len(local)]
+
+    def add_replica(self, device=None) -> int:
+        """Grow the fleet by one replica — the autoscaler's scale-up verb,
+        also callable directly. The new replica joins the SAME lifecycle
+        the fleet was constructed with, in an order that keeps both the
+        routing and the publish invariants:
+
+        1. construct with the fleet's raw host params and adopt the shared
+           fleet controller/liveloop hooks (never its own worker);
+        2. warmup() — every bucket compiles and the staging buffers
+           preallocate BEFORE any traffic can reach it;
+        3. start its workers (when the fleet is running) while the router
+           still has no slot for it — an idle serve loop on an empty
+           queue;
+        4. adopt it under the single fleet publish: stage its device copy
+           of the current (params, step, arm) outside the reload lock,
+           then install at the fleet's shared version AND activate its
+           router slot inside one critical section — re-staging if a
+           reload/arm-switch won the race — so there is no window where
+           the replica serves params at a version the fleet has moved
+           past, and no routed request before the install.
+
+        Returns the new replica's index. Single-writer contract: scale
+        events are serialized by the caller (the autoscaler worker)."""
+        if device is None:
+            device = self._pick_device()
+        replica = PolicyServer(
+            self.cfg, self.serve_cfg, params=self._params_host,
+            metrics=self.metrics, device=device,
+            name=f"d{len(self.replicas)}", step_cache=self._step_cache,
+            net=self.net, template=self._template,
+        )
+        if self.degrade is not None:
+            # shared fleet controller, never a second evaluation worker
+            replica.degrade = self.degrade
+            replica._degrade_owner = False
+        r0 = self.replicas[0]
+        if r0.tap is not None:
+            # liveloop hooks are fleet-shared single instances (loop.py
+            # installs them on every replica at attach time; a replica
+            # born later inherits them here)
+            replica.tap = r0.tap
+        if r0.eps_assigner is not None:
+            replica.eps_assigner = r0.eps_assigner
+        if self.autoscale is not None:
+            # wire its completion latencies into the autoscaler's window
+            # (no-op when that window is the shared degrade ladder's)
+            self.autoscale.attach(replica)
+        replica.warmup()
+        if self.supervisor is not None:
+            replica.start(watch_checkpoints=False)
+        slot = self.router.add_slot()
+        while True:
+            with self._reload_lock:
+                raw, step, version, arm = (
+                    self._params_host, self._ckpt_step, self._version,
+                    self._arm,
+                )
+            prepared = replica.prepare_for_publish(raw, arm)
+            with self._reload_lock:
+                if (self._version, self._ckpt_step, self._arm) != (
+                    version, step, arm,
+                ):
+                    continue  # a reload/arm switch landed mid-stage
+                replica.install_prepared(
+                    prepared, step, version=version, raw_params=raw,
+                )
+                if len(self.replicas) == slot:
+                    self.replicas.append(replica)
+                    self.devices = self.devices + (device,)
+                self.replicas_added += 1
+                # activation inside the same critical section: from the
+                # first routed request onward the replica is part of every
+                # fleet-wide publish iteration (reload_now / set_arm skip
+                # inactive replicas, so activating later would open a
+                # version-skew window)
+                self.router.activate(slot)
+            return slot
+
     # ------------------------------------------------------ degrade surface
     # (mirrors PolicyServer's so serve/degrade.py drives either; actions
     # fan out to the surviving replicas)
@@ -352,6 +494,11 @@ class MultiDeviceServer:
         # per-replica bound: the ladder reacts to the most pressured
         # replica, not the fleet aggregate a straggler hides inside
         return self.serve_cfg.queue_depth
+
+    def active_replicas(self) -> int:
+        """Replicas currently taking routed traffic (the autoscaler's
+        fleet-size signal; killed/not-yet-activated slots excluded)."""
+        return sum(1 for a in self.router.active() if a)
 
     def queue_depth(self) -> int:
         return max(
@@ -480,6 +627,11 @@ class MultiDeviceServer:
                 lambda: self._degrade_iteration(),
                 max_restarts=self.serve_cfg.max_restarts,
             )
+        if self.autoscale is not None:
+            # its OWN supervised root (serve/autoscale.py): scale events
+            # block on warmup/migration for whole seconds — they must
+            # never share a worker with the sub-second watch/degrade ticks
+            self.autoscale.start()
 
     def check(self) -> Dict[str, int]:
         out = {"worker_restarts": 0, "worker_stalls": 0}
@@ -487,13 +639,20 @@ class MultiDeviceServer:
             c = r.check()
             out["worker_restarts"] += c.get("worker_restarts", 0)
             out["worker_stalls"] += c.get("worker_stalls", 0)
-        if self.supervisor is not None:
-            c = self.supervisor.check()
-            out["worker_restarts"] += c.get("worker_restarts", 0)
-            out["worker_stalls"] += c.get("worker_stalls", 0)
+        sups = [self.supervisor]
+        if self.autoscale is not None:
+            sups.append(self.autoscale.supervisor)
+        for sup in sups:
+            if sup is not None:
+                c = sup.check()
+                out["worker_restarts"] += c.get("worker_restarts", 0)
+                out["worker_stalls"] += c.get("worker_stalls", 0)
         return out
 
     def stop(self, timeout: float = 5.0) -> None:
+        if self.autoscale is not None:
+            # first: no scale event may fire into a stopping fleet
+            self.autoscale.stop(timeout)
         if self.supervisor is not None:
             self.supervisor.shutdown(timeout)
             self.supervisor = None
@@ -524,11 +683,23 @@ class MultiDeviceServer:
             "reloads": self.reloads,
             "reload_errors": self.reload_errors,
             "replicas_killed": self.replicas_killed,
+            "replicas_added": self.replicas_added,
             "sessions_migrated": self.sessions_migrated,
             "sessions_lost": self.sessions_lost,
             "sessions_restarted": self.sessions_restarted,
             "serve_quantization": self.cfg.serve_quantization,
         }
+        # per-replica idle signals alongside the summed counters: the
+        # autoscaler's drain decision reads this triplet (a replica is a
+        # drain candidate when inactive traffic-wise, not merely unlucky
+        # in one stats sweep)
+        out["replica_active"] = self.router.active()
+        out["replica_inflight"] = [
+            s.get("inflight_depth", 0) for s in per_replica
+        ]
+        out["replica_last_request_age_s"] = [
+            round(s.get("last_request_age_s", 0.0), 4) for s in per_replica
+        ]
         for key in self._SUMMED:
             out[key] = sum(s.get(key, 0) for s in per_replica)
         lookups = out["cache_hits"] + out["cache_misses"]
@@ -543,8 +714,17 @@ class MultiDeviceServer:
         cache0 = self.replicas[0].cache
         out["cache_dtype"] = cache0.dtype.name
         out["session_carry_bytes"] = cache0.session_carry_bytes
-        out["cache_capacity"] = cache0.capacity * len(self.replicas)
-        out["spill_capacity"] = cache0.spill_capacity * len(self.replicas)
+        # summed per replica (not capacity * count): with a dynamic fleet
+        # the killed replicas' capacity has left and added replicas' has
+        # joined — only the ACTIVE replicas' rows can hold sessions
+        out["cache_capacity"] = sum(
+            r.cache.capacity
+            for r, a in zip(self.replicas, out["replica_active"]) if a
+        )
+        out["spill_capacity"] = sum(
+            r.cache.spill_capacity
+            for r, a in zip(self.replicas, out["replica_active"]) if a
+        )
         out.update(self.router.stats())
         # liveloop tap/assigner are SHARED across replicas (one instance
         # installed on all), so their stats pass through once, not summed
@@ -553,5 +733,7 @@ class MultiDeviceServer:
                 out[key] = val
         if self.degrade is not None:
             out.update(self.degrade.stats())
+        if self.autoscale is not None:
+            out.update(self.autoscale.stats())
         out["replicas"] = per_replica
         return out

@@ -187,11 +187,16 @@ class ScenarioRunner:
     """
 
     def __init__(self, server, spec: ScenarioSpec, slo_ms: float = 50.0,
-                 drain_s: float = 2.0):
+                 drain_s: float = 2.0, timeline: bool = False):
         self.server = server
         self.spec = spec
         self.slo_ms = slo_ms
         self.drain_s = drain_s
+        # timeline=True adds a per-second "miss_timeline" to the row
+        # ([{t, submitted, misses, p99_ms}...]) — the autoscale bench
+        # reads it to attribute SLO misses to scale events. Default off:
+        # existing scenario rows keep their exact shape.
+        self.timeline = timeline
         self._lock = threading.Lock()
         # (t_submit_rel, latency_s or None, error class or None)
         self._records: List[Tuple[float, Optional[float], Optional[str]]] = []
@@ -379,6 +384,27 @@ class ScenarioRunner:
             row["p50_latency_ms"] = row["p95_latency_ms"] = None
             row["p99_latency_ms"] = None
             row["slo_attainment"] = 0.0
+        if self.timeline:
+            slo_s = self.slo_ms / 1e3
+            buckets: Dict[int, List] = {}
+            for t_rel, lat, err in records:
+                b = buckets.setdefault(int(t_rel), [0, 0, []])
+                b[0] += 1
+                if err is not None or lat is None or lat > slo_s:
+                    b[1] += 1
+                if lat is not None:
+                    b[2].append(lat)
+            row["miss_timeline"] = [
+                {
+                    "t": sec,
+                    "submitted": b[0],
+                    "misses": b[1],
+                    "p99_ms": round(
+                        float(np.percentile(b[2], 99) * 1e3), 1
+                    ) if b[2] else None,
+                }
+                for sec, b in sorted(buckets.items())
+            ]
         return row
 
 

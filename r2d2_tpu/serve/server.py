@@ -217,6 +217,9 @@ class PolicyServer:
         device=None,
         mesh=None,
         name: str = "",
+        step_cache: Optional[Dict[bool, object]] = None,
+        net=None,
+        template=None,
     ):
         self.cfg = cfg
         self.serve_cfg = serve_cfg
@@ -236,7 +239,18 @@ class PolicyServer:
         # worker-name suffix so multi-device supervisors tell replicas apart
         self.name = name
 
-        self.net, self._template = init_train_state(cfg, jax.random.PRNGKey(serve_cfg.seed))
+        # `net`/`template` (serve/multi.py passes the fleet's) skip the
+        # jitted model init: the net is stateless (params are call
+        # arguments) and every replica of a fleet initializes an
+        # identical one from the same seed anyway — re-running init in a
+        # replica forked mid-traffic would stall the serving core on the
+        # init compile for nothing
+        if net is not None and template is not None:
+            self.net, self._template = net, template
+        else:
+            self.net, self._template = init_train_state(
+                cfg, jax.random.PRNGKey(serve_cfg.seed)
+            )
         ckpt_step = -1
         if params is None:
             if checkpoint_dir is not None and latest_checkpoint_step(checkpoint_dir) is not None:
@@ -340,7 +354,17 @@ class PolicyServer:
         # jitted steps by their one trace-relevant switch (in-jit dequant
         # or not); built lazily so the default config compiles exactly the
         # steps it always did. self._step tracks the last-selected one.
-        self._steps: Dict[bool, object] = {}
+        # `step_cache` (serve/multi.py passes a fleet-level dict) SHARES
+        # this cache across a fleet's replicas: replicas are structural
+        # clones — same config, same net architecture, and every piece of
+        # per-replica state (params, session stores, staging) enters the
+        # step as a call argument, never closure state — so a replica the
+        # autoscaler forks mid-traffic warms against the fleet's already
+        # traced + compiled executables instead of stealing the serving
+        # cores for a fresh trace/compile of identical programs.
+        self._steps: Dict[bool, object] = (
+            step_cache if step_cache is not None else {}
+        )
         self._step = self._step_for(self._published[3])
 
         # degradation ladder (serve/degrade.py): default OFF — no
@@ -349,6 +373,10 @@ class PolicyServer:
         # .degrade with ONE shared controller and owns its worker.
         self.degrade: Optional[DegradeController] = None
         self._degrade_owner = False
+        # extra per-request latency observers (objects with .observe(s)) —
+        # the autoscaler installs its own SignalWindow here when it runs
+        # without a degrade ladder to share one with
+        self._latency_sinks: tuple = ()
         if cfg.serve_degrade:
             self.degrade = DegradeController(
                 self, DegradeConfig(slo_ms=cfg.serve_degrade_slo_ms)
@@ -722,11 +750,17 @@ class PolicyServer:
                 staged.eps[:n].copy(), rec.ckpt_step, rec.version,
                 None, None, staged.slots[:n].copy(), rows=rec.tap_rows,
             )
-        if self.degrade is not None:
-            # feed the ladder's latency window (per answered request, the
-            # same queue-to-resolve latency clients experience)
+        if self.degrade is not None or self._latency_sinks:
+            # feed the ladder's latency window and any extra sinks (per
+            # answered request, the same queue-to-resolve latency clients
+            # experience)
+            sinks = self._latency_sinks
             for r in rec.batch:
-                self.degrade.observe(t_done - r.t_enqueue)
+                lat = t_done - r.t_enqueue
+                if self.degrade is not None:
+                    self.degrade.observe(lat)
+                for s in sinks:
+                    s.observe(lat)
         if self.metrics is not None:
             self._log_serve_metrics(rec, t_done)
 
@@ -878,26 +912,51 @@ class PolicyServer:
     def warmup(self) -> None:
         """Pre-trace every bucket shape with pad-only batches so live
         traffic never waits on a compile. Writes touch only the scratch
-        row, so session state is untouched."""
+        row, so session state is untouched. The staging buffers warm
+        alongside the compiles: a replica the autoscaler adds mid-traffic
+        enters the rotation with no first-batch allocations left to pay.
+
+        With a degrade ladder attached, the quality arms' executables
+        warm too — bf16 is a new dtype signature, int8 a new (in-jit
+        dequant) step — because an arm switch fires UNDER overload by
+        definition: a switch that stalls the serving core on a fresh
+        trace+compile mid-crest is a worse latency cliff than the
+        pressure it answers. The trace budget is then arms x buckets
+        (analysis/jaxpr_rules.check_trace_budget's `arms`); the warm
+        params are staged copies, dropped after warmup — the publish
+        cell never moves."""
+        self._staging.warm(self.cfg.obs_shape, np.uint8)
         params, _, _, arm = self._published
-        step_fn = self._step_for(arm)
-        for bucket in self.batcher.buckets:
-            obs = np.zeros((bucket, *self.cfg.obs_shape), np.uint8)
-            h, c, la, lr = self.cache.arrays()
-            warm_args = [
-                params, h, c, la, lr,
-                jnp.asarray(obs), jnp.zeros(bucket, jnp.float32),
-                jnp.full(bucket, self.cache.pad_slot, jnp.int32),
-                jnp.ones(bucket, bool), jnp.zeros(bucket, bool),
-                jnp.zeros(bucket, jnp.int32),
-            ]
-            if self.cfg.num_tasks > 1:
-                warm_args.append(jnp.zeros(bucket, jnp.int32))
-            out = step_fn(*warm_args)
-            q, action, h, c, la, lr = out
-            jax.block_until_ready(q)
-            # commit: on donating backends the old stores were consumed
-            self.cache.commit(h, c, la, lr)
+        warm_arms = [(arm, params)]
+        if self.degrade is not None:
+            for rung_arm in ("bf16", "int8"):
+                if rung_arm != arm:
+                    p, _, _ = self.prepare_for_publish(
+                        self._params_raw, rung_arm
+                    )
+                    warm_arms.append((rung_arm, p))
+        for warm_arm, warm_params in warm_arms:
+            step_fn = self._step_for(warm_arm)
+            for bucket in self.batcher.buckets:
+                obs = np.zeros((bucket, *self.cfg.obs_shape), np.uint8)
+                h, c, la, lr = self.cache.arrays()
+                warm_args = [
+                    warm_params, h, c, la, lr,
+                    jnp.asarray(obs), jnp.zeros(bucket, jnp.float32),
+                    jnp.full(bucket, self.cache.pad_slot, jnp.int32),
+                    jnp.ones(bucket, bool), jnp.zeros(bucket, bool),
+                    jnp.zeros(bucket, jnp.int32),
+                ]
+                if self.cfg.num_tasks > 1:
+                    warm_args.append(jnp.zeros(bucket, jnp.int32))
+                out = step_fn(*warm_args)
+                q, action, h, c, la, lr = out
+                jax.block_until_ready(q)
+                # commit: on donating backends the old stores were consumed
+                self.cache.commit(h, c, la, lr)
+        # leave the published arm as the last-selected step (analysis
+        # introspection reads self._step)
+        self._step_for(arm)
 
     def start(self, watch_checkpoints: Optional[bool] = None) -> None:
         if self.supervisor is not None:
@@ -984,6 +1043,10 @@ class PolicyServer:
             "quantized_leaves": self.quantized_leaves,
             "completed_batches": self.completed_batches,
             "metrics_skipped": self.metrics_skipped,
+            # dispatched-not-yet-completed requests: with the queue depth
+            # and last_request_age_s (batcher stats) this is the idle
+            # signal triplet the autoscaler's drain decision reads
+            "inflight_depth": len(self._inflight),
         }
         out.update(self.batcher.stats())
         out.update(self.cache.stats())

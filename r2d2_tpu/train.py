@@ -1161,6 +1161,104 @@ class Trainer:
         )
         return path
 
+    _RESHARD_LIVE_KEYS = ("dp_size", "tp_size", "fsdp_size", "replay_plane")
+
+    def reshard_live(self, **topology) -> dict:
+        """Elastic live reshard: re-split the replay plane across a new
+        dp/tp/fsdp topology IN THIS PROCESS — the learner-side half of the
+        serve plane's elastic story (serve/autoscale.py): when the fleet
+        grows or drains, the learner follows the topology change without a
+        process exit and restart.
+
+        Sequence: quiesce (drain every deferred plane write-back) ->
+        snapshot the replay through the same atomic writer the preemption
+        path uses -> swap the config/mesh/state placement to the new
+        topology -> rebuild the replay plane -> regather + re-deal the
+        snapshot slabs across the new layout (replay/reshard.py) ->
+        rebind the live actor's replay hooks. The actor object itself is
+        untouched — its RNG streams, env state, and param store carry
+        straight through — and the replay contents round-trip through the
+        lossless snapshot/reshard path, so the resumed run is bit-exact
+        with one that never resharded (tests/test_autoscale.py proves it).
+
+        Accepts only the topology knobs (`dp_size`, `tp_size`,
+        `fsdp_size`, `replay_plane`). Single-process only: the multihost
+        plane reshards through the exit/resume path (reshard_on_resume),
+        where every process re-reads the shared snapshot set. The
+        snapshot file is left in place — it is the crash-safety artifact
+        until the next one overwrites it. Returns a summary dict."""
+        unknown = set(topology) - set(self._RESHARD_LIVE_KEYS)
+        if unknown:
+            raise ValueError(
+                f"reshard_live accepts {self._RESHARD_LIVE_KEYS}, "
+                f"got {sorted(unknown)}"
+            )
+        if (
+            jax.process_count() > 1
+            or self.cfg.replay_plane == "multihost"
+            or topology.get("replay_plane") == "multihost"
+        ):
+            raise NotImplementedError(
+                "live reshard is single-process; multihost topologies "
+                "reshard through exit + resume (cfg.reshard_on_resume)"
+            )
+        from r2d2_tpu.replay.reshard import reshard_replay, snapshot_paths
+
+        # 1. quiesce: every in-flight priority write-back must land in the
+        #    slabs before they are snapshotted
+        self.finish_updates()
+        snap = self.save_replay_snapshot()
+        before_env_steps = self.replay.env_steps
+        before_size = len(self.replay)
+        # 2. swap the topology: new config, new mesh, state re-placed the
+        #    same way __init__ places it (values untouched -> bit-exact)
+        cfg = self.cfg.replace(**topology).validate()
+        self.cfg = cfg
+        self._backward_arm, self._backward_arm_stride = (
+            cfg.resolve_backward_arm()
+        )
+        self.mesh = None
+        if cfg.dp_size * cfg.tp_size * cfg.fsdp_size > 1:
+            n_mesh = cfg.dp_size * cfg.tp_size * cfg.fsdp_size
+            self.mesh = make_mesh(dp=cfg.dp_size, tp=cfg.tp_size,
+                                  devices=jax.devices()[:n_mesh],
+                                  fsdp=cfg.fsdp_size)
+        state_host = jax.device_get(self.state)
+        if self.mesh is not None:
+            from r2d2_tpu.parallel.mesh import train_state_shardings
+
+            self.state = jax.device_put(
+                state_host, train_state_shardings(state_host, self.mesh)
+            )
+        else:
+            self.state = jax.device_put(state_host)
+        # 3. rebuild the plane (its jitted steps re-trace against the new
+        #    mesh) and re-deal the snapshot across the new layout
+        self.plane = _PLANES[cfg.replay_plane](self)
+        self.replay = self.plane.replay
+        self._resume_carry = reshard_replay(
+            self.replay, snapshot_paths(cfg.checkpoint_dir)
+        )
+        # env_steps_offset is unchanged: the restored counter equals the
+        # pre-reshard one, so the global total carries straight through
+        # 4. rebind the actor's replay hooks — the ONLY replay references
+        #    living outside the plane
+        if hasattr(self.actor, "push_block"):
+            self.actor.push_block = self.replay.add_block
+        if hasattr(self.actor, "replay"):
+            self.actor.replay = self.replay
+        return {
+            "snapshot": snap,
+            "replay_plane": cfg.replay_plane,
+            "dp_size": cfg.dp_size,
+            "tp_size": cfg.tp_size,
+            "fsdp_size": cfg.fsdp_size,
+            "env_steps": self.replay.env_steps,
+            "env_steps_before": before_env_steps,
+            "replay_size": len(self.replay),
+            "replay_size_before": before_size,
+        }
+
     def _snapshot_async(self) -> None:
         """Periodic (snapshot_every) snapshot off the hot path: the write
         runs on a background thread; if the previous one is still going it

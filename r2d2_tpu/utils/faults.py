@@ -54,6 +54,14 @@ Registered sites (KNOWN_SITES below):
                         retry site for the replay add itself: finished
                         Blocks -> replay plane (liveloop/loop.py,
                         liveloop/bridge.py)
+- autoscale.evaluate  — top of every autoscaler evaluation tick: an
+                        "error" exercises the supervised-restart drill on
+                        the control loop itself (serve/autoscale.py)
+- autoscale.scale_up  — fires at the exact decision to grow the fleet,
+                        before add_replica runs: scheduled chaos fails a
+                        scale-up mid-pressure (serve/autoscale.py)
+- autoscale.scale_down — fires at the exact decision to drain a replica,
+                        before the victim is chosen (serve/autoscale.py)
 """
 
 from __future__ import annotations
@@ -89,6 +97,9 @@ KNOWN_SITES = (
     "reshard.scatter",
     "liveloop.tap",
     "liveloop.ingest",
+    "autoscale.evaluate",
+    "autoscale.scale_up",
+    "autoscale.scale_down",
 )
 
 

@@ -1,0 +1,83 @@
+#!/bin/bash
+# Round-17 elastic-autoscaler chain: the measurement side of the
+# autoscaler PR (serve/autoscale.py control loop, add_replica adopt path,
+# reshard_live). Three rungs, the comparison written to BENCH_r17.json:
+#
+#   1. elasticity gate — the autoscaler/scenario/serve test files plus
+#      the full static-analysis CLI (AST lints, jaxpr gates, AND the
+#      interprocedural concurrency pass over the new control-loop
+#      thread). A broken scale event or a racy gate aborts the chain:
+#      economics measured over a fleet that loses sessions are noise.
+#   2. parity anchor  — one open-loop serve row with serve_autoscale at
+#      its default (off), so the comparison has a static-plane anchor
+#      and the default path is exercised the same day it ships.
+#   3. elastic vs static — bench.py --mode autoscale: the seeded diurnal
+#      scenario against the autoscaled fleet (starts at 1 replica, grows
+#      under sustained SLO pressure, drains back when healthy) and
+#      against a peak-sized static fleet of 2, same arrival trace.
+#
+# PRE-REGISTERED read: the elastic arm rides through >= 1 scale-up AND
+# >= 1 scale-down with sessions_lost == 0 on BOTH arms (the drain
+# migrates through the spill tier), the replica trace actually varies,
+# SLO attainment is no worse than the static peak fleet, and the
+# chip-second integral of the elastic arm is strictly below the static
+# fleet's 2 x horizon — elasticity pays for itself without dropping a
+# session.
+cd /root/repo
+
+. runs/lib.sh
+
+OUT=BENCH_r17.json
+
+echo "=== RUNG 1: elasticity gate ==="
+python -m pytest tests/test_autoscale.py tests/test_scenarios.py \
+  tests/test_serve.py tests/test_serve_spill.py -q -p no:cacheprovider
+RC=$?
+echo "=== ELASTIC_PYTEST EXIT: $RC ==="
+python -m r2d2_tpu.analysis.cli --jaxpr --concurrency
+RCA=$?
+echo "=== ANALYSIS EXIT: $RCA ==="
+if [ $RC -ne 0 ] || [ $RCA -ne 0 ]; then
+  echo "=== ABORT: elasticity gate failed; the economics would be noise ==="
+  exit 1
+fi
+
+echo "=== RUNG 2: parity anchor (autoscale off, default path) ==="
+python bench.py --mode serve --serve-seconds 10 --arrival-rate 60 \
+  | tee runs/bench_serve_r17_anchor.jsonl
+echo "=== SERVE_ANCHOR EXIT: $? ==="
+
+echo "=== RUNG 3: elastic vs peak-sized static fleet ==="
+python bench.py --mode autoscale --autoscale-out "$OUT"
+RC=$?
+echo "=== AUTOSCALE EXIT: $RC ==="
+if [ $RC -ne 0 ]; then
+  echo "=== ABORT: autoscale bench failed ==="
+  exit 1
+fi
+
+python - "$OUT" <<'PY'
+import json, sys
+r = json.load(open(sys.argv[1]))
+auto, static = r["arms"]["autoscale"], r["arms"]["static"]
+assert r["scale_ups"] >= 1 and r["scale_downs"] >= 1, \
+    (r["scale_ups"], r["scale_downs"])
+ns = {p["replicas"] for p in r["replica_trace"]}
+assert len(ns) > 1, f"replica trace never varied: {r['replica_trace']}"
+assert auto["sessions_lost"] == 0 and static["sessions_lost"] == 0, \
+    (auto["sessions_lost"], static["sessions_lost"])
+assert auto["slo_attainment"] >= static["slo_attainment"], \
+    (auto["slo_attainment"], static["slo_attainment"])
+cs = r["chip_seconds"]
+assert cs["autoscale"] < cs["static"], cs
+print(f"elasticity: {r['scale_ups']} up / {r['scale_downs']} down, "
+      f"lost 0/0, attainment {auto['slo_attainment']:.3f} >= "
+      f"{static['slo_attainment']:.3f}, chip-seconds "
+      f"{cs['autoscale']} < {cs['static']} "
+      f"({100 * r['value']:.0f}% saved)")
+PY
+RC=$?
+echo "=== ELASTICITY_ASSERT EXIT: $RC ==="
+[ $RC -ne 0 ] && exit 1
+
+echo R17_AUTOSCALE_ALL_DONE

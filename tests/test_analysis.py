@@ -1266,9 +1266,14 @@ def test_thread_root_inventory_repo_wide():
     # as an anonymous root.
     assert "serve-loop" in spawn_names, sorted(spawn_names)
     assert "serve-complete" in spawn_names, sorted(spawn_names)
+    # the PR 17 elastic autoscaler is its own supervised root — scale
+    # events block for whole seconds (warmup, migration) and must never
+    # share a worker with the sub-second degrade/watch ticks
+    assert "autoscaler" in spawn_names, sorted(spawn_names)
     paths = {os.path.relpath(r.path, PKG_DIR) for r in roots if r.path}
     for mod in ("serve/server.py", "serve/multi.py", "serve/client.py",
-                "serve/scenarios.py", "liveloop/loop.py",
+                "serve/scenarios.py", "serve/autoscale.py",
+                "liveloop/loop.py",
                 "utils/supervision.py", "replay/tiered_store.py", "train.py"):
         assert mod in paths, f"no thread root found in {mod}"
 

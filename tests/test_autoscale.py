@@ -1,0 +1,519 @@
+"""Elastic autoscaler tests (serve/autoscale.py + Trainer.reshard_live).
+
+Pins the PR's acceptance criteria: scale decisions are a deterministic
+function of a seeded scenario trace, the hysteresis dead band parks the
+fleet size on an oscillating signal instead of flapping it, a live fleet
+survives one scale-up AND one scale-down with `sessions_lost == 0` and
+BITWISE carry continuity for every session, quality-degrading rung steps
+are gated behind an in-flight scale-up (the scale-vs-degrade interlock),
+and the learner's in-process `reshard_live` resumes bit-exactly without a
+process exit."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import tiny_test
+from r2d2_tpu.serve import (
+    AutoscaleConfig,
+    Autoscaler,
+    DegradeConfig,
+    DegradeController,
+    LocalClient,
+    MultiDeviceServer,
+    ScenarioSpec,
+    ServeConfig,
+    SignalWindow,
+)
+from tests.test_scenarios import _StubServer
+from tests.test_serve import SessionReference
+
+
+# ------------------------------------------------------------- signal window
+
+
+def test_signal_window_abstains_cold_then_judges():
+    w = SignalWindow(window=16, slo_ms=50.0, min_samples=4)
+    cold = w.signals()
+    assert (cold["p99_ms"], cold["attainment"], cold["samples"]) \
+        == (0.0, 1.0, 0.0)
+    assert cold["age_s"] == float("inf")
+    for lat in (0.01, 0.02, 0.01, 0.2):
+        w.observe(lat)
+    sig = w.signals()
+    assert sig["samples"] == 4.0 and sig["age_s"] < 60.0
+    assert sig["p99_ms"] > 50.0 and sig["attainment"] == 0.75
+    w.reset()
+    fresh = w.signals()
+    assert fresh["samples"] == 0.0 and fresh["age_s"] == float("inf")
+
+
+def test_stale_window_abstains_for_the_autoscaler():
+    """An idle fleet stops producing latencies; the last crest's bad p99
+    must not hold the drain decision hostage — past stale_after_s the
+    latency signals abstain and the queue signal alone judges."""
+    stub, auto = _autoscaler(dwell_down=2, stale_after_s=0.0)
+    stub.n = 2
+    for _ in range(8):  # a crest's worth of SLO-missing samples
+        auto.window.observe(10.0)
+    stub.depth = 0  # queue empty; samples stale (stale_after_s=0)
+    evs = [auto.evaluate_once() for _ in range(3)]
+    assert "down" in evs and stub.n == 1
+
+
+# ------------------------------------------------------------ decision logic
+
+
+class _ElasticStub(_StubServer):
+    """Fleet double: the degrade surface plus the autoscaler's verbs and
+    per-replica idle triplet. Replica 0 looks idle (old last-request age),
+    later replicas look busy — the drain choice is observable."""
+
+    def __init__(self, n: int = 1, queue_bound: int = 100):
+        super().__init__(queue_bound=queue_bound)
+        self.n = n
+        self.replicas: list = []
+        self.events: list = []
+
+    def active_replicas(self) -> int:
+        return self.n
+
+    def add_replica(self) -> int:
+        self.n += 1
+        self.events.append("up")
+        return self.n - 1
+
+    def kill_replica(self, idx: int) -> dict:
+        self.n -= 1
+        self.events.append(("down", idx))
+        return {"migrated": 0, "lost": 0, "restarted": 0}
+
+    def stats(self) -> dict:
+        return {
+            "replica_active": [True] * self.n,
+            "replica_inflight": [0] * self.n,
+            "replica_last_request_age_s": [9.0] + [0.01] * (self.n - 1),
+            "router_counts": [1] * self.n,
+        }
+
+
+def _autoscaler(stub=None, **kw) -> tuple:
+    stub = stub if stub is not None else _ElasticStub()
+    defaults = dict(min_replicas=1, max_replicas=2, dwell_up=2,
+                    dwell_down=3, cooldown_s=0.0, idle_age_s=1.0,
+                    min_samples=4)
+    defaults.update(kw)
+    return stub, Autoscaler(stub, AutoscaleConfig(**defaults))
+
+
+def _diurnal_events(spec: ScenarioSpec, ticks: int = 64,
+                    capacity_rate: float = None) -> list:
+    """Drive one autoscaler through the seeded diurnal rate profile: each
+    tick's queue depth is the offered-vs-capacity overhang at that point
+    of the (pure, seeded) spec. Returns the scale-event sequence."""
+    cap = capacity_rate if capacity_rate is not None else 1.5 * spec.base_rate
+    stub, auto = _autoscaler()
+    events = []
+    for k in range(ticks):
+        rate = spec.rate_at(spec.duration_s * k / ticks)
+        over = max(rate - cap, 0.0) / cap
+        stub.depth = min(stub.queue_bound, int(stub.queue_bound * over))
+        ev = auto.evaluate_once()
+        if ev is not None:
+            events.append((k, ev, stub.n))
+    assert auto.evaluations == ticks
+    return events
+
+
+def test_scale_events_deterministic_from_seeded_trace():
+    """The controller is a pure function of its seeded scenario input: the
+    diurnal crest buys exactly one scale-up, the falling edge drains it,
+    and a second identical drive reproduces the event sequence tick-for-
+    tick."""
+    spec = ScenarioSpec(name="d", duration_s=8.0, base_rate=100.0,
+                        rate_profile="diurnal", peak_mult=3.0, seed=11)
+    events = _diurnal_events(spec)
+    assert [e[1] for e in events] == ["up", "down"]
+    up_tick, down_tick = events[0][0], events[1][0]
+    assert up_tick < 32 <= down_tick  # up on the rise, down past the crest
+    assert events[0][2] == 2 and events[1][2] == 1
+    assert _diurnal_events(spec) == events  # bit-identical replay
+
+
+def test_no_flap_on_oscillating_signal():
+    """A signal bouncing between pressured and healthy every tick never
+    accumulates either dwell: the fleet size parks."""
+    stub, auto = _autoscaler(dwell_up=2, dwell_down=2)
+    for k in range(40):
+        stub.depth = 90 if k % 2 == 0 else 0
+        assert auto.evaluate_once() is None
+    assert stub.n == 1 and auto.scale_ups == 0 and auto.scale_downs == 0
+
+
+def test_dead_band_holds_both_dwells():
+    """Between the bands (healthy queue but not-yet-clean latency, or the
+    mid-queue region) neither dwell advances — the ladder's dead-band
+    semantics, reused."""
+    stub, auto = _autoscaler(dwell_up=2, dwell_down=2)
+    stub.depth = 10  # between queue_low (5) and queue_high (25)
+    for _ in range(20):
+        assert auto.evaluate_once() is None
+    assert stub.n == 1
+
+
+def test_scale_bounds_and_cooldown():
+    """max_replicas caps growth, min_replicas floors the drain, and the
+    post-event cooldown holds the next decision."""
+    stub, auto = _autoscaler(max_replicas=2, cooldown_s=60.0)
+    stub.depth = 90
+    evs = [auto.evaluate_once() for _ in range(8)]
+    # one scale-up, then the cooldown holds even under sustained pressure
+    assert evs.count("up") == 1 and stub.n == 2
+    stub2, auto2 = _autoscaler(min_replicas=1, dwell_down=2)
+    stub2.depth = 0
+    for _ in range(10):
+        auto2.evaluate_once()
+    assert stub2.n == 1 and auto2.scale_downs == 0  # floored at min
+
+
+def test_drain_holds_until_a_replica_goes_idle():
+    """drain_requires_idle (default): a healthy fleet whose replicas are
+    all still talking parks at its current size — health signals
+    describe the fleet at its CURRENT size, so a comfortable fleet must
+    not drain into a crest. The drain fires only once some replica has
+    demonstrably nothing to say."""
+    class _BusyStub(_ElasticStub):
+        def __init__(self):
+            super().__init__(n=2)
+            self.ages = [0.01, 0.01]
+
+        def stats(self):
+            st = super().stats()
+            st["replica_last_request_age_s"] = list(self.ages)
+            return st
+
+    stub = _BusyStub()
+    auto = Autoscaler(stub, AutoscaleConfig(
+        min_replicas=1, max_replicas=2, dwell_down=2, cooldown_s=0.0,
+        idle_age_s=1.0, min_samples=4,
+    ))
+    stub.depth = 0
+    for _ in range(6):
+        assert auto.evaluate_once() is None  # armed, holding
+    assert stub.n == 2 and auto.drain_holds >= 4
+    stub.ages[0] = 9.0  # replica 0 went quiet
+    assert auto.evaluate_once() == "down" and stub.n == 1
+
+
+def test_pressure_margin_buys_capacity_inside_the_slo():
+    """The predictive trigger: p99 past margin*slo — but still INSIDE the
+    SLO — is pressure, because a scale-up takes seconds to land and must
+    be bought before misses start. At margin 1.0 the same latencies are
+    healthy."""
+    stub, auto = _autoscaler(pressure_margin=0.5, dwell_up=2)
+    for _ in range(8):
+        auto.window.observe(0.030)  # 30 ms: over 0.5*50, under the SLO
+    evs = [auto.evaluate_once() for _ in range(3)]
+    assert "up" in evs and stub.n == 2
+    stub2, auto2 = _autoscaler(pressure_margin=1.0, dwell_up=2)
+    for _ in range(8):
+        auto2.window.observe(0.030)
+    assert [auto2.evaluate_once() for _ in range(6)] == [None] * 6
+    assert stub2.n == 1
+
+
+def test_drain_picks_the_idle_replica():
+    stub, auto = _autoscaler(dwell_down=2)
+    stub.n = 2
+    stub.depth = 0
+    evs = [auto.evaluate_once() for _ in range(3)]
+    assert ("down", 0) in stub.events  # replica 0 is the idle one
+    assert "down" in evs
+
+
+# ---------------------------------------------------------------- interlock
+
+
+def test_interlock_gates_rung_up_until_scale_inflight():
+    """The scale-vs-degrade interlock: under sustained pressure below
+    max_replicas the ladder's rung-up is HELD (capacity answers, not
+    quality) — and the held dwell fires the first tick the gate opens
+    (here: the scale-up pins the fleet at max, so capacity can no longer
+    answer; the cooldown itself does NOT hold the gate open — once a
+    replica lands below max, the new capacity drains the backlog and the
+    ladder stays parked)."""
+    stub = _ElasticStub()
+    stub.degrade = DegradeController(
+        stub, DegradeConfig(dwell_up=2, dwell_down=3, min_samples=4,
+                            eval_interval_s=0.01)
+    )
+    auto = Autoscaler(stub, AutoscaleConfig(
+        min_replicas=1, max_replicas=2, dwell_up=3, cooldown_s=60.0,
+        min_samples=4,
+    ))
+    assert auto.window is stub.degrade.window  # ONE shared window
+    ctl = stub.degrade
+    stub.depth = 90
+    # ladder dwell (2) is satisfied first, but the gate is closed: held
+    assert ctl.evaluate_once() is None
+    assert ctl.evaluate_once() is None
+    assert ctl.rung == 0 and ctl.gated_holds >= 1
+    # autoscaler reaches ITS dwell (3) and scales up; gate now open
+    for _ in range(3):
+        auto.evaluate_once()
+    assert stub.n == 2
+    assert ctl.evaluate_once() == "admit"  # held dwell fires immediately
+    # recovery is never gated
+    stub.depth = 0
+    for _ in range(3):
+        ctl.window.observe(0.001)
+        ctl.evaluate_once()
+    assert ctl.rung == 0
+
+
+def test_interlock_closes_once_the_replica_lands_below_max():
+    """After a scale-up completes BELOW max_replicas the gate closes even
+    inside the cooldown: the new capacity is draining the backlog, and an
+    open gate there would let the ladder ratchet into the quality arms
+    against a receding queue — a shed equilibrium."""
+    stub = _ElasticStub()
+    stub.degrade = DegradeController(
+        stub, DegradeConfig(dwell_up=2, dwell_down=3, min_samples=4,
+                            eval_interval_s=0.01)
+    )
+    auto = Autoscaler(stub, AutoscaleConfig(
+        min_replicas=1, max_replicas=3, dwell_up=1, cooldown_s=60.0,
+        min_samples=4,
+    ))
+    ctl = stub.degrade
+    stub.depth = 90
+    assert auto.evaluate_once() == "up" and stub.n == 2  # cooldown armed
+    held = ctl.gated_holds
+    # still pressured, still below max, deep inside the cooldown: the
+    # ladder's dwell keeps being HELD, no rung fires
+    assert [ctl.evaluate_once() for _ in range(4)] == [None] * 4
+    assert ctl.rung == 0 and ctl.gated_holds > held
+
+
+def test_interlock_opens_at_max_replicas():
+    """A fleet pinned at max_replicas cannot answer with capacity: the
+    ladder must be free to degrade exactly as before the autoscaler
+    existed."""
+    stub = _ElasticStub(n=2)
+    stub.degrade = DegradeController(
+        stub, DegradeConfig(dwell_up=2, dwell_down=3, min_samples=4,
+                            eval_interval_s=0.01)
+    )
+    Autoscaler(stub, AutoscaleConfig(min_replicas=1, max_replicas=2,
+                                     min_samples=4))
+    ctl = stub.degrade
+    stub.depth = 90
+    steps = [ctl.evaluate_once() for _ in range(4)]
+    assert steps == [None, "admit", None, "bf16"]
+    assert ctl.gated_holds == 0
+
+
+# ------------------------------------------------------- live fleet, bitwise
+
+
+def test_fleet_scale_up_and_down_bit_exact():
+    """The acceptance criterion: a live fleet grows by one replica and
+    later drains one, mid-traffic — `sessions_lost == 0` through BOTH
+    events and every session's response stream continues BITWISE, as if
+    the fleet size never changed. The autoscaler thread is running (its
+    dwells parked out of reach) so the supervised lifecycle is exercised;
+    the events themselves fire through its verbs deterministically."""
+    cfg = tiny_test().replace(
+        serve_devices=1, serve_spill=64, serve_autoscale=True,
+        autoscale_min_replicas=1, autoscale_max_replicas=2,
+        autoscale_dwell_up=10**6, autoscale_dwell_down=10**6,
+        # the mid-traffic drain is the point here (bitwise migration
+        # under load); the idle-hold policy has its own unit test
+        autoscale_drain_requires_idle=False,
+    )
+    srv = MultiDeviceServer(
+        cfg, ServeConfig(buckets=(2, 4), max_wait_ms=1.0, cache_capacity=8)
+    )
+    assert srv.autoscale is not None
+    srv.warmup()
+    srv.start()
+    client = LocalClient(srv)
+    rng = np.random.default_rng(17)
+    refs: dict = {}
+
+    def step_all(sids, first: bool = False) -> None:
+        for sid in sids:
+            if first:
+                refs[sid] = SessionReference(srv.net, cfg.hidden_dim)
+            obs = rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8)
+            reward = float(rng.normal())
+            res = client.act(sid, obs, reward=reward, reset=first)
+            q_ref, a_ref = refs[sid].step(srv._params_host, obs, reward,
+                                          first, bucket=res.bucket)
+            np.testing.assert_array_equal(q_ref, np.asarray(res.q))
+            assert a_ref == res.action
+
+    gen_a = [f"ela-{s}" for s in range(8)]
+    gen_b = [f"elb-{s}" for s in range(6)]
+    try:
+        step_all(gen_a, first=True)
+        step_all(gen_a)
+        # SCALE UP: spawn/warm/publish/activate — then keep serving. The
+        # pre-scale sessions keep their replica-0 affinity and continue
+        # bitwise across the fleet-size change.
+        slot = srv.add_replica()
+        assert slot == 1 and srv.active_replicas() == 2
+        step_all(gen_a)
+        # a second generation of sessions lands on the new (least-loaded)
+        # replica, so the upcoming drain has real state to migrate
+        step_all(gen_b, first=True)
+        step_all(gen_a + gen_b)
+        counts = srv.router.counts()
+        assert counts[1] == len(gen_b)
+        # SCALE DOWN through the autoscaler's own drain choice: the
+        # less-loaded replica 1 is the victim, and every one of its
+        # sessions migrates through the spill tier
+        victim = srv.autoscale._pick_drain_victim()
+        assert victim == 1
+        outcome = srv.kill_replica(victim)
+        assert outcome["lost"] == 0
+        assert outcome["migrated"] == len(gen_b)
+        assert srv.active_replicas() == 1
+        # post-drain: the migrated carries promote from the survivor's
+        # slab and BOTH generations continue their streams bit-for-bit
+        step_all(gen_a + gen_b)
+        step_all(gen_a + gen_b)
+        srv.check()  # autoscaler supervisor folded into the fleet check
+    finally:
+        srv.stop()
+    st = srv.stats()
+    assert st["sessions_lost"] == 0
+    assert st["sessions_migrated"] == len(gen_b)
+    assert st["replicas_added"] == 1 and st["replicas_killed"] == 1
+    assert st["autoscale_evaluations"] >= 0  # autoscale stats ride along
+    assert len(st["replica_active"]) == 2
+    assert st["replica_active"] == [True, False]
+    assert len(st["replica_inflight"]) == 2
+    assert len(st["replica_last_request_age_s"]) == 2
+
+
+def test_added_replica_follows_fleet_publish():
+    """A replica born after a reload serves the SAME params version as the
+    fleet — and joins subsequent reloads (the adopt-under-one-version
+    discipline in add_replica)."""
+    cfg = tiny_test().replace(serve_devices=1, serve_spill=16)
+    srv = MultiDeviceServer(
+        cfg, ServeConfig(buckets=(2,), max_wait_ms=1.0, cache_capacity=8)
+    )
+    srv.warmup()
+    srv.start()
+    try:
+        srv.add_replica()
+        r0, r1 = srv.replicas
+        assert r0._published[2] == r1._published[2]  # same version
+        # a fleet-wide arm switch reaches the adopted replica too
+        srv.set_arm("bf16")
+        assert r0._published[3] == r1._published[3] == "bf16"
+        assert r0._published[2] == r1._published[2]
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------- router elasticity
+
+
+def test_router_bound_tracks_active_set():
+    """The affinity-LRU bound is per-replica capacity x ACTIVE replicas:
+    deactivation shrinks it (and trims), activation restores it."""
+    from r2d2_tpu.serve.multi import SessionRouter
+
+    r = SessionRouter(2, max_tracked=8)  # 4 per replica
+    for i in range(8):
+        r.route(f"s{i}")
+    assert len(r._map) == 8
+    r.deactivate(1)
+    assert r.max_tracked == 4 and len(r._map) == 4
+    assert r.dropped == 4
+    r.activate(1)
+    assert r.max_tracked == 8
+    slot = r.add_slot()
+    assert slot == 2 and r.active() == [True, True, False]
+    r.activate(slot)
+    assert r.max_tracked == 12
+    assert r.active() == [True, True, True]
+
+
+# --------------------------------------------------------- learner reshard
+
+
+@pytest.mark.slow
+def test_reshard_live_is_bit_exact(tmp_path):
+    """The learner half of elasticity: snapshot -> reshard -> resume IN
+    PROCESS, then keep training — bit-identical to a run that never
+    resharded."""
+    from r2d2_tpu.train import Trainer
+
+    def build(sub):
+        return tiny_test().replace(
+            env_name="catch", checkpoint_dir=str(tmp_path / sub),
+            snapshot_replay=True, training_steps=4, save_interval=2,
+            learning_starts=48,
+        )
+
+    a = Trainer(build("a"))
+    a.run_inline(env_steps_per_update=4)
+    info = a.reshard_live(dp_size=1)
+    assert info["replay_size"] == info["replay_size_before"]
+    assert info["env_steps"] == info["env_steps_before"]
+    a.cfg = a.cfg.replace(training_steps=6)
+    a.run_inline(env_steps_per_update=4)
+
+    b = Trainer(build("b").replace(training_steps=6))
+    b.run_inline(env_steps_per_update=4)
+
+    assert int(a.state.step) == int(b.state.step) == 6
+    import jax
+
+    for pa, pb in zip(jax.tree.leaves(a.state.params),
+                      jax.tree.leaves(b.state.params)):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+    assert len(a.replay) == len(b.replay)
+    assert a.replay.env_steps == b.replay.env_steps
+    np.testing.assert_allclose(a.replay.tree.tree, b.replay.tree.tree,
+                               rtol=1e-12)
+
+
+def test_reshard_live_rejects_bad_inputs(tmp_path):
+    from r2d2_tpu.train import Trainer
+
+    cfg = tiny_test().replace(
+        env_name="catch", checkpoint_dir=str(tmp_path / "c"),
+        snapshot_replay=True, training_steps=1, learning_starts=48,
+    )
+    t = Trainer(cfg)
+    with pytest.raises(ValueError, match="reshard_live accepts"):
+        t.reshard_live(hidden_dim=128)
+    with pytest.raises(NotImplementedError, match="single-process"):
+        t.reshard_live(replay_plane="multihost")
+
+
+# ----------------------------------------------------------- config gating
+
+
+def test_autoscale_defaults_off_and_validates():
+    cfg = tiny_test()
+    assert cfg.serve_autoscale is False
+    srv_cfg = cfg.replace(serve_devices=1)
+    # default-off: no autoscaler object is even constructed
+    srv = MultiDeviceServer(
+        srv_cfg, ServeConfig(buckets=(2,), max_wait_ms=1.0,
+                             cache_capacity=4)
+    )
+    assert srv.autoscale is None
+    with pytest.raises(ValueError, match="autoscale"):
+        cfg.replace(serve_autoscale=True, serve_devices=4,
+                    autoscale_max_replicas=2).validate()
+    with pytest.raises(ValueError, match="autoscale"):
+        cfg.replace(autoscale_min_replicas=3,
+                    autoscale_max_replicas=2).validate()
