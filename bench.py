@@ -1687,6 +1687,332 @@ def liveloop_main(
     print(json.dumps(row))
 
 
+def podloop_main(
+    hosts: int = 2,
+    sessions: int = 8,
+    seconds: float = 90.0,
+    arrival_rate: float = 60.0,
+    seed: int = 0,
+    out_path: str = "",
+):
+    """Pod-loop bench: the live loop across REAL process boundaries
+    (transport/podloop.py) — N serve-host processes feed one learner
+    process over the block-stream transport; checkpoints broadcast back
+    over the same sockets. This driver process only spawns the pod,
+    generates closed-loop catch traffic against each host's TCP frontend
+    (PolicyClient), and reads the children's stats jsonl.
+
+    Mid-run SIGKILL drill: at ~40% of the window host h0 is SIGKILLed and
+    relaunched with the SAME spool dir, host id, and serve port. The row
+    certifies: the learner never stops training through the outage
+    (learner_step strictly advances), the restarted host resumes its
+    sequence from the on-disk spool (the learner's per-host high-water
+    mark advances past its kill-time value), `duplicate_blocks == 0`
+    end-to-end (the HELLO_ACK resume protocol de-duplicated the replayed
+    tail), and `sessions_lost == 0` on every host. **Ingest lag** —
+    serve-host spool time to trainable-in-replay time — is the headline
+    first-class column."""
+    import signal as _signal
+    import subprocess
+    import tempfile
+
+    from r2d2_tpu.envs.catch import CatchHostEnv
+    from r2d2_tpu.serve import PolicyClient
+    from r2d2_tpu.transport.podloop import podloop_config
+
+    cfg = podloop_config(seed, checkpoint_dir="")  # driver-side env shapes
+    root = tempfile.mkdtemp(prefix="podloop_bench_")
+    spool_root = os.path.join(root, "spool")
+    ckpt_dir = os.path.join(root, "ckpt")
+    os.makedirs(spool_root, exist_ok=True)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def _spawn(argv, logname):
+        log = open(os.path.join(root, logname), "w")
+        return subprocess.Popen(
+            [sys.executable, "-m", "r2d2_tpu.transport.podloop"] + argv,
+            stdout=subprocess.PIPE, stderr=log, env=env, text=True,
+        ), log
+
+    def _wait_ready(proc, timeout=180.0):
+        import select as _select
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise SystemExit(
+                    f"[podloop] FAIL: child exited rc={proc.returncode} "
+                    "before ready"
+                )
+            r, _, _ = _select.select([proc.stdout], [], [], 0.5)
+            if r:
+                line = proc.stdout.readline()
+                if not line:
+                    continue
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if msg.get("podloop_ready"):
+                    return msg
+        raise SystemExit("[podloop] FAIL: child not ready in time")
+
+    def _last_stats(path, role=None):
+        best = None
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        row = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn final line from a SIGKILL
+                    if role is None or row.get("role") == role:
+                        best = row
+        except OSError:
+            pass
+        return best or {}
+
+    learner_stats_path = os.path.join(root, "learner.jsonl")
+    learner, learner_log = _spawn(
+        ["--role", "learner", "--ckpt-dir", ckpt_dir,
+         "--stats", learner_stats_path, "--seed", str(seed)],
+        "learner.log",
+    )
+    ingest_port = _wait_ready(learner)["ingest_port"]
+    print(f"[podloop] learner up, ingest port {ingest_port}",
+          file=sys.stderr)
+
+    host_stats_path = [os.path.join(root, f"h{i}.jsonl") for i in range(hosts)]
+
+    def _spawn_host(i, port=0):
+        proc, log = _spawn(
+            ["--role", "serve", "--host-id", f"h{i}",
+             "--learner-port", str(ingest_port), "--port", str(port),
+             "--spool-dir", spool_root, "--stats", host_stats_path[i],
+             "--seed", str(seed + i)],
+            f"h{i}.log" if port == 0 else f"h{i}_restarted.log",
+        )
+        return proc, log, _wait_ready(proc)["serve_port"]
+
+    host_procs, host_logs, host_ports = [], [], []
+    for i in range(hosts):
+        proc, log, port = _spawn_host(i)
+        host_procs.append(proc)
+        host_logs.append(log)
+        host_ports.append(port)
+        print(f"[podloop] serve host h{i} up on port {port}",
+              file=sys.stderr)
+
+    stop = threading.Event()
+    rec_lock = threading.Lock()
+    latencies: list = []
+    episodes: list = []  # (t_end_rel_s, session_idx, return, length)
+    errors = [0]
+    t0 = time.perf_counter()
+    per_session_rate = max(arrival_rate / max(sessions, 1), 1e-6)
+
+    def session_body(idx: int) -> None:
+        # closed-loop catch against ONE host's TCP frontend; errors
+        # (including the whole SIGKILL outage window) reset the episode
+        # and keep offering — the client's own retries ride the restart
+        rng = np.random.default_rng(seed * 1009 + idx)
+        host_idx = idx % hosts
+        env_ = CatchHostEnv(
+            height=cfg.obs_shape[0], width=cfg.obs_shape[1],
+            seed=seed * 1009 + idx,
+        )
+        client = PolicyClient("127.0.0.1", host_ports[host_idx],
+                              timeout=5.0, retries=2, seed=idx)
+        sid = f"pod-{idx}"
+        obs, reward, reset = env_.reset(), 0.0, True
+        ep_ret, ep_len = 0.0, 0
+        while not stop.is_set():
+            t_req = time.perf_counter()
+            try:
+                res = client.act(sid, obs, reward=reward, reset=reset)
+            except Exception:
+                with rec_lock:
+                    errors[0] += 1
+                obs, reward, reset = env_.reset(), 0.0, True
+                ep_ret, ep_len = 0.0, 0
+                stop.wait(min(rng.exponential(1.0 / per_session_rate), 0.5))
+                continue
+            with rec_lock:
+                latencies.append(time.perf_counter() - t_req)
+            reset = False
+            obs, reward, done, _ = env_.step(res["action"])
+            ep_ret += reward
+            ep_len += 1
+            if done:
+                with rec_lock:
+                    episodes.append(
+                        (time.perf_counter() - t0, idx, ep_ret, ep_len)
+                    )
+                obs, reset = env_.reset(), True
+                ep_ret, ep_len = 0.0, 0
+            stop.wait(rng.exponential(1.0 / per_session_rate))
+
+    threads = [
+        threading.Thread(target=session_body, args=(i,),
+                         name=f"pod-session-{i}", daemon=True)
+        for i in range(sessions)
+    ]
+    for t in threads:
+        t.start()
+
+    # ---- SIGKILL drill on h0 at ~40% of the window
+    kill_at = seconds * 0.4
+    deadline = time.monotonic() + seconds
+    time.sleep(max(kill_at - (time.perf_counter() - t0), 0.0))
+    pre_kill = _last_stats(learner_stats_path)
+    seq_at_kill = int(pre_kill.get("ingest_host_seq", {}).get("h0", 0))
+    step_at_kill = int(pre_kill.get("learner_step", 0))
+    host_procs[0].send_signal(_signal.SIGKILL)
+    host_procs[0].wait(timeout=10.0)
+    t_kill = round(time.perf_counter() - t0, 2)
+    print(f"[podloop] SIGKILL h0 at {t_kill}s "
+          f"(h0 seq {seq_at_kill}, learner step {step_at_kill})",
+          file=sys.stderr)
+    # relaunch with the SAME identity: host id, spool dir, serve port
+    proc, log, port = _spawn_host(0, port=host_ports[0])
+    host_procs[0], restart_log = proc, log
+    assert port == host_ports[0]
+    t_back = round(time.perf_counter() - t0, 2)
+    print(f"[podloop] h0 back on port {port} at {t_back}s", file=sys.stderr)
+
+    while time.monotonic() < deadline:
+        if learner.poll() is not None:
+            raise SystemExit(
+                f"[podloop] FAIL: learner died rc={learner.returncode}"
+            )
+        time.sleep(0.5)
+
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    wall = time.perf_counter() - t0
+    learner_alive = learner.poll() is None
+
+    # graceful drain: hosts first (their final flush pushes the spool
+    # tail), then the learner
+    for proc in host_procs:
+        if proc.poll() is None:
+            proc.send_signal(_signal.SIGTERM)
+    for proc in host_procs:
+        try:
+            proc.wait(timeout=30.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    learner.send_signal(_signal.SIGTERM)
+    try:
+        learner.wait(timeout=30.0)
+    except subprocess.TimeoutExpired:
+        learner.kill()
+    for log in host_logs + [learner_log, restart_log]:
+        log.close()
+
+    lstats = _last_stats(learner_stats_path)
+    hstats = [_last_stats(p) for p in host_stats_path]
+    h0_final_seq = int(lstats.get("ingest_host_seq", {}).get("h0", 0))
+    duplicate_blocks = int(lstats.get("ingest_duplicate_blocks", 0))
+    sessions_lost = sum(int(h.get("sessions_lost", 0)) for h in hstats)
+    reconnects_h0 = int(hstats[0].get("transport_reconnects", 0))
+
+    half2 = [r for (t, _, r, _) in episodes if t >= seconds / 2]
+    lat_ms = np.sort(np.asarray(latencies, np.float64)) * 1e3
+    row = {
+        "metric": "podloop_ingest_lag_p95_ms",
+        # headline: serve-host spool time -> trainable-in-replay time,
+        # measured by the learner per block, across the process boundary
+        "value": lstats.get("ingest_lag_p95_ms"),
+        "unit": "ms",
+        "vs_baseline": None,
+        "ingest_lag_p50_ms": lstats.get("ingest_lag_p50_ms"),
+        "ingest_lag_max_ms": lstats.get("ingest_lag_max_ms"),
+        "hosts": hosts,
+        "sessions": sessions,
+        "duration_s": round(wall, 2),
+        "arrival_rate_target": arrival_rate,
+        "agg_requests_per_s": round(len(latencies) / wall, 2),
+        "request_errors": errors[0],
+        "episodes_total": len(episodes),
+        "return_per_session_2nd_half": (
+            round(float(np.mean(half2)), 4) if half2 else None
+        ),
+        "p50_latency_ms": (
+            round(float(np.percentile(lat_ms, 50)), 3) if len(lat_ms) else None
+        ),
+        "p95_latency_ms": (
+            round(float(np.percentile(lat_ms, 95)), 3) if len(lat_ms) else None
+        ),
+        "learner_step_final": int(lstats.get("learner_step", 0)),
+        "params_version_final": int(lstats.get("params_version", 0)),
+        "ingest_blocks": int(lstats.get("ingest_blocks", 0)),
+        "ckpts_broadcast": int(lstats.get("ingest_ckpts_broadcast", 0)),
+        "host_reloads": [int(h.get("reloads", 0)) for h in hstats],
+        "sigkill_drill": {
+            "killed_host": "h0",
+            "t_kill_s": t_kill,
+            "t_back_s": t_back,
+            "h0_seq_at_kill": seq_at_kill,
+            "h0_seq_final": h0_final_seq,
+            "learner_step_at_kill": step_at_kill,
+            "learner_uninterrupted": bool(learner_alive),
+            "h0_reconnects_after_restart": reconnects_h0,
+            "duplicate_blocks": duplicate_blocks,
+            "sessions_lost": sessions_lost,
+        },
+        "seed": seed,
+    }
+    print(
+        f"[podloop] {len(episodes)} episodes / {len(latencies)} requests "
+        f"in {wall:.1f}s; learner step {row['learner_step_final']} "
+        f"version {row['params_version_final']} "
+        f"lag p95 {row['value']}ms; drill: h0 seq {seq_at_kill}->"
+        f"{h0_final_seq} dupes={duplicate_blocks} lost={sessions_lost}",
+        file=sys.stderr,
+    )
+    if not learner_alive:
+        raise SystemExit(
+            "[podloop] FAIL: learner did not run uninterrupted through "
+            "the SIGKILL drill"
+        )
+    if row["learner_step_final"] <= step_at_kill:
+        raise SystemExit(
+            "[podloop] FAIL: learner made no progress after the kill "
+            f"({step_at_kill} -> {row['learner_step_final']})"
+        )
+    if h0_final_seq <= seq_at_kill:
+        raise SystemExit(
+            "[podloop] FAIL: restarted host h0 never resumed its stream "
+            f"(seq {seq_at_kill} -> {h0_final_seq})"
+        )
+    if duplicate_blocks:
+        raise SystemExit(
+            f"[podloop] FAIL: duplicate_blocks={duplicate_blocks} != 0 — "
+            "the HELLO_ACK resume protocol leaked a replayed block"
+        )
+    if sessions_lost:
+        raise SystemExit(
+            f"[podloop] FAIL: sessions_lost={sessions_lost} != 0"
+        )
+    if row["params_version_final"] < 1 or row["ckpts_broadcast"] < 1:
+        raise SystemExit(
+            "[podloop] FAIL: no checkpoint ever broadcast back to the "
+            "hosts — the pod loop did not close"
+        )
+    if sum(row["host_reloads"]) < 1:
+        raise SystemExit(
+            "[podloop] FAIL: no serve host ever installed a broadcast "
+            "checkpoint (host_reloads all zero)"
+        )
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(row, f, indent=1)
+        print(f"[podloop] report -> {out_path}", file=sys.stderr)
+    print(json.dumps(row))
+
+
 def serve_main(
     core: str = "lstm",
     lru_chunk: int = 0,
@@ -2718,7 +3044,7 @@ if __name__ == "__main__":
         "--mode", default="learner",
         choices=["learner", "system", "fused", "long_context", "serve",
                  "recovery", "breakdown", "scenarios", "liveloop",
-                 "multitask", "autoscale"],
+                 "multitask", "autoscale", "podloop"],
         help="learner: fused-update throughput on synthetic replay (the "
              "driver's default metric). system: concurrent on-device "
              "collection + learning via threads. fused: the same full "
@@ -2748,7 +3074,14 @@ if __name__ == "__main__":
              "peak-sized static fleet on the diurnal scenario — SLO "
              "attainment, sessions_lost through one scale-up and one "
              "scale-down, replica-count trace, and chip-seconds, written "
-             "to BENCH_r17.json.",
+             "to BENCH_r17.json. "
+             "podloop: the live loop across real process boundaries "
+             "(transport/) — N serve-host processes stream blocks to one "
+             "learner process over the fault-tolerant block-stream "
+             "transport, checkpoints broadcast back over the same "
+             "sockets, with a mid-run SIGKILL-one-host drill; reports "
+             "aggregate requests/s, return per session, and ingest lag, "
+             "written to BENCH_r18.json.",
     )
     p.add_argument(
         "--mt-updates", type=int, default=600,
@@ -2944,6 +3277,36 @@ if __name__ == "__main__":
              "(e.g. BENCH_r12.json)",
     )
     p.add_argument(
+        "--podloop-hosts", type=int, default=2,
+        help="podloop mode: serve-host process count feeding the learner",
+    )
+    p.add_argument(
+        "--podloop-sessions", type=int, default=8,
+        help="podloop mode: concurrent driver sessions (split across "
+             "hosts round-robin)",
+    )
+    p.add_argument(
+        "--podloop-seconds", type=float, default=90.0,
+        help="podloop mode: wall-clock window (long enough for the "
+             "SIGKILL'd host to relaunch, reconnect, and resume its "
+             "stream before the end)",
+    )
+    p.add_argument(
+        "--podloop-rate", type=float, default=60.0,
+        help="podloop mode: aggregate closed-loop arrival rate in "
+             "requests/s",
+    )
+    p.add_argument(
+        "--podloop-seed", type=int, default=0,
+        help="podloop mode: seed for traffic pacing, envs, and the "
+             "children's exploration/jitter streams",
+    )
+    p.add_argument(
+        "--podloop-out", default="",
+        help="podloop mode: also write the report JSON here "
+             "(e.g. BENCH_r18.json)",
+    )
+    p.add_argument(
         "--backward-arm", default="auto",
         choices=["auto", "default", "fused_dwh", "ckpt"],
         help="breakdown mode: which seq-backward arm the timed programs "
@@ -3008,6 +3371,13 @@ if __name__ == "__main__":
                       arrival_rate=args.liveloop_rate,
                       seed=args.liveloop_seed,
                       out_path=args.liveloop_out)
+    elif args.mode == "podloop":
+        podloop_main(hosts=args.podloop_hosts,
+                     sessions=args.podloop_sessions,
+                     seconds=args.podloop_seconds,
+                     arrival_rate=args.podloop_rate,
+                     seed=args.podloop_seed,
+                     out_path=args.podloop_out)
     elif args.mode == "scenarios":
         scenarios_main(args.core, args.lru_chunk,
                        sessions=args.scenario_sessions,

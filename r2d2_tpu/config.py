@@ -298,6 +298,37 @@ class R2D2Config:
     liveloop_tap_depth: int = 256
     liveloop_queue_depth: int = 64
 
+    # Pod-loop block-stream transport (transport/): the process-boundary
+    # analog of the in-process liveloop bridge. A serve host plugs a
+    # BlockStreamPublisher in as the bridge's replay sink; the learner
+    # runs an IngestService that fans N host streams into its replay
+    # plane. None of these knobs change any behavior unless the transport
+    # endpoints are actually constructed (bench.py --mode podloop, the
+    # podloop CLI, tests) — the single-process golden paths never read
+    # them.
+    #
+    # Publisher spool bound, in blocks: finished Blocks awaiting
+    # acknowledgement (including the whole disconnected window) are kept
+    # in a bounded at-least-once spool; when full the OLDEST unacked
+    # block is shed and counted (fresh experience beats stale, same
+    # policy as the liveloop bridge queue).
+    transport_spool_depth: int = 512
+    # Directory for the publisher's on-disk spool ("" = in-memory only).
+    # With a directory, every spooled block is persisted as
+    # <host>/<seq>.blk before it is eligible to send, and a restarted
+    # publisher (SIGKILL drill) reloads the unacked tail and resumes its
+    # sequence numbering from disk.
+    transport_spool_dir: str = ""
+    # Publisher heartbeat cadence in seconds (idle connections still
+    # prove liveness) and the learner-side dead-peer timeout after which
+    # a silent host connection is reaped. The timeout must exceed the
+    # cadence with real headroom or healthy-but-quiet hosts flap.
+    transport_heartbeat_s: float = 1.0
+    transport_dead_peer_s: float = 10.0
+    # Socket connect/handshake timeout for one attempt (the reconnect
+    # loop wraps attempts in jittered backoff on top of this).
+    transport_connect_timeout_s: float = 5.0
+
     # Fused-sequence training semantics for the LSTM core: the T-step
     # unroll treats each row's burn-in prefix as state-refresh only — a
     # stop-gradient seam at burn_in[b] cuts the backward pass so burn-in
@@ -807,6 +838,24 @@ class R2D2Config:
             raise ValueError(
                 "liveloop_tap_depth and liveloop_queue_depth are bounded "
                 "hand-off queue depths; both must be >= 1"
+            )
+        if self.transport_spool_depth < 1:
+            raise ValueError(
+                "transport_spool_depth bounds the publisher's unacked "
+                "block spool; it must be >= 1"
+            )
+        if self.transport_heartbeat_s <= 0.0 or \
+                self.transport_connect_timeout_s <= 0.0:
+            raise ValueError(
+                "transport_heartbeat_s and transport_connect_timeout_s "
+                "must be > 0"
+            )
+        if self.transport_dead_peer_s <= self.transport_heartbeat_s:
+            raise ValueError(
+                "transport_dead_peer_s is the ingest service's silence "
+                "threshold for reaping a host connection; it must exceed "
+                "transport_heartbeat_s (with headroom) or healthy idle "
+                "hosts flap"
             )
         if self.lstm_backend not in ("auto", "scan", "pallas"):
             raise ValueError(f"unknown lstm_backend {self.lstm_backend!r}")

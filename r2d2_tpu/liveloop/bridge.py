@@ -30,6 +30,13 @@ class IngestBridge:
         self.offered_blocks = 0
         self.dropped_blocks = 0
         self.ingested_blocks = 0
+        # drop visibility at DRAIN granularity: how many offers were shed
+        # since the previous drain_once (the backpressure signal a metrics
+        # row can alarm on — a nonzero value means the ingest thread is
+        # not keeping up RIGHT NOW, where the cumulative counter can't
+        # distinguish an old burst from an ongoing one)
+        self.dropped_last_drain = 0
+        self._dropped_at_drain = 0
 
     def offer(self, block, priorities, episode_reward: Optional[float]) -> None:
         """Enqueue one finished block; sheds the OLDEST queued block when
@@ -52,6 +59,8 @@ class IngestBridge:
             items = list(self._q)
             self._q.clear()
             self._wake.clear()
+            self.dropped_last_drain = self.dropped_blocks - self._dropped_at_drain
+            self._dropped_at_drain = self.dropped_blocks
         if not items:
             return 0
 
@@ -79,6 +88,7 @@ class IngestBridge:
             return {
                 "bridge_offered_blocks": self.offered_blocks,
                 "bridge_dropped_blocks": self.dropped_blocks,
+                "bridge_dropped_last_drain": self.dropped_last_drain,
                 "bridge_ingested_blocks": self.ingested_blocks,
                 "bridge_queue_depth": len(self._q),
             }

@@ -164,6 +164,10 @@ class Autoscaler:
         self.scale_downs = 0
         self.scale_errors = 0
         self.drain_holds = 0
+        # per-drain provenance: {replica, idle_age_s, inflight,
+        # affinities} for every victim actually killed (guarded by _lock)
+        self._drain_log: List[Dict[str, object]] = []
+        self._victim_info: Optional[Dict[str, object]] = None
         self.supervisor: Optional[Supervisor] = None
         degrade = getattr(server, "degrade", None)
         if degrade is not None:
@@ -286,6 +290,13 @@ class Autoscaler:
     def _scale_down(self) -> Optional[str]:
         fault_point("autoscale.scale_down")
         victim = self._pick_drain_victim()
+        if victim is not None and self._victim_info is not None:
+            # drain provenance: WHICH replica went and how quiet it
+            # actually was (idle-age straight from the fleet's stats
+            # triplet) — the audit trail for "we never drained a replica
+            # that was mid-conversation"
+            with self._lock:
+                self._drain_log.append(dict(self._victim_info))
         if victim is None:
             # drain_requires_idle and every replica is still talking:
             # hold — the armed dwell retries next tick (drain_holds
@@ -345,8 +356,20 @@ class Autoscaler:
         if best is None:
             raise RuntimeError("no active replica to drain")
         if self.cfg.drain_requires_idle and best[0][0] != 0:
+            # single-writer (the autoscaler's own worker) — see below
+            # r2d2: disable=cross-thread-unguarded-write
+            self._victim_info = None
             return None
-        return best[1]
+        i = best[1]
+        # single-writer (the autoscaler's own worker); _scale_down copies
+        # it into the locked drain log
+        self._victim_info = {  # r2d2: disable=cross-thread-unguarded-write
+            "replica": i,
+            "idle_age_s": round(float(ages[i]), 3),
+            "inflight": int(inflight[i]),
+            "affinities": int(counts[i]),
+        }
+        return i
 
     # ------------------------------------------------------------- lifecycle
 
@@ -409,6 +432,7 @@ class Autoscaler:
                 "autoscale_scale_downs": self.scale_downs,
                 "autoscale_scale_errors": self.scale_errors,
                 "autoscale_drain_holds": self.drain_holds,
+                "autoscale_drain_log": [dict(d) for d in self._drain_log],
                 "autoscale_in_flight": self._scaling,
                 "autoscale_cooldown_active": now < self._cooldown_until,
                 "autoscale_trace": [

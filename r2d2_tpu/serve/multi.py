@@ -390,6 +390,11 @@ class MultiDeviceServer:
                 migrated += 1
             else:
                 self.router.forget(sid)
+                # full disconnect, not just a routing drop: the liveloop
+                # hooks are fleet-shared, so a lost session would
+                # otherwise strand its ε assignment and an unflushed
+                # partial block in the tap accumulator forever
+                victim.evict(sid)
                 lost += 1
         with self._reload_lock:
             self.replicas_killed += 1
@@ -571,6 +576,28 @@ class MultiDeviceServer:
             self._ckpt_step = int(state.step)
             self.reloads += 1
         return True
+
+    def publish_params(self, params, ckpt_step: int,
+                       version: Optional[int] = None) -> None:
+        """Fleet-wide publish of in-memory params — reload_now minus the
+        disk restore, for callers that received new params some other way
+        (the pod-loop transport ships them over the block-stream socket).
+        Same lockstep discipline: stage every live replica outside the
+        reload lock, install all under one shared version. `version`
+        defaults to the next fleet version; an explicit value (the
+        learner's broadcast version) keeps the params_version stamps on
+        captured transitions comparable across hosts."""
+        alive = [r for r, a in zip(self.replicas, self.router.active()) if a]
+        staged = [r.prepare_for_publish(params) for r in alive]
+        with self._reload_lock:
+            v = self._version + 1 if version is None else int(version)
+            for r, prepared in zip(alive, staged):
+                r.install_prepared(prepared, int(ckpt_step), version=v,
+                                   raw_params=params)
+            self._params_host = params
+            self._version = v
+            self._ckpt_step = int(ckpt_step)
+            self.reloads += 1
 
     def _watch_iteration(self) -> None:
         # mirrors PolicyServer._watch_iteration: bounded work per call,

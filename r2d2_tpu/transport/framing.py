@@ -1,0 +1,230 @@
+"""Wire format of the block-stream transport: length-prefixed CRC frames.
+
+One frame = a fixed 13-byte header + payload:
+
+    magic   4 bytes  b"R2DB" — stream-resync sentinel; a mismatch means
+                     the peer is not speaking this protocol (or the
+                     stream tore mid-frame) and the connection is dead
+    type    1 byte   frame type (HELLO .. CKPT below)
+    length  4 bytes  big-endian u32 payload byte count
+    crc     4 bytes  big-endian u32 crc32 of the payload
+
+The CRC is an end-to-end integrity check on the PAYLOAD (the header is
+covered by the magic + the length bound): a flipped bit anywhere in a
+spooled-then-streamed Block surfaces as a FrameError at the receiver
+instead of a silently corrupted replay write. FrameError subclasses
+ConnectionError on purpose — every framing violation means the stream
+state is unrecoverable mid-connection, so the shared retry policy
+(`with_retries`, TRANSIENT_ERRORS) treats it exactly like a torn socket:
+drop the connection, reconnect, resume from the handshake.
+
+Handshake (versioned): the publisher opens with HELLO
+`{"proto": PROTO_VERSION, "host": <host-id>, "next_seq": N}` and the
+service answers HELLO_ACK `{"proto": ..., "last_seq": M}` — M being the
+highest contiguous sequence number it has already ingested from that
+host. The publisher then resends ONLY seq > M, which is what turns
+at-least-once spooling into exactly-once delivery on the happy path: a
+reconnecting (or SIGKILL-restarted) host never re-sends what the learner
+already owns, and the service's per-frame seq admission check
+(`ingest.dedup`) stays a belt-and-suspenders counter that reads 0.
+
+Control payloads (HELLO/HELLO_ACK/ACK/HEARTBEAT) are canonical JSON;
+BLOCK and CKPT payloads are npz archives (numpy's own portable binary
+container, loaded with allow_pickle=False) — see encode_block /
+encode_ckpt below.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from r2d2_tpu.replay.block import Block
+
+MAGIC = b"R2DB"
+PROTO_VERSION = 1
+
+# frame types
+HELLO = 1       # publisher -> service: {proto, host, next_seq}
+HELLO_ACK = 2   # service -> publisher: {proto, last_seq}
+BLOCK = 3       # publisher -> service: npz (one Block + stream metadata)
+ACK = 4         # service -> publisher: {seq}: highest contiguous ingested
+HEARTBEAT = 5   # either direction: {t} liveness proof on idle streams
+CKPT = 6        # service -> publisher: npz (flattened param leaves)
+
+_HEADER = struct.Struct(">4sBII")
+
+# hard bound on a single frame; a length field past this is treated as a
+# torn/garbage header rather than an allocation request (a real CKPT of
+# the presets is a few MB; tiny_test Blocks are KBs)
+MAX_FRAME_BYTES = 256 * 1024 * 1024
+
+
+class FrameError(ConnectionError):
+    """Framing violation: bad magic, CRC mismatch, absurd length, or a
+    protocol-version mismatch. The stream cannot be re-synchronized
+    mid-connection; classified transient so retry wrappers reconnect."""
+
+
+def encode_frame(ftype: int, payload: bytes) -> bytes:
+    return _HEADER.pack(MAGIC, ftype, len(payload), zlib.crc32(payload)) + payload
+
+
+def send_frame(sock, ftype: int, payload: bytes) -> None:
+    sock.sendall(encode_frame(ftype, payload))
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock) -> Tuple[int, bytes]:
+    """Read one complete frame; raises FrameError on any violation and
+    ConnectionError on EOF (both transient-classified)."""
+    magic, ftype, length, crc = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    if magic != MAGIC:
+        raise FrameError(f"bad frame magic {magic!r}")
+    if length > MAX_FRAME_BYTES:
+        raise FrameError(f"frame length {length} exceeds {MAX_FRAME_BYTES}")
+    payload = _recv_exact(sock, length) if length else b""
+    if zlib.crc32(payload) != crc:
+        raise FrameError(f"payload crc mismatch on frame type {ftype}")
+    return ftype, payload
+
+
+# ------------------------------------------------------------- JSON control
+
+
+def encode_json(obj: Dict) -> bytes:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+
+
+def decode_json(payload: bytes) -> Dict:
+    try:
+        obj = json.loads(payload.decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise FrameError(f"malformed control payload: {e}") from e
+    if not isinstance(obj, dict):
+        raise FrameError("control payload must be a JSON object")
+    return obj
+
+
+# ------------------------------------------------------------- block codec
+
+# scalar/metadata keys ride in the same npz as the arrays so one archive
+# is the whole frame (no second framing layer inside the payload)
+_BLOCK_ARRAYS = (
+    "obs", "last_action", "last_reward", "action", "n_step_reward",
+    "gamma", "hidden", "burn_in_steps", "learning_steps", "forward_steps",
+)
+
+
+def encode_block(
+    block: Block,
+    priorities: np.ndarray,
+    episode_reward: Optional[float],
+    seq: int,
+    t_serve: float,
+    eps_stamps: Optional[np.ndarray] = None,
+    ver_stamps: Optional[np.ndarray] = None,
+) -> bytes:
+    """One finished Block + its replay-add arguments + stream metadata as
+    an npz payload. `t_serve` (sender wall clock at spool time) is the
+    ingest-lag measurement anchor; `eps_stamps`/`ver_stamps` are the
+    block's per-transition off-policy audit stamps (the tap's audit-tail
+    entry), shipped so the learner side can stamp (host, ε, version) skew
+    without trusting the sender's aggregation."""
+    arrays = {k: np.asarray(getattr(block, k)) for k in _BLOCK_ARRAYS}
+    arrays["num_sequences"] = np.asarray(block.num_sequences, np.int64)
+    arrays["task"] = np.asarray(block.task, np.int64)
+    arrays["priorities"] = np.asarray(priorities)
+    arrays["has_episode_reward"] = np.asarray(
+        int(episode_reward is not None), np.int64
+    )
+    arrays["episode_reward"] = np.asarray(
+        0.0 if episode_reward is None else float(episode_reward), np.float64
+    )
+    arrays["seq"] = np.asarray(int(seq), np.int64)
+    arrays["t_serve"] = np.asarray(float(t_serve), np.float64)
+    arrays["eps_stamps"] = np.asarray(
+        [] if eps_stamps is None else eps_stamps, np.float32
+    )
+    arrays["ver_stamps"] = np.asarray(
+        [] if ver_stamps is None else ver_stamps, np.int64
+    )
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def decode_block(payload: bytes) -> Dict:
+    """Inverse of encode_block. Returns {block, priorities,
+    episode_reward, seq, t_serve, eps_stamps, ver_stamps}."""
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as d:
+            arrays = {k: np.asarray(d[k]) for k in d.files}
+    except (ValueError, OSError, KeyError, zlib.error) as e:
+        raise FrameError(f"malformed BLOCK payload: {e}") from e
+    try:
+        block = Block(
+            **{k: arrays[k] for k in _BLOCK_ARRAYS},
+            num_sequences=int(arrays["num_sequences"][()]),
+            task=int(arrays["task"][()]),
+        )
+        return {
+            "block": block,
+            "priorities": arrays["priorities"],
+            "episode_reward": (
+                float(arrays["episode_reward"][()])
+                if int(arrays["has_episode_reward"][()]) else None
+            ),
+            "seq": int(arrays["seq"][()]),
+            "t_serve": float(arrays["t_serve"][()]),
+            "eps_stamps": arrays["eps_stamps"],
+            "ver_stamps": arrays["ver_stamps"],
+        }
+    except KeyError as e:
+        raise FrameError(f"BLOCK payload missing field {e}") from e
+
+
+# -------------------------------------------------------- checkpoint codec
+
+
+def encode_ckpt(leaves: List[np.ndarray], step: int, version: int) -> bytes:
+    """Flattened param leaves + provenance as one npz payload. The
+    receiver reconstructs against its OWN template treedef (both ends
+    build the same network from the same config), so only leaf order —
+    jax.tree flattening order, deterministic for a fixed structure —
+    crosses the wire, never pickled tree structure."""
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    arrays["n_leaves"] = np.asarray(len(leaves), np.int64)
+    arrays["step"] = np.asarray(int(step), np.int64)
+    arrays["version"] = np.asarray(int(version), np.int64)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def decode_ckpt(payload: bytes) -> Tuple[List[np.ndarray], int, int]:
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as d:
+            n = int(np.asarray(d["n_leaves"])[()])
+            leaves = [np.asarray(d[f"leaf_{i}"]) for i in range(n)]
+            return (
+                leaves,
+                int(np.asarray(d["step"])[()]),
+                int(np.asarray(d["version"])[()]),
+            )
+    except (ValueError, OSError, KeyError, zlib.error) as e:
+        raise FrameError(f"malformed CKPT payload: {e}") from e

@@ -1,0 +1,380 @@
+"""BlockStreamPublisher — the serve host's end of the pod-loop stream.
+
+The publisher exposes the replay-sink surface the liveloop bridge drains
+into (`add_blocks_batch` / `add_block`), so a serve process upgrades from
+single-process liveloop to pod-loop by passing THIS object as
+`LiveLoopPlane(cfg, server, replay=publisher)` — the tap, the bridge, its
+bounded queue, and its fault sites all keep working unchanged; only the
+final hop changes from "write the local replay store" to "spool and
+stream to the learner".
+
+Delivery contract (at-least-once spool, exactly-once effect):
+
+- every offered Block is assigned the next monotonic per-host sequence
+  number and spooled BEFORE it is eligible to send (`transport.spool`;
+  on disk under `transport_spool_dir` so a SIGKILL'd host resumes its
+  numbering and unacked tail from disk);
+- the spool is bounded (`transport_spool_depth`): when full the OLDEST
+  unacked block is shed and counted — the same fresh-beats-stale policy
+  as every liveloop queue. The ingest service tolerates the resulting
+  seq gap (it acks highest-ingested, not strictly-contiguous);
+- a supervised worker ("transport-publish") owns the socket: it
+  connects with jittered exponential backoff (`transport.connect`,
+  single attempts wrapped in `with_retries` with a `max_elapsed` budget
+  below the supervision heartbeat), replays the HELLO handshake, and
+  learns from HELLO_ACK the highest seq the learner already ingested —
+  resending ONLY past it, so reconnects deliver zero duplicates;
+- acks prune the spool; a torn connection (any TRANSIENT_ERRORS out of
+  `transport.send`/`transport.recv`) just marks the stream disconnected
+  and the next iteration reconnects — the worker's restart budget is
+  reserved for real bugs, not network weather;
+- CKPT frames arriving on the same socket (the learner's hot-reload
+  broadcast) are decoded and handed to `on_checkpoint(leaves, step,
+  version)` on the worker thread.
+
+Single-writer discipline: only the worker thread touches the socket;
+producer threads (the bridge's ingest worker) and the worker share the
+spool and counters under one lock, with no blocking call inside it.
+"""
+
+from __future__ import annotations
+
+import os
+import select
+import socket
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, List, Optional, Tuple
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.transport import framing
+from r2d2_tpu.utils.faults import (
+    TRANSIENT_ERRORS,
+    Backoff,
+    fault_point,
+    with_retries,
+)
+from r2d2_tpu.utils.supervision import Supervisor
+
+# bound on blocks sent per worker iteration: keeps one body call's work
+# bounded (the supervision contract) while still draining bursts fast
+_SEND_BATCH = 64
+
+
+class BlockStreamPublisher:
+    def __init__(
+        self,
+        cfg: R2D2Config,
+        address: Tuple[str, int],
+        host_id: str,
+        audit_source: Optional[Callable[[], Optional[dict]]] = None,
+        on_checkpoint: Optional[Callable] = None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.address = address
+        self.host_id = host_id
+        # called (on the producer thread) right after each block is
+        # offered; returns the tap's freshest audit-tail entry — by the
+        # tap's emit ordering, exactly this block's (epsilon,
+        # params_version) stamps — or None when no tap is wired
+        self.audit_source = audit_source
+        self.on_checkpoint = on_checkpoint
+        self._lock = threading.Lock()
+        # spool of (seq, payload) awaiting ack, oldest first
+        self._spool: Deque[Tuple[int, bytes]] = deque()
+        self._next_seq = 1
+        self._sent_up_to = 0  # highest seq handed to sendall this session
+        self._acked = 0       # highest seq the service has acknowledged
+        self._sock: Optional[socket.socket] = None
+        self._last_send = 0.0
+        self._backoff = Backoff(
+            base=0.05, factor=2.0, max_delay=2.0, jitter=0.5, seed=seed
+        )
+        self.supervisor: Optional[Supervisor] = None
+        # counters, guarded by _lock
+        self.spooled_blocks = 0
+        self.sent_blocks = 0
+        self.acked_blocks = 0
+        self.spool_dropped = 0
+        self.reconnects = 0
+        self.connect_failures = 0
+        self.ckpts_applied = 0
+        self._spool_path = None
+        if cfg.transport_spool_dir:
+            self._spool_path = os.path.join(cfg.transport_spool_dir, host_id)
+            os.makedirs(self._spool_path, exist_ok=True)
+            self._load_spool()
+
+    # ------------------------------------------------------------ spool disk
+
+    def _load_spool(self) -> None:
+        """Crash resume: reload the unacked tail and continue the sequence
+        numbering past everything ever spooled here."""
+        entries = []
+        for name in os.listdir(self._spool_path):
+            if not name.endswith(".blk"):
+                continue
+            seq = int(name[:-4])
+            with open(os.path.join(self._spool_path, name), "rb") as f:
+                entries.append((seq, f.read()))
+        entries.sort()
+        # __init__-only (no worker exists yet)
+        # r2d2: disable=cross-thread-unguarded-write
+        self._spool.extend(entries)
+        if entries:
+            # __init__-only (no worker exists yet)
+            self._next_seq = entries[-1][0] + 1  # r2d2: disable=lock-discipline
+
+    def _spool_file(self, seq: int) -> str:
+        return os.path.join(self._spool_path, f"{seq:012d}.blk")
+
+    # --------------------------------------------------------- replay surface
+
+    def add_block(self, block, priorities, episode_reward) -> None:
+        """The bridge's per-block sink: assign a seq, encode, persist,
+        enqueue. Never blocks on the network — the worker streams the
+        spool independently."""
+        audit = self.audit_source() if self.audit_source is not None else None
+        eps = audit.get("epsilon") if audit else None
+        ver = audit.get("params_version") if audit else None
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+        payload = framing.encode_block(
+            block, priorities, episode_reward, seq=seq, t_serve=time.time(),
+            eps_stamps=eps, ver_stamps=ver,
+        )
+        fault_point("transport.spool")
+        if self._spool_path is not None:
+            # persist-then-enqueue: a crash between the two re-sends a
+            # spooled block (at-least-once), never invents a seq gap
+            tmp = self._spool_file(seq) + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, self._spool_file(seq))
+        with self._lock:
+            if len(self._spool) >= self.cfg.transport_spool_depth:
+                old_seq, _ = self._spool.popleft()
+                self.spool_dropped += 1
+                self._drop_spool_file(old_seq)
+            self._spool.append((seq, payload))
+            self.spooled_blocks += 1
+
+    def add_blocks_batch(self, items) -> None:
+        for block, priorities, episode_reward in items:
+            self.add_block(block, priorities, episode_reward)
+
+    def _drop_spool_file(self, seq: int) -> None:
+        if self._spool_path is None:
+            return
+        try:
+            os.unlink(self._spool_file(seq))
+        except OSError:
+            pass  # already pruned (or the dir is gone at teardown)
+
+    # ------------------------------------------------------------- connection
+
+    def _connect_once(self) -> socket.socket:
+        fault_point("transport.connect")
+        sock = socket.create_connection(
+            self.address, timeout=self.cfg.transport_connect_timeout_s
+        )
+        try:
+            sock.settimeout(self.cfg.transport_connect_timeout_s)
+            with self._lock:
+                next_seq = self._next_seq
+            framing.send_frame(sock, framing.HELLO, framing.encode_json({
+                "proto": framing.PROTO_VERSION,
+                "host": self.host_id,
+                "next_seq": next_seq,
+            }))
+            ftype, payload = framing.recv_frame(sock)
+            if ftype != framing.HELLO_ACK:
+                raise framing.FrameError(
+                    f"expected HELLO_ACK, got frame type {ftype}"
+                )
+            hello = framing.decode_json(payload)
+            if hello.get("proto") != framing.PROTO_VERSION:
+                raise framing.FrameError(
+                    f"protocol version mismatch: peer speaks "
+                    f"{hello.get('proto')}, we speak {framing.PROTO_VERSION}"
+                )
+            last_seq = int(hello.get("last_seq", 0))
+        except BaseException:
+            sock.close()
+            raise
+        sock.settimeout(self.cfg.transport_connect_timeout_s)
+        self._on_resume(last_seq)
+        return sock
+
+    def _on_resume(self, last_seq: int) -> None:
+        """HELLO_ACK told us what the learner already owns: prune it from
+        the spool and resume sending strictly past it — the zero-duplicate
+        reconnect contract."""
+        dropped: List[int] = []
+        with self._lock:
+            while self._spool and self._spool[0][0] <= last_seq:
+                dropped.append(self._spool.popleft()[0])
+            self._acked = max(self._acked, last_seq)
+            self._sent_up_to = last_seq
+            self.acked_blocks += len(dropped)
+        for seq in dropped:
+            self._drop_spool_file(seq)
+
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    def _disconnect(self) -> None:
+        with self._lock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # ---------------------------------------------------------------- pumping
+
+    def pump(self, timeout: float = 0.25) -> None:
+        """One bounded unit of publisher work: ensure a live connection,
+        drain inbound control frames, stream the unsent spool tail, prove
+        liveness. The supervised worker body; also callable synchronously
+        (tests, the stop-path flush)."""
+        if self._sock is None:
+            try:
+                # two fast attempts per iteration, wall-clock-bounded so a
+                # black-holed connect can never starve the heartbeat; the
+                # across-iteration escalation is the jittered Backoff
+                sock = with_retries(
+                    self._connect_once, "transport.connect", attempts=2,
+                    base_delay=0.05, max_elapsed=
+                    2 * self.cfg.transport_connect_timeout_s,
+                )
+            except TRANSIENT_ERRORS:
+                with self._lock:
+                    self.connect_failures += 1
+                wait = self._backoff.fail()
+                stop = self.supervisor.stop if self.supervisor else None
+                if stop is not None:
+                    stop.wait(wait)
+                else:
+                    time.sleep(wait)
+                return
+            self._backoff.reset()
+            with self._lock:
+                self._sock = sock
+                self.reconnects += 1
+                self._last_send = time.monotonic()
+        try:
+            self._drain_inbound(timeout)
+            self._send_tail()
+            self._maybe_heartbeat()
+        except TRANSIENT_ERRORS:
+            # torn stream (real or injected at transport.send/recv): the
+            # next iteration reconnects and the handshake resumes the seq
+            self._disconnect()
+
+    def _drain_inbound(self, timeout: float) -> None:
+        while True:
+            ready, _, _ = select.select([self._sock], [], [], timeout)
+            if not ready:
+                return
+            timeout = 0.0  # only the first wait blocks; then drain dry
+            fault_point("transport.recv")
+            ftype, payload = framing.recv_frame(self._sock)
+            if ftype == framing.ACK:
+                self._on_ack(int(framing.decode_json(payload)["seq"]))
+            elif ftype == framing.CKPT:
+                leaves, step, version = framing.decode_ckpt(payload)
+                with self._lock:
+                    self.ckpts_applied += 1
+                if self.on_checkpoint is not None:
+                    self.on_checkpoint(leaves, step, version)
+            elif ftype == framing.HEARTBEAT:
+                pass  # liveness only
+            else:
+                raise framing.FrameError(
+                    f"unexpected frame type {ftype} on publisher stream"
+                )
+
+    def _on_ack(self, seq: int) -> None:
+        dropped: List[int] = []
+        with self._lock:
+            while self._spool and self._spool[0][0] <= seq:
+                dropped.append(self._spool.popleft()[0])
+            self._acked = max(self._acked, seq)
+            self.acked_blocks += len(dropped)
+        for s in dropped:
+            self._drop_spool_file(s)
+
+    def _send_tail(self) -> None:
+        with self._lock:
+            tail = [
+                (seq, payload) for seq, payload in self._spool
+                if seq > self._sent_up_to
+            ][:_SEND_BATCH]
+        for seq, payload in tail:
+            fault_point("transport.send")
+            framing.send_frame(self._sock, framing.BLOCK, payload)
+            with self._lock:
+                self._last_send = time.monotonic()
+                self._sent_up_to = max(self._sent_up_to, seq)
+                self.sent_blocks += 1
+
+    def _maybe_heartbeat(self) -> None:
+        now = time.monotonic()
+        if now - self._last_send >= self.cfg.transport_heartbeat_s:
+            fault_point("transport.send")
+            framing.send_frame(
+                self._sock, framing.HEARTBEAT,
+                framing.encode_json({"t": time.time()}),
+            )
+            with self._lock:
+                self._last_send = now
+
+    # --------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self.supervisor = Supervisor()
+        self.supervisor.spawn("transport-publish", lambda: self.pump(0.25))
+
+    def check(self) -> dict:
+        return self.supervisor.check() if self.supervisor is not None else {}
+
+    def flush(self, deadline_s: float = 5.0) -> bool:
+        """Best-effort final drain (stop path): pump synchronously until
+        the spool is fully acked or the deadline passes. Returns True when
+        everything offered was delivered AND acknowledged."""
+        limit = time.monotonic() + deadline_s
+        while time.monotonic() < limit:
+            with self._lock:
+                if not self._spool:
+                    return True
+            self.pump(timeout=0.05)
+        with self._lock:
+            return not self._spool
+
+    def stop(self, flush_deadline_s: float = 5.0) -> None:
+        if self.supervisor is not None:
+            self.supervisor.shutdown(timeout=5.0)
+            self.supervisor = None
+        self.flush(flush_deadline_s)
+        self._disconnect()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "transport_spooled_blocks": self.spooled_blocks,
+                "transport_sent_blocks": self.sent_blocks,
+                "transport_acked_blocks": self.acked_blocks,
+                "transport_spool_dropped": self.spool_dropped,
+                "transport_spool_depth": len(self._spool),
+                "transport_reconnects": self.reconnects,
+                "transport_connect_failures": self.connect_failures,
+                "transport_ckpts_applied": self.ckpts_applied,
+                "transport_acked_seq": self._acked,
+                "transport_next_seq": self._next_seq,
+                "transport_connected": self._sock is not None,
+            }

@@ -62,6 +62,25 @@ Registered sites (KNOWN_SITES below):
                         scale-up mid-pressure (serve/autoscale.py)
 - autoscale.scale_down — fires at the exact decision to drain a replica,
                         before the victim is chosen (serve/autoscale.py)
+- transport.connect   — the block-stream publisher's connect+handshake to
+                        the learner's ingest service; retried with
+                        jittered backoff, the reconnect drill
+                        (transport/publisher.py)
+- transport.send      — one framed send on the publisher's socket: a
+                        mid-stream "error" drops the connection and the
+                        unacked spool tail is resent after the reconnect
+                        handshake (transport/publisher.py)
+- transport.recv      — one framed receive (ACK/CKPT/HEARTBEAT) on the
+                        publisher's socket (transport/publisher.py)
+- transport.spool     — the publisher's per-block spool write (the
+                        at-least-once persistence point; on-disk when
+                        transport_spool_dir is set)
+                        (transport/publisher.py)
+- ingest.accept       — the learner-side service's accept/handshake of
+                        one host connection (transport/ingest.py)
+- ingest.dedup        — the per-host sequence-number admission check on
+                        every received BLOCK frame — the exactly-once
+                        delivery seam (transport/ingest.py)
 """
 
 from __future__ import annotations
@@ -100,6 +119,12 @@ KNOWN_SITES = (
     "autoscale.evaluate",
     "autoscale.scale_up",
     "autoscale.scale_down",
+    "transport.connect",
+    "transport.send",
+    "transport.recv",
+    "transport.spool",
+    "ingest.accept",
+    "ingest.dedup",
 )
 
 
@@ -277,6 +302,8 @@ def with_retries(
     max_delay: float = 2.0,
     retry_on: Tuple[Type[BaseException], ...] = TRANSIENT_ERRORS,
     sleep: Callable[[float], None] = time.sleep,
+    max_elapsed: Optional[float] = None,
+    clock: Callable[[], float] = time.monotonic,
 ):
     """Run `fn` with bounded exponential backoff on transient errors.
 
@@ -284,13 +311,24 @@ def with_retries(
     Trainer and serve metrics merge these, so a flaky boundary shows up
     as a rate in the metrics stream instead of vanishing into latency.
     The final attempt's error propagates: retries bound tail latency,
-    they do not convert persistent failures into hangs."""
+    they do not convert persistent failures into hangs.
+
+    `max_elapsed` (seconds) is a second, wall-clock budget on top of the
+    attempt count: once `clock()` has advanced past it — attempt time
+    included, not just backoff sleeps — the next failure propagates even
+    with attempts remaining. Supervised worker bodies wrap transport I/O
+    with max_elapsed below their heartbeat timeout so a wedged peer
+    surfaces as a (restartable) crash, never as a stale heartbeat that
+    escalates to a process-fatal stall."""
     delay = base_delay
+    t0 = clock() if max_elapsed is not None else 0.0
     for attempt in range(attempts):
         try:
             return fn()
         except retry_on:
             if attempt == attempts - 1:
+                raise
+            if max_elapsed is not None and clock() - t0 >= max_elapsed:
                 raise
             with _retry_lock:
                 _retry_counts[site] = _retry_counts.get(site, 0) + 1

@@ -1,0 +1,87 @@
+#!/bin/bash
+# Round-18 pod-loop transport chain: the measurement side of the
+# block-stream transport PR (transport/framing|publisher|ingest, the
+# podloop roles, the SIGKILL-one-host drill). Three rungs, the report
+# written to BENCH_r18.json:
+#
+#   1. transport gate — the transport/chaos/fault/liveloop/autoscale
+#      test files plus the full static-analysis CLI (AST lints, jaxpr
+#      gates, AND the interprocedural concurrency pass over the new
+#      publisher/ingest threads). A broken resume protocol or a racy
+#      spool aborts the chain: pod economics measured over a stream
+#      that duplicates or drops silently are noise.
+#   2. parity anchor  — one single-process liveloop-off serve row, so
+#      the default (transport-less) path is exercised the same day the
+#      pod loop ships.
+#   3. pod loop       — bench.py --mode podloop: 2 serve processes +
+#      1 learner process on CPU, closed-loop catch traffic, a mid-run
+#      SIGKILL of serve host 0, relaunch from its on-disk spool.
+#
+# PRE-REGISTERED read: the learner process rides through the SIGKILL
+# uninterrupted AND keeps training (step advances after the kill), the
+# killed host resumes from its spool with its per-host seq ADVANCING
+# past the pre-kill high-water, duplicate_blocks == 0 on the learner,
+# sessions_lost == 0 across every host, >= 1 checkpoint broadcast
+# applied by >= 1 host (host_reloads), and ingest lag is reported as a
+# first-class column (p50/p95/max ms) — the metric that decides
+# whether the fleet learns from today's traffic today.
+cd /root/repo
+
+. runs/lib.sh
+
+OUT=BENCH_r18.json
+
+echo "=== RUNG 1: transport gate ==="
+python -m pytest tests/test_transport.py tests/test_chaos.py \
+  tests/test_faults.py tests/test_liveloop.py tests/test_autoscale.py \
+  -q -p no:cacheprovider
+RC=$?
+echo "=== TRANSPORT_PYTEST EXIT: $RC ==="
+python -m r2d2_tpu.analysis.cli --jaxpr --concurrency
+RCA=$?
+echo "=== ANALYSIS EXIT: $RCA ==="
+if [ $RC -ne 0 ] || [ $RCA -ne 0 ]; then
+  echo "=== ABORT: transport gate failed; pod economics would be noise ==="
+  exit 1
+fi
+
+echo "=== RUNG 2: parity anchor (single process, transport-less default) ==="
+python bench.py --mode serve --serve-seconds 10 --arrival-rate 60 \
+  | tee runs/bench_serve_r18_anchor.jsonl
+echo "=== SERVE_ANCHOR EXIT: $? ==="
+
+echo "=== RUNG 3: pod loop (2 serve hosts + 1 learner, SIGKILL drill) ==="
+python bench.py --mode podloop --podloop-out "$OUT"
+RC=$?
+echo "=== PODLOOP EXIT: $RC ==="
+if [ $RC -ne 0 ]; then
+  echo "=== ABORT: podloop bench failed ==="
+  exit 1
+fi
+
+python - "$OUT" <<'PY'
+import json, sys
+r = json.load(open(sys.argv[1]))
+d = r["sigkill_drill"]
+assert d["learner_uninterrupted"], d
+assert r["learner_step_final"] > d["learner_step_at_kill"], \
+    (d["learner_step_at_kill"], r["learner_step_final"])
+assert d["h0_seq_final"] > d["h0_seq_at_kill"], d
+assert d["duplicate_blocks"] == 0, d["duplicate_blocks"]
+assert d["sessions_lost"] == 0, d["sessions_lost"]
+assert r["ckpts_broadcast"] >= 1 and sum(r["host_reloads"]) >= 1, \
+    (r["ckpts_broadcast"], r["host_reloads"])
+assert r["value"] is not None and r["value"] > 0, r["value"]
+print(f"podloop: {r['agg_requests_per_s']:.0f} req/s aggregate, "
+      f"return/session {r['return_per_session_2nd_half']}, "
+      f"lag p50/p95 {r['ingest_lag_p50_ms']:.0f}/{r['value']:.0f} ms, "
+      f"drill: learner {d['learner_step_at_kill']}->"
+      f"{r['learner_step_final']}, h0 seq {d['h0_seq_at_kill']}->"
+      f"{d['h0_seq_final']}, dupes 0, lost 0, "
+      f"reloads {r['host_reloads']}")
+PY
+RC=$?
+echo "=== PODLOOP_ASSERT EXIT: $RC ==="
+[ $RC -ne 0 ] && exit 1
+
+echo R18_PODLOOP_ALL_DONE

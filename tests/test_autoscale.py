@@ -517,3 +517,102 @@ def test_autoscale_defaults_off_and_validates():
     with pytest.raises(ValueError, match="autoscale"):
         cfg.replace(autoscale_min_replicas=3,
                     autoscale_max_replicas=2).validate()
+
+
+# ------------------------------------------------------- drain provenance
+
+
+def test_drain_logs_victim_idle_age():
+    """Every drain records WHICH replica went and how quiet it was —
+    idle-age straight from the fleet's per-replica stats triplet — in
+    stats()['autoscale_drain_log'] (the audit trail the pod-loop bench
+    and ops dashboards read)."""
+    stub, auto = _autoscaler(dwell_down=2)
+    stub.n = 2
+    stub.depth = 0
+    evs = [auto.evaluate_once() for _ in range(3)]
+    assert "down" in evs
+    log = auto.stats()["autoscale_drain_log"]
+    assert len(log) == 1
+    entry = log[0]
+    assert entry["replica"] == 0  # the idle one (age 9.0 in the stub)
+    assert entry["idle_age_s"] == pytest.approx(9.0)
+    assert entry["inflight"] == 0
+    assert entry["affinities"] == 1
+    # a held drain (nobody idle) logs nothing
+    class _Busy(_ElasticStub):
+        def stats(self):
+            st = super().stats()
+            st["replica_last_request_age_s"] = [0.01] * self.n
+            return st
+
+    stub2 = _Busy(n=2)
+    auto2 = Autoscaler(stub2, AutoscaleConfig(
+        min_replicas=1, max_replicas=2, dwell_down=2, cooldown_s=0.0,
+        idle_age_s=1.0, min_samples=4,
+    ))
+    stub2.depth = 0
+    for _ in range(4):
+        auto2.evaluate_once()
+    assert auto2.drain_holds >= 1
+    assert auto2.stats()["autoscale_drain_log"] == []
+
+
+def test_drain_during_active_tap_never_strands_accumulator():
+    """A drain that LOSES sessions (no spill room on the survivor) must
+    disconnect them from the fleet-shared liveloop hooks too: each lost
+    session's partial block is cut into the ingest stream and its tap
+    accumulator stream closes — nothing is stranded unflushed with no
+    writer left."""
+    from r2d2_tpu.liveloop import LiveLoopPlane
+
+    class _Sink:
+        def __init__(self):
+            self.items = []
+
+        def add_blocks_batch(self, items):
+            self.items.extend(items)
+
+    cfg = tiny_test().replace(serve_devices=1, serve_spill=8, liveloop=True)
+    srv = MultiDeviceServer(
+        cfg, ServeConfig(buckets=(2,), max_wait_ms=1.0, cache_capacity=8)
+    )
+    sink = _Sink()
+    plane = LiveLoopPlane(cfg, srv, sink)  # hooks installed, driven inline
+    srv.warmup()
+    srv.start()
+    client = LocalClient(srv)
+    rng = np.random.default_rng(23)
+
+    def step_all(sids, first=False):
+        for sid in sids:
+            obs = rng.integers(0, 255, cfg.obs_shape, dtype=np.uint8)
+            client.act(sid, obs, reward=0.1, reset=first)
+
+    gen_a = [f"keep-{i}" for i in range(3)]
+    gen_b = [f"lose-{i}" for i in range(3)]
+    try:
+        step_all(gen_a, first=True)
+        srv.add_replica()
+        step_all(gen_b, first=True)  # land on the new least-loaded replica
+        step_all(gen_a + gen_b)      # a couple of captured transitions each
+        plane.tap.process_pending(timeout=0.0)
+        assert plane.tap.stats()["tap_open_sessions"] == 6
+        assert all(srv.router.peek(s) == 1 for s in gen_b)
+        # survivor refuses every migrating row: all of replica 1's
+        # sessions are genuinely lost mid-ingest
+        srv.replicas[0].cache.import_spilled = lambda *a, **k: False
+        outcome = srv.kill_replica(1)
+        assert outcome["lost"] == len(gen_b)
+        # the lost sessions' queued evictions cut their partials and close
+        # their streams; the survivors' accumulators are untouched
+        plane.tap.process_pending(timeout=0.0)
+        st = plane.tap.stats()
+        assert st["tap_open_sessions"] == len(gen_a)
+        assert st["tap_emitted_blocks"] == len(gen_b)  # the cut partials
+        plane.bridge.drain_once()
+        assert len(sink.items) == len(gen_b)  # ...and they reached replay
+    finally:
+        plane.stop()
+        srv.stop()
+    assert srv.stats()["sessions_lost"] == len(gen_b)

@@ -169,6 +169,63 @@ def test_with_retries_backoff_schedule():
     assert delays == [0.05, 0.1, 0.15]  # doubled, then clamped
 
 
+def test_with_retries_max_elapsed_budget_propagates():
+    """The per-site wall-clock budget: even with attempts remaining, a
+    failure past `max_elapsed` propagates instead of sleeping again."""
+    clock = [0.0]
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        clock[0] += 0.6  # each attempt "takes" 0.6s
+        raise OSError("slow transient")
+
+    with pytest.raises(OSError):
+        with_retries(
+            flaky, "test.site", attempts=10, max_elapsed=1.0,
+            sleep=lambda _: None, clock=lambda: clock[0],
+        )
+    # attempt 1 at t=0.6 (under budget, retries), attempt 2 at t=1.2
+    # (over budget, propagates) — the remaining 8 attempts never run
+    assert len(calls) == 2
+    assert faults.retry_stats() == {"test.site": 1}
+
+
+def test_with_retries_max_elapsed_under_budget_keeps_retrying():
+    clock = [0.0]
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        clock[0] += 0.1
+        if len(attempts) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert with_retries(
+        flaky, "test.site", attempts=5, max_elapsed=10.0,
+        sleep=lambda _: None, clock=lambda: clock[0],
+    ) == "ok"
+    assert len(attempts) == 3
+
+
+def test_with_retries_no_budget_is_unbounded_in_time():
+    """max_elapsed=None (the default) preserves the old contract: only
+    the attempt count bounds the loop, never the clock."""
+    clock = [0.0]
+
+    def flaky():
+        clock[0] += 1e9
+        if clock[0] < 3e9:
+            raise OSError("x")
+        return "ok"
+
+    assert with_retries(
+        flaky, "test.site", attempts=3, sleep=lambda _: None,
+        clock=lambda: clock[0],
+    ) == "ok"
+
+
 def test_with_retries_absorbs_injected_fault():
     faults.install(FaultPlane(schedule={"s": {1: "error"}}))
 

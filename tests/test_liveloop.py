@@ -268,6 +268,13 @@ def test_bridge_backpressure_drops_oldest_counted():
     stats = bridge.stats()
     assert stats["bridge_ingested_blocks"] == 2
     assert stats["bridge_queue_depth"] == 0
+    # drain-granularity drop visibility: this drain observed the 3 sheds
+    # since the previous one; a quiet follow-up drain reads 0 again
+    assert stats["bridge_dropped_last_drain"] == 3
+    bridge.offer("block5", "prio5", None)
+    bridge.drain_once()
+    assert bridge.stats()["bridge_dropped_last_drain"] == 0
+    assert bridge.stats()["bridge_dropped_blocks"] == 3
 
 
 def test_bridge_falls_back_to_add_block():

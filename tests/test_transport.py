@@ -1,0 +1,377 @@
+"""Block-stream transport tests (r2d2_tpu/transport): frame codec
+integrity, publisher<->ingest loopback delivery with ack pruning and
+audit stamping, zero-duplicate reconnect resume, on-disk spool crash
+resume, bounded-spool shedding with gap tolerance, dead-peer reaping,
+and the checkpoint broadcast path. All CPU, all loopback sockets."""
+
+from __future__ import annotations
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import tiny_test
+from r2d2_tpu.replay.block import Block
+from r2d2_tpu.transport import framing
+from r2d2_tpu.transport.ingest import IngestService
+from r2d2_tpu.transport.publisher import BlockStreamPublisher
+from r2d2_tpu.utils import faults
+
+pytestmark = pytest.mark.transport
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    faults.uninstall()
+    faults.reset_retry_stats()
+    yield
+    faults.uninstall()
+    faults.reset_retry_stats()
+
+
+def _cfg(**over):
+    base = dict(
+        env_name="catch", action_dim=3, liveloop=True,
+        transport_connect_timeout_s=2.0,
+        transport_heartbeat_s=0.2,
+        transport_dead_peer_s=1.0,
+    )
+    base.update(over)
+    return tiny_test().replace(**base).validate()
+
+
+def mk_block(i: int, T: int = 12) -> Block:
+    rng = np.random.default_rng(i)
+    B = 1
+    return Block(
+        obs=rng.normal(size=(T, B, 5, 5)).astype(np.float32),
+        last_action=rng.integers(0, 3, (T, B)).astype(np.int32),
+        last_reward=rng.normal(size=(T, B)).astype(np.float32),
+        action=rng.integers(0, 3, (T, B)).astype(np.int32),
+        n_step_reward=rng.normal(size=(T, B)).astype(np.float32),
+        gamma=np.ones((T, B), np.float32),
+        hidden=rng.normal(size=(2, B, 8)).astype(np.float32),
+        num_sequences=B,
+        burn_in_steps=np.zeros((B,), np.int32),
+        learning_steps=np.full((B,), T, np.int32),
+        forward_steps=np.zeros((B,), np.int32),
+    )
+
+
+class FakeReplay:
+    def __init__(self):
+        self.items = []
+
+    def add_blocks_batch(self, items):
+        self.items.extend(items)
+
+
+def _pump_until(pub, cond, deadline_s=15.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        pub.pump(timeout=0.05)
+    return cond()
+
+
+@pytest.fixture()
+def loop(request):
+    """A running IngestService + a synchronous publisher wired to it."""
+    cfg = _cfg()
+    replay = FakeReplay()
+    svc = IngestService(cfg, replay, version_source=lambda: 7)
+    svc.start()
+    applied = []
+    pub = BlockStreamPublisher(
+        cfg, ("127.0.0.1", svc.port), "h0", seed=1,
+        on_checkpoint=lambda leaves, step, ver: applied.append(
+            (leaves, step, ver)
+        ),
+    )
+    yield cfg, replay, svc, pub, applied
+    pub.stop(flush_deadline_s=1.0)
+    svc.stop()
+
+
+# ------------------------------------------------------------- frame codec
+
+
+def test_frame_roundtrip_over_socketpair():
+    a, b = socket.socketpair()
+    try:
+        framing.send_frame(a, framing.HELLO, framing.encode_json({"x": 1}))
+        payload = framing.encode_block(
+            mk_block(0), np.ones((1,), np.float32), 0.25, seq=3, t_serve=1.5
+        )
+        framing.send_frame(a, framing.BLOCK, payload)
+        ftype, got = framing.recv_frame(b)
+        assert ftype == framing.HELLO
+        assert framing.decode_json(got) == {"x": 1}
+        ftype, got = framing.recv_frame(b)
+        assert ftype == framing.BLOCK
+        assert got == payload
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_crc_rejects_corruption():
+    frame = bytearray(
+        framing.encode_frame(framing.BLOCK, b"payload-bytes")
+    )
+    frame[-3] ^= 0xFF  # flip a payload bit
+    a, b = socket.socketpair()
+    try:
+        a.sendall(bytes(frame))
+        with pytest.raises(framing.FrameError, match="crc"):
+            framing.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_bad_magic_rejected():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"NOPE" + b"\x00" * 9)
+        with pytest.raises(framing.FrameError, match="magic"):
+            framing.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_block_codec_roundtrip_bit_exact():
+    block = mk_block(4)
+    prios = np.asarray([0.7], np.float32)
+    eps = np.asarray([0.1, 0.2], np.float32)
+    ver = np.asarray([3, 3], np.int64)
+    payload = framing.encode_block(
+        block, prios, 1.25, seq=9, t_serve=2.5, eps_stamps=eps,
+        ver_stamps=ver,
+    )
+    d = framing.decode_block(payload)
+    for f in ("obs", "last_action", "last_reward", "action",
+              "n_step_reward", "gamma", "hidden", "burn_in_steps",
+              "learning_steps", "forward_steps"):
+        np.testing.assert_array_equal(getattr(d["block"], f),
+                                      getattr(block, f))
+    assert d["block"].num_sequences == block.num_sequences
+    np.testing.assert_array_equal(d["priorities"], prios)
+    assert d["episode_reward"] == 1.25
+    assert d["seq"] == 9 and d["t_serve"] == 2.5
+    np.testing.assert_array_equal(d["eps_stamps"], eps)
+    np.testing.assert_array_equal(d["ver_stamps"], ver)
+    # None episode reward survives the has_episode_reward flag
+    d2 = framing.decode_block(framing.encode_block(
+        block, prios, None, seq=1, t_serve=0.0
+    ))
+    assert d2["episode_reward"] is None
+
+
+def test_ckpt_codec_roundtrip():
+    leaves = [np.arange(6.0).reshape(2, 3), np.ones((4,), np.float32)]
+    got, step, version = framing.decode_ckpt(
+        framing.encode_ckpt(leaves, step=40, version=2)
+    )
+    assert step == 40 and version == 2
+    assert len(got) == 2
+    for a, b in zip(got, leaves):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_malformed_payloads_raise_frame_error():
+    with pytest.raises(framing.FrameError):
+        framing.decode_block(b"not an npz")
+    with pytest.raises(framing.FrameError):
+        framing.decode_ckpt(b"garbage")
+    with pytest.raises(framing.FrameError):
+        framing.decode_json(b"\xff\xfe")
+
+
+# ------------------------------------------------------------ loopback path
+
+
+def test_loopback_delivery_acks_and_stamps(loop):
+    cfg, replay, svc, pub, _ = loop
+    stamps = iter([{"epsilon": np.asarray([0.3], np.float32),
+                    "params_version": np.asarray([5], np.int64)}] * 3)
+    pub.audit_source = lambda: next(stamps)
+    for i in range(3):
+        pub.add_block(mk_block(i), np.ones((1,), np.float32), float(i))
+    assert _pump_until(pub, lambda: len(replay.items) == 3)
+    # acks prune the spool down to nothing
+    assert _pump_until(
+        pub, lambda: pub.stats()["transport_spool_depth"] == 0
+    )
+    st = svc.stats()
+    assert st["ingest_blocks"] == 3
+    assert st["ingest_duplicate_blocks"] == 0
+    assert st["ingest_host_seq"] == {"h0": 3}
+    # learner-side audit stamps: host, epsilon, version skew vs the
+    # learner's version_source (7 - 5 = 2)
+    tail = list(svc.audit_tail)
+    assert [e["seq"] for e in tail] == [1, 2, 3]
+    assert all(e["host"] == "h0" for e in tail)
+    assert all(e["version_skew"] == 2 for e in tail)
+    assert all(e["ingest_lag_s"] >= 0.0 for e in tail)
+    np.testing.assert_array_equal(tail[0]["epsilon"],
+                                  np.asarray([0.3], np.float32))
+    # delivered content is bit-identical
+    np.testing.assert_array_equal(replay.items[0][0].obs, mk_block(0).obs)
+    assert [er for (_, _, er) in replay.items] == [0.0, 1.0, 2.0]
+
+
+def test_reconnect_resumes_without_duplicates(loop):
+    cfg, replay, svc, pub, _ = loop
+    for i in range(4):
+        pub.add_block(mk_block(i), np.ones((1,), np.float32), None)
+    assert _pump_until(pub, lambda: len(replay.items) == 4)
+    pub._disconnect()  # torn stream mid-run
+    for i in range(4, 6):
+        pub.add_block(mk_block(i), np.ones((1,), np.float32), None)
+    assert _pump_until(pub, lambda: len(replay.items) == 6)
+    st = svc.stats()
+    assert st["ingest_blocks"] == 6
+    assert st["ingest_duplicate_blocks"] == 0
+    assert st["ingest_host_seq"] == {"h0": 6}
+    assert pub.stats()["transport_reconnects"] == 2
+
+
+def test_ckpt_broadcast_reaches_publisher(loop):
+    cfg, replay, svc, pub, applied = loop
+    pub.add_block(mk_block(0), np.ones((1,), np.float32), None)
+    assert _pump_until(pub, lambda: len(replay.items) == 1)
+    leaves = [np.arange(4.0), np.full((2, 2), 7.0)]
+    svc.broadcast_checkpoint(leaves, step=20, version=3)
+    assert _pump_until(pub, lambda: len(applied) == 1)
+    got, step, version = applied[0]
+    assert (step, version) == (20, 3)
+    for a, b in zip(got, leaves):
+        np.testing.assert_array_equal(a, b)
+    assert pub.stats()["transport_ckpts_applied"] == 1
+
+
+def test_spool_shed_oldest_counted_gap_tolerated():
+    """A bounded spool under a dead learner sheds its OLDEST unacked
+    blocks; once connected, the learner ingests the surviving tail across
+    the seq gap without wedging or double-counting."""
+    cfg = _cfg(transport_spool_depth=3)
+    replay = FakeReplay()
+    svc = IngestService(cfg, replay, version_source=None)
+    pub = BlockStreamPublisher(cfg, ("127.0.0.1", svc.port), "h0", seed=2)
+    try:
+        for i in range(5):  # 5 offers into a depth-3 spool: 2 shed
+            pub.add_block(mk_block(i), np.ones((1,), np.float32), None)
+        st = pub.stats()
+        assert st["transport_spool_dropped"] == 2
+        assert st["transport_spool_depth"] == 3
+        svc.start()
+        assert _pump_until(pub, lambda: len(replay.items) == 3)
+        st = svc.stats()
+        # seq 3..5 arrive over the 1..2 gap; the high-water mark lands on 5
+        assert st["ingest_host_seq"] == {"h0": 5}
+        assert st["ingest_duplicate_blocks"] == 0
+        np.testing.assert_array_equal(replay.items[0][0].obs, mk_block(2).obs)
+    finally:
+        pub.stop(flush_deadline_s=1.0)
+        svc.stop()
+
+
+def test_spool_crash_resume_from_disk(tmp_path):
+    """SIGKILL semantics: a publisher dies with unacked spool on disk; a
+    fresh publisher with the same host id and spool dir resumes the
+    numbering and delivers the tail — and the handshake guarantees the
+    learner sees zero duplicates even for blocks it already ingested."""
+    cfg = _cfg(transport_spool_dir=str(tmp_path))
+    replay = FakeReplay()
+    svc = IngestService(cfg, replay, version_source=None)
+    svc.start()
+    pub = BlockStreamPublisher(cfg, ("127.0.0.1", svc.port), "h0", seed=3)
+    for i in range(3):
+        pub.add_block(mk_block(i), np.ones((1,), np.float32), None)
+    assert _pump_until(pub, lambda: len(replay.items) == 3)
+    # die WITHOUT acking having pruned everything: add two more that the
+    # learner never sees, then vanish (no stop/flush — SIGKILL)
+    pub._disconnect()
+    for i in range(3, 5):
+        pub.add_block(mk_block(i), np.ones((1,), np.float32), None)
+    del pub
+
+    pub2 = BlockStreamPublisher(cfg, ("127.0.0.1", svc.port), "h0", seed=4)
+    try:
+        # numbering resumed past everything ever spooled here
+        assert pub2.stats()["transport_next_seq"] == 6
+        pub2.add_block(mk_block(5), np.ones((1,), np.float32), None)
+        assert _pump_until(pub2, lambda: len(replay.items) == 6)
+        st = svc.stats()
+        assert st["ingest_blocks"] == 6
+        assert st["ingest_duplicate_blocks"] == 0
+        assert st["ingest_host_seq"] == {"h0": 6}
+        # delivered exactly once each, in seq order
+        for i in range(6):
+            np.testing.assert_array_equal(replay.items[i][0].obs,
+                                          mk_block(i).obs)
+    finally:
+        pub2.stop(flush_deadline_s=1.0)
+        svc.stop()
+
+
+def test_dead_peer_reaped_and_mark_survives():
+    """A host silent past transport_dead_peer_s is reaped; its seq
+    high-water mark survives for the next reconnect."""
+    cfg = _cfg(transport_dead_peer_s=0.4, transport_heartbeat_s=0.1)
+    replay = FakeReplay()
+    svc = IngestService(cfg, replay, version_source=None)
+    svc.start()
+    # a hand-rolled host that handshakes, ships one block, then goes
+    # SILENT without closing (a wedged process, not a clean disconnect)
+    sock = socket.create_connection(("127.0.0.1", svc.port), timeout=2.0)
+    try:
+        framing.send_frame(sock, framing.HELLO, framing.encode_json(
+            {"proto": framing.PROTO_VERSION, "host": "h0", "next_seq": 1}
+        ))
+        sock.settimeout(2.0)
+        ftype, _ = framing.recv_frame(sock)
+        assert ftype == framing.HELLO_ACK
+        framing.send_frame(sock, framing.BLOCK, framing.encode_block(
+            mk_block(0), np.ones((1,), np.float32), None, seq=1,
+            t_serve=time.time(),
+        ))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if svc.stats()["ingest_dead_peers"] >= 1:
+                break
+            time.sleep(0.05)
+        st = svc.stats()
+        assert st["ingest_blocks"] == 1
+        assert st["ingest_dead_peers"] >= 1
+        assert st["ingest_connected_hosts"] == 0
+        assert st["ingest_host_seq"] == {"h0": 1}  # the mark survives
+    finally:
+        sock.close()
+        svc.stop()
+
+
+def test_protocol_version_mismatch_rejected():
+    cfg = _cfg()
+    svc = IngestService(cfg, FakeReplay(), version_source=None)
+    svc.start()
+    try:
+        sock = socket.create_connection(("127.0.0.1", svc.port), timeout=2.0)
+        try:
+            framing.send_frame(sock, framing.HELLO, framing.encode_json(
+                {"proto": framing.PROTO_VERSION + 1, "host": "hX",
+                 "next_seq": 1}
+            ))
+            sock.settimeout(2.0)
+            # the service drops the connection instead of answering
+            with pytest.raises((ConnectionError, socket.timeout, OSError)):
+                framing.recv_frame(sock)
+        finally:
+            sock.close()
+    finally:
+        svc.stop()
