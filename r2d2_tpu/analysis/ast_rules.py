@@ -87,6 +87,14 @@ Rule catalog (ids, severities — the table in ARCHITECTURE.md mirrors this):
   through the version shim (check_rep/auto vs check_vma/axis_names), and
   the manual tp×fsdp train step depends on the shim's axis_names=None ->
   fully-manual defaulting.
+- codec-decode-in-hot-loop (warning) a block-codec decode
+  (`decode_field` / `decode_block` / `read_block`) or an mmap page-in
+  (`np.memmap` / `mmap.mmap`) inside a for/while body in the learner
+  hot-path modules or serve/*: the disk replay tier's contract is that
+  decompression and first-touch page faults happen on the replay staging
+  thread (tiered_store._fill_disk_rows), never on the learner or serve
+  step — one zlib inflate per iteration there erases the overlap the
+  three-tier design buys.
 """
 
 from __future__ import annotations
@@ -112,6 +120,7 @@ ALL_RULES = (
     "lock-discipline",
     "host-tree-in-hot-loop",
     "raw-shard-map-import",
+    "codec-decode-in-hot-loop",
 )
 
 # hot-path modules for the host-sync rule: the learner/collection dispatch
@@ -930,6 +939,59 @@ def _rule_raw_shard_map_import(tree: ast.Module, path: str) -> List[Finding]:
     return out
 
 
+# block-codec decode / disk page-in surface (replay/codec.py +
+# replay/disk_tier.py). Method-style receivers (x.decode_field(...)) and
+# bare names (decode_field(...)) both match: the contract is positional
+# ("not on the learner/serve step"), not receiver-typed.
+_DECODE_CALL_NAMES = {"decode_field", "decode_block", "read_block"}
+_MMAP_CALLS = {"np.memmap", "numpy.memmap", "mmap.mmap"}
+
+
+def _rule_codec_decode_in_hot_loop(tree: ast.AST, path: str) -> List[Finding]:
+    if not (is_hot_path(path) or is_serve_path(path)):
+        return []
+    out: List[Finding] = []
+    seen: Set[Tuple[int, int]] = set()
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for stmt in list(loop.body) + list(loop.orelse):
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func) or ""
+                last = d.split(".")[-1]
+                if d in _MMAP_CALLS:
+                    what = f"{d}(...)"
+                elif last in _DECODE_CALL_NAMES:
+                    what = f"{d or last}(...)"
+                else:
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(
+                    Finding(
+                        rule="codec-decode-in-hot-loop",
+                        severity="warning",
+                        path=path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=f"{what} inside a hot-loop body: block-codec "
+                        "inflate / mmap page-in belongs on the replay "
+                        "staging thread (tiered_store._fill_disk_rows), not "
+                        "the learner/serve step — a per-iteration decode "
+                        "erases the three-tier overlap",
+                        hint="sample through TieredReplayBuffer (the staging "
+                        "thread decodes behind the prefetch queue), or mark "
+                        "a deliberate cold-path decode with "
+                        "`# r2d2: disable=codec-decode-in-hot-loop`",
+                    )
+                )
+    return out
+
+
 _RULES = (
     _rule_host_sync,
     _rule_serve_step_host_sync,
@@ -942,6 +1004,7 @@ _RULES = (
     _rule_lock_discipline,
     _rule_host_tree_in_hot_loop,
     _rule_raw_shard_map_import,
+    _rule_codec_decode_in_hot_loop,
 )
 
 

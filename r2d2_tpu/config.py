@@ -329,6 +329,34 @@ class R2D2Config:
     # loop wraps attempts in jittered backoff on top of this).
     transport_connect_timeout_s: float = 5.0
 
+    # Replay disk tier (replay/disk_tier.py): memory-mapped fixed-geometry
+    # segment files below the host slab in the tiered plane. Default off
+    # (capacity 0) keeps every existing plane byte-identical — no segment
+    # file is ever opened, the control plane keeps its host-only tree, and
+    # the pointer-window staleness mask is untouched. With a capacity, the
+    # host slab never evicts on wrap: the sum-tree plane picks the
+    # LOWEST-priority resident block as the demotion victim and spills it
+    # to a segment record; its leaves stay live in the (extended) tree so
+    # demoted sequences remain sampleable — the staging thread pages them
+    # in through the mmap, hidden behind the H2D double buffer. True
+    # eviction only happens when the disk tier itself wraps.
+    #
+    # Capacity is in transitions (like buffer_capacity) and must be a
+    # multiple of block_length; the tier requires replay_plane="tiered"
+    # (the only plane with an off-critical-path staging thread to decode
+    # on) and a non-empty directory.
+    replay_disk_dir: str = ""
+    replay_disk_capacity: int = 0
+    # Block codec (replay/codec.py): "none" (default — wire frames, spool
+    # entries, and segment records all byte-compatible with pre-codec
+    # binaries) or "delta-zlib" (delta-along-time + deflate on the uint8
+    # obs field; every other field rides raw). Applies to disk segment
+    # records, the publisher's on-disk spool, and BLOCK wire frames — the
+    # wire half is negotiated per connection over HELLO, so a new
+    # publisher facing an old ingest service transparently falls back to
+    # raw frames (and vice versa).
+    block_codec: str = "none"
+
     # Fused-sequence training semantics for the LSTM core: the T-step
     # unroll treats each row's burn-in prefix as state-refresh only — a
     # stop-gradient seam at burn_in[b] cuts the backward pass so burn-in
@@ -857,6 +885,28 @@ class R2D2Config:
                 "transport_heartbeat_s (with headroom) or healthy idle "
                 "hosts flap"
             )
+        if self.block_codec not in ("none", "delta-zlib"):
+            raise ValueError(f"unknown block_codec {self.block_codec!r}")
+        if self.replay_disk_capacity < 0:
+            raise ValueError("replay_disk_capacity must be >= 0")
+        if self.replay_disk_capacity > 0:
+            if not self.replay_disk_dir:
+                raise ValueError(
+                    "replay_disk_capacity needs replay_disk_dir: the disk "
+                    "tier's segment files must live somewhere"
+                )
+            if self.replay_disk_capacity % self.block_length != 0:
+                raise ValueError(
+                    "replay_disk_capacity must be a multiple of "
+                    "block_length (the disk tier holds whole blocks)"
+                )
+            if self.replay_plane != "tiered":
+                raise ValueError(
+                    "the replay disk tier hangs below the tiered plane's "
+                    "host slab (its staging thread is where demoted rows "
+                    "are paged in + decoded); set replay_plane='tiered' "
+                    "or replay_disk_capacity=0"
+                )
         if self.lstm_backend not in ("auto", "scan", "pallas"):
             raise ValueError(f"unknown lstm_backend {self.lstm_backend!r}")
         if self.recurrent_core not in ("lstm", "lru"):

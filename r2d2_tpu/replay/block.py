@@ -83,3 +83,31 @@ def store_field_specs(cfg):
         # contract over it) is byte-identical to before
         {"task": ((S,), np.int32)} if cfg.num_tasks > 1 else {}
     )
+
+
+# The per-step fields a demoted block carries in its disk-segment record,
+# in record order (replay/disk_tier.py walks them to size and parse the
+# fixed-geometry slots). The small per-sequence metadata (hidden carries,
+# burn_in/learning/forward spans, task id) stays RAM-resident for disk
+# slots — the control plane needs it to keep demoted sequences sampleable
+# without touching the segment, and it is a rounding error next to the
+# per-step planes the record actually holds.
+DISK_FIELDS = (
+    "obs", "last_action", "last_reward", "action", "n_step_reward", "gamma",
+)
+
+
+def disk_field_specs(cfg):
+    """Per-slot (shape, dtype) of every disk-segment record field, in
+    DISK_FIELDS order. Dtypes mirror the HOST slab (uint8 scalar actions,
+    replay_buffer.py), not the device-store int32 layout above — the disk
+    tier spills host rows and must round-trip them bit-exactly."""
+    slot, bl = cfg.block_slot_len, cfg.block_length
+    return {
+        "obs": ((slot, *cfg.obs_shape), np.uint8),
+        "last_action": ((slot,), np.uint8),
+        "last_reward": ((slot,), np.float32),
+        "action": ((bl,), np.uint8),
+        "n_step_reward": ((bl,), np.float32),
+        "gamma": ((bl,), np.float32),
+    }

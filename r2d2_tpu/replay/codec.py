@@ -1,0 +1,165 @@
+"""Per-field block codec: delta-then-deflate for uint8 obs planes.
+
+The replay data plane crosses four boundaries as raw arrays — tap ->
+bridge -> store -> H2D staging, plus the pod-loop socket hop — and obs
+dominate every one of them (~7 KB/transition at 84x84 uint8 against a
+few hundred bytes of carries and scalars). Game frames are temporally
+redundant: consecutive frames differ in a handful of pixels, so a delta
+along the time axis turns near-identical rows into near-zero rows, and a
+fast LZ-class entropy pass (zlib level 1 — the stdlib's LZ77, chosen
+over lz4/snappy because the container must not grow dependencies)
+collapses them. Carries are already bf16 (precision="bf16" halves them
+at the store) and float rewards are incompressible noise at these sizes,
+so only uint8 fields are ever transformed; everything else rides RAW.
+
+Encoded field layout (the "tiny header" shared by disk segments, the
+transport spool, and BLOCK wire frames):
+
+    method   1 byte   RAW=0 | DELTA_ZLIB=1
+    dtype    1 byte   index into _DTYPES
+    ndim     1 byte
+    dims     ndim x 4 bytes  big-endian u32
+    length   4 bytes  big-endian u32 payload byte count
+    payload  `length` bytes
+
+Worst-case guarantee: encode_field output NEVER exceeds the raw array
+bytes plus this header — a DELTA_ZLIB attempt that fails to shrink the
+field (already-random obs) is discarded and the field ships RAW, so
+fixed-geometry consumers (disk_tier's record slots) can size once from
+`encoded_max_len` and every possible encoding fits.
+
+Decode runs on staging/ingest threads only, NEVER the learner hot loop —
+the codec-decode-in-hot-loop lint (analysis/ast_rules.py) enforces it
+statically, and `fault_point("codec.decode")` makes every decode a chaos
+boundary: a kill mid-decode must leave replay bit-identical on resume.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Tuple
+
+import numpy as np
+
+from r2d2_tpu.utils.faults import fault_point
+
+# codec knob values (config.block_codec); "none" disables every transform
+# so the default wire/spool/segment bytes stay byte-identical to pre-codec
+CODECS = ("none", "delta-zlib")
+
+RAW = 0
+DELTA_ZLIB = 1
+
+# zlib level 1: the speed/ratio point where encode stays cheap enough for
+# the publisher's producer thread (level 6+ costs 3-4x encode time for
+# ~10% extra ratio on frame deltas)
+_ZLIB_LEVEL = 1
+
+_DTYPES = (
+    np.dtype(np.uint8), np.dtype(np.int8), np.dtype(np.uint16),
+    np.dtype(np.int32), np.dtype(np.int64),
+    np.dtype(np.float32), np.dtype(np.float64),
+)
+_DTYPE_CODE = {dt: i for i, dt in enumerate(_DTYPES)}
+
+_FIXED = struct.Struct(">BBB")  # method, dtype code, ndim
+_DIM = struct.Struct(">I")
+_LEN = struct.Struct(">I")
+
+
+class CodecError(ValueError):
+    """Corrupt or foreign encoded-field bytes (bad method/dtype code,
+    truncated payload, deflate error). ValueError so container layers
+    (framing.FrameError, spool load) can classify it as payload damage."""
+
+
+def header_len(ndim: int) -> int:
+    return _FIXED.size + ndim * _DIM.size + _LEN.size
+
+
+def encoded_max_len(shape: Tuple[int, ...], dtype) -> int:
+    """Hard upper bound on encode_field output for a field of this
+    geometry — raw bytes + header, the fixed-slot size disk segments
+    allocate per field."""
+    nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return header_len(len(shape)) + nbytes
+
+
+def _delta_u8(arr: np.ndarray) -> np.ndarray:
+    """Wrapping first-difference along axis 0 (uint8 modular arithmetic —
+    exactly invertible by a modular cumsum)."""
+    d = arr.copy()
+    if arr.shape[0] > 1:
+        d[1:] = arr[1:] - arr[:-1]
+    return d
+
+
+def encode_field(arr: np.ndarray, codec: str = "delta-zlib") -> bytes:
+    """One array -> self-describing encoded bytes.
+
+    DELTA_ZLIB is attempted only for uint8 arrays under a compressing
+    codec; any attempt that does not beat RAW is thrown away, so the
+    output length never exceeds encoded_max_len(arr.shape, arr.dtype)."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in _DTYPE_CODE:
+        raise CodecError(f"codec does not carry dtype {arr.dtype}")
+    method, payload = RAW, arr.tobytes()
+    if codec == "delta-zlib" and arr.dtype == np.uint8 and arr.size:
+        comp = zlib.compress(_delta_u8(arr).tobytes(), _ZLIB_LEVEL)
+        if len(comp) < len(payload):
+            method, payload = DELTA_ZLIB, comp
+    parts = [_FIXED.pack(method, _DTYPE_CODE[arr.dtype], arr.ndim)]
+    parts += [_DIM.pack(d) for d in arr.shape]
+    parts.append(_LEN.pack(len(payload)))
+    parts.append(payload)
+    return b"".join(parts)
+
+
+def decode_field(buf, offset: int = 0) -> Tuple[np.ndarray, int]:
+    """Inverse of encode_field. Returns (array, end offset) so callers
+    can walk concatenated fields. Raises CodecError on damage.
+
+    Runs on staging/ingest threads only (see module docstring)."""
+    fault_point("codec.decode")
+    buf = memoryview(buf)
+    try:
+        method, dcode, ndim = _FIXED.unpack_from(buf, offset)
+    except struct.error as e:
+        raise CodecError(f"truncated field header: {e}") from e
+    if method not in (RAW, DELTA_ZLIB):
+        raise CodecError(f"unknown codec method {method}")
+    if dcode >= len(_DTYPES):
+        raise CodecError(f"unknown dtype code {dcode}")
+    pos = offset + _FIXED.size
+    try:
+        shape = tuple(
+            _DIM.unpack_from(buf, pos + i * _DIM.size)[0] for i in range(ndim)
+        )
+        pos += ndim * _DIM.size
+        (length,) = _LEN.unpack_from(buf, pos)
+        pos += _LEN.size
+    except struct.error as e:
+        raise CodecError(f"truncated field header: {e}") from e
+    end = pos + length
+    if end > len(buf):
+        raise CodecError("truncated field payload")
+    payload = buf[pos:end]
+    dtype = _DTYPES[dcode]
+    expect = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    if method == RAW:
+        if length != expect:
+            raise CodecError(f"raw field length {length} != {expect}")
+        arr = np.frombuffer(payload, dtype).reshape(shape).copy()
+    else:
+        try:
+            raw = zlib.decompress(bytes(payload))
+        except zlib.error as e:
+            raise CodecError(f"deflate damage: {e}") from e
+        if len(raw) != expect:
+            raise CodecError(f"inflated length {len(raw)} != {expect}")
+        arr = np.frombuffer(raw, dtype).reshape(shape).copy()
+        if arr.shape[0] > 1:
+            # modular cumsum undoes the wrapping delta exactly
+            np.add.accumulate(arr, axis=0, dtype=np.uint8, out=arr)
+    return arr, end

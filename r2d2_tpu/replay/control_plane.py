@@ -69,6 +69,16 @@ class ReplayControlPlane:
         self.learning_sum = np.zeros(cfg.num_blocks, np.int64)
         self.occupied = np.zeros(cfg.num_blocks, bool)
         self.num_seq_store = np.zeros(cfg.num_blocks, np.int32)
+        # Disk-tier mode only (TieredReplayBuffer allocates it, sized
+        # host+disk blocks): per-slot last-mutation stamp in ptr_advances
+        # clock units. The pointer-window staleness mask below assumes
+        # slots are overwritten in ring order; priority-aware demotion
+        # moves block contents between ARBITRARY slots, so in disk mode
+        # every mutation (write, demote, retire) stamps its slot and
+        # update_priorities compares stamps instead of windows. None on
+        # every non-disk plane — the window mask and its exact byte
+        # behavior are untouched.
+        self.slot_stamp = None
         # priority_plane="device": an HBM float32 mirror of the tree
         # (replay/device_sum_tree.DeviceSumTree) attached by the owning
         # data plane. Every host-side tree write goes through _tree_write,
@@ -139,6 +149,8 @@ class ReplayControlPlane:
         )
         self.block_ptr = (ptr + 1) % self.cfg.num_blocks
         self.ptr_advances += 1
+        if self.slot_stamp is not None:
+            self.slot_stamp[ptr] = self.ptr_advances
         return ptr
 
     def _account_blocks(
@@ -174,6 +186,12 @@ class ReplayControlPlane:
             self.learning_sum[occ] = 0
             self.occupied[occ] = False
             self.num_seq_store[occ] = 0
+        if self.slot_stamp is not None and slots.size:
+            # disk mode: retirement is a mutation like any other — bump
+            # the clock once and stamp so in-flight priority write-backs
+            # for these slots are rejected by the stamp comparison
+            self.ptr_advances += 1
+            self.slot_stamp[slots] = self.ptr_advances
 
     def _reserve_contiguous(self, n: int) -> int:
         """Wrap the ring pointer to 0 if fewer than n slots remain before
@@ -261,6 +279,16 @@ class ReplayControlPlane:
         window-mask-only behavior (the reference's own guarantee)."""
         S = self.cfg.seqs_per_block
         with self.lock:
+            if self.slot_stamp is not None and old_advances is not None:
+                # Disk mode: demotion moves blocks between arbitrary slots,
+                # so ring-window reasoning is void. A per-slot stamp gives
+                # the EXACT verdict: keep an index iff its slot has not
+                # mutated since the draw. (The full-lap check below would
+                # also misfire here — demotions bump ptr_advances without
+                # overwriting every slot.)
+                mask = self.slot_stamp[idxes // S] <= old_advances
+                self._tree_write(idxes[mask], td_errors[mask])
+                return
             if (
                 old_advances is not None
                 and self.ptr_advances - old_advances >= self.cfg.num_blocks

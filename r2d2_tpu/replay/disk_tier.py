@@ -1,0 +1,181 @@
+"""Memory-mapped disk tier below the host replay slab.
+
+The third storage tier (HBM staging / host slab / THIS): fixed-geometry
+segment files holding demoted blocks' per-step fields, encoded by
+replay/codec.py. TieredReplayBuffer owns the policy — priority-aware victim
+choice, control-plane accounting, decode caching — this module owns only
+the bytes-on-disk mechanism, mirroring how tiered_store.py splits staging
+policy from the host slab.
+
+Geometry
+--------
+A record is one demoted block:
+
+    directory   len(DISK_FIELDS) x u32   encoded byte length per field
+    fields      concatenated encode_field outputs, DISK_FIELDS order
+    slack       up to record_size, untouched
+
+Every record slot is `record_size` bytes = directory + the codec's
+worst-case bound per field (codec.encoded_max_len — encode_field output can
+NEVER exceed it, so any encoding fits any slot and a record rewrite never
+shifts its neighbors). Records pack `seg_blocks` to a segment file
+`seg_{k:06d}.dat`; segments are created lazily on first write (np.memmap
+"w+") so a mostly-empty disk tier costs only the slots actually demoted —
+the same lazy-page discipline tiered_store uses for HBM staging slabs.
+
+Crash ordering: `fault_point("disk.write")` fires BEFORE the record bytes
+land, so a kill there leaves a slot whose directory still describes the
+PREVIOUS record — and the caller's retire-then-write-then-account protocol
+guarantees nothing references the slot yet. Page-in passes
+`fault_point("disk.promote")` then decodes on the staging thread.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict
+
+import numpy as np
+
+from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.replay import codec
+from r2d2_tpu.replay.block import DISK_FIELDS, disk_field_specs
+from r2d2_tpu.utils.faults import fault_point
+
+# records per segment file: small enough that a lazily-created segment is
+# cheap, large enough that a populated tier is a handful of mmaps
+SEG_BLOCKS = 64
+
+
+class DiskTier:
+    def __init__(self, cfg: R2D2Config):
+        self.cfg = cfg
+        self.dir = cfg.replay_disk_dir
+        self.disk_blocks = cfg.replay_disk_capacity // cfg.block_length
+        self.codec = cfg.block_codec
+        self.specs = disk_field_specs(cfg)
+        self._dir_struct = struct.Struct(f">{len(DISK_FIELDS)}I")
+        self._field_max = {
+            name: codec.encoded_max_len(shape, dt)
+            for name, (shape, dt) in self.specs.items()
+        }
+        self.record_size = self._dir_struct.size + sum(self._field_max.values())
+        self.seg_blocks = min(self.disk_blocks, SEG_BLOCKS)
+        self._maps: Dict[int, np.memmap] = {}
+        os.makedirs(self.dir, exist_ok=True)
+        # counters (read under the owning buffer's lock via stats())
+        self.writes = 0
+        self.reads = 0
+        self.bytes_raw = 0   # pre-codec bytes of every record written
+        self.bytes_enc = 0   # encoded bytes actually written
+
+    # -------------------------------------------------------------- segments
+
+    def _segment_path(self, k: int) -> str:
+        return os.path.join(self.dir, f"seg_{k:06d}.dat")
+
+    def _segment(self, k: int) -> np.memmap:
+        mm = self._maps.get(k)
+        if mm is None:
+            path = self._segment_path(k)
+            size = self.seg_blocks * self.record_size
+            mode = "r+" if (
+                os.path.exists(path) and os.path.getsize(path) == size
+            ) else "w+"
+            mm = np.memmap(path, dtype=np.uint8, mode=mode, shape=(size,))
+            self._maps[k] = mm
+        return mm
+
+    def _locate(self, slot: int):
+        if not (0 <= slot < self.disk_blocks):
+            raise IndexError(f"disk slot {slot} out of range")
+        return self._segment(slot // self.seg_blocks), (
+            slot % self.seg_blocks
+        ) * self.record_size
+
+    # --------------------------------------------------------------- records
+
+    def write_block(self, slot: int, fields: Dict[str, np.ndarray]) -> None:
+        """Encode and write one demoted block's per-step fields into record
+        slot `slot`. Fields must match disk_field_specs geometry (the host
+        slab rows do by construction)."""
+        lengths, payloads, raw = [], [], 0
+        for name in DISK_FIELDS:
+            shape, dt = self.specs[name]
+            arr = np.ascontiguousarray(fields[name], dtype=dt).reshape(shape)
+            enc = codec.encode_field(arr, self.codec)
+            if len(enc) > self._field_max[name]:  # encode_field guarantees not
+                raise codec.CodecError(f"{name} encoding exceeds record slot")
+            lengths.append(len(enc))
+            payloads.append(enc)
+            raw += arr.nbytes
+        buf = self._dir_struct.pack(*lengths) + b"".join(payloads)
+        # a kill here (or mid-mmap-write) must leave replay consistent: the
+        # caller has already retired whatever this slot held, and accounts
+        # the new occupant only after we return
+        fault_point("disk.write")
+        mm, off = self._locate(slot)
+        mm[off : off + len(buf)] = np.frombuffer(buf, np.uint8)
+        self.writes += 1
+        self.bytes_raw += raw
+        self.bytes_enc += len(buf)
+
+    def read_block(self, slot: int) -> Dict[str, np.ndarray]:
+        """Page in and decode record slot `slot`. Staging/ingest threads
+        only — never the learner hot loop (codec-decode-in-hot-loop lint)."""
+        fault_point("disk.promote")
+        mm, off = self._locate(slot)
+        lengths = self._dir_struct.unpack(
+            bytes(mm[off : off + self._dir_struct.size])
+        )
+        pos = off + self._dir_struct.size
+        out = {}
+        view = memoryview(mm)
+        for name, ln in zip(DISK_FIELDS, lengths):
+            arr, end = codec.decode_field(view, pos)
+            if end - pos != ln:
+                raise codec.CodecError(
+                    f"{name} record length {end - pos} != directory {ln}"
+                )
+            out[name] = arr
+            pos = end
+        self.reads += 1
+        return out
+
+    # ------------------------------------------------- snapshot raw transfer
+
+    def record_bytes(self, slot: int) -> np.ndarray:
+        """The used bytes of record `slot` (directory + encoded fields),
+        verbatim — snapshots embed these so --resume rewrites segments
+        bit-exactly without a decode/re-encode round trip."""
+        mm, off = self._locate(slot)
+        lengths = self._dir_struct.unpack(
+            bytes(mm[off : off + self._dir_struct.size])
+        )
+        used = self._dir_struct.size + sum(lengths)
+        return np.array(mm[off : off + used])
+
+    def write_record_bytes(self, slot: int, buf: np.ndarray) -> None:
+        """Inverse of record_bytes: restore a record's raw bytes."""
+        buf = np.asarray(buf, dtype=np.uint8)
+        if len(buf) > self.record_size:
+            raise codec.CodecError("record bytes exceed slot geometry")
+        mm, off = self._locate(slot)
+        mm[off : off + len(buf)] = buf
+
+    def flush(self) -> None:
+        for mm in self._maps.values():
+            mm.flush()
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "disk_blocks": self.disk_blocks,
+            "disk_writes": self.writes,
+            "disk_reads": self.reads,
+            "disk_bytes_raw": self.bytes_raw,
+            "disk_bytes_enc": self.bytes_enc,
+            "disk_codec_ratio": (
+                self.bytes_raw / self.bytes_enc if self.bytes_enc else 0.0
+            ),
+        }

@@ -82,36 +82,43 @@ class ReplayBuffer(ReplayControlPlane):
 
     # ------------------------------------------------------------------ add
 
+    def _write_block_locked(self, block: Block, ptr: int) -> None:
+        """Write one block's data-plane fields into slab slot `ptr`.
+        Caller holds self.lock and owns the accounting that follows.
+        (Factored so the tiered store's disk-demotion overrides can reuse
+        the exact slab-write byte behavior without re-entering the lock —
+        threading.Lock is not reentrant.)"""
+        S = self.cfg.seqs_per_block
+        steps = block.stored_steps
+        self.obs_store[ptr, :steps] = block.obs
+        self.last_action_store[ptr, :steps] = block.last_action
+        self.last_reward_store[ptr, :steps] = block.last_reward
+        T = len(block.action)
+        self.action_store[ptr, :T] = block.action
+        self.n_step_reward_store[ptr, :T] = block.n_step_reward
+        self.gamma_store[ptr, :T] = block.gamma
+        ns = block.num_sequences
+        self.hidden_store[ptr, :ns] = block.hidden
+        self.burn_in_store[ptr, :S] = 0
+        self.learning_store[ptr, :S] = 0
+        self.forward_store[ptr, :S] = 0
+        self.burn_in_store[ptr, :ns] = block.burn_in_steps
+        self.learning_store[ptr, :ns] = block.learning_steps
+        self.forward_store[ptr, :ns] = block.forward_steps
+        self.task_store[ptr] = block.task
+
     def add_block(
         self, block: Block, priorities: np.ndarray, episode_reward: Optional[float]
     ) -> None:
         """Write one block into the circular store and refresh its leaves
         (reference worker.py:178-208). `priorities` must already be padded
         to seqs_per_block (zeros for absent sequences)."""
-        S = self.cfg.seqs_per_block
         with self.lock:
             # data writes FIRST, accounting last: a malformed block (flaky
             # env shapes) raises here before the tree/pointer mutate, so a
             # supervised-restart run can never train on a slot whose
             # priorities describe data that was never written
-            ptr = self.block_ptr
-            steps = block.stored_steps
-            self.obs_store[ptr, :steps] = block.obs
-            self.last_action_store[ptr, :steps] = block.last_action
-            self.last_reward_store[ptr, :steps] = block.last_reward
-            T = len(block.action)
-            self.action_store[ptr, :T] = block.action
-            self.n_step_reward_store[ptr, :T] = block.n_step_reward
-            self.gamma_store[ptr, :T] = block.gamma
-            ns = block.num_sequences
-            self.hidden_store[ptr, :ns] = block.hidden
-            self.burn_in_store[ptr, :S] = 0
-            self.learning_store[ptr, :S] = 0
-            self.forward_store[ptr, :S] = 0
-            self.burn_in_store[ptr, :ns] = block.burn_in_steps
-            self.learning_store[ptr, :ns] = block.learning_steps
-            self.forward_store[ptr, :ns] = block.forward_steps
-            self.task_store[ptr] = block.task
+            self._write_block_locked(block, self.block_ptr)
             self._account_add(
                 block.num_sequences, int(block.learning_steps.sum()), priorities, episode_reward
             )
@@ -125,25 +132,7 @@ class ReplayBuffer(ReplayControlPlane):
         add_block per item, in order."""
         with self.lock:
             for block, priorities, episode_reward in items:
-                S = self.cfg.seqs_per_block
-                ptr = self.block_ptr
-                steps = block.stored_steps
-                self.obs_store[ptr, :steps] = block.obs
-                self.last_action_store[ptr, :steps] = block.last_action
-                self.last_reward_store[ptr, :steps] = block.last_reward
-                T = len(block.action)
-                self.action_store[ptr, :T] = block.action
-                self.n_step_reward_store[ptr, :T] = block.n_step_reward
-                self.gamma_store[ptr, :T] = block.gamma
-                ns = block.num_sequences
-                self.hidden_store[ptr, :ns] = block.hidden
-                self.burn_in_store[ptr, :S] = 0
-                self.learning_store[ptr, :S] = 0
-                self.forward_store[ptr, :S] = 0
-                self.burn_in_store[ptr, :ns] = block.burn_in_steps
-                self.learning_store[ptr, :ns] = block.learning_steps
-                self.forward_store[ptr, :ns] = block.forward_steps
-                self.task_store[ptr] = block.task
+                self._write_block_locked(block, self.block_ptr)
                 self._account_add(
                     block.num_sequences, int(block.learning_steps.sum()),
                     priorities, episode_reward,

@@ -56,6 +56,7 @@ from __future__ import annotations
 import glob
 import os
 import re
+import struct
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -114,6 +115,36 @@ def _read_shard(d, prefix: str, store_prefix: str) -> Dict:
     return out
 
 
+def _flatten_disk_tier(d, shard: Dict) -> None:
+    """Host snapshots with a disk tier (PR 19) hold two row ranges: slab
+    rows in store_* and demoted blocks as encoded segment-record bytes.
+    Resharding FLATTENS the hierarchy — decode each record and append its
+    per-step rows to the stores, so every later phase sees one plain host
+    plane whose store row count matches the (already-extended) occupancy
+    arrays. Unoccupied disk slots append zero rows, mirroring an
+    unoccupied slab slot."""
+    from r2d2_tpu.replay import codec
+    from r2d2_tpu.replay.block import DISK_FIELDS
+
+    db = int(d["disk_blocks"][()])
+    if db <= 0:
+        return
+    stores = shard["stores"]
+    ext = {
+        k: np.zeros((db, *stores[k].shape[1:]), stores[k].dtype)
+        for k in DISK_FIELDS
+    }
+    dir_size = struct.calcsize(f">{len(DISK_FIELDS)}I")
+    for i in np.asarray(d["disk_occupied_slots"], np.int64):
+        buf = np.asarray(d[f"disk_rec_{int(i)}"], np.uint8).tobytes()
+        pos = dir_size  # field payloads are self-describing past the directory
+        for name in DISK_FIELDS:
+            arr, pos = codec.decode_field(buf, pos)
+            ext[name][int(i)] = arr
+    for k in DISK_FIELDS:
+        stores[k] = np.concatenate([stores[k], ext[k]], axis=0)
+
+
 def gather_logical(paths: List[str]) -> Tuple[Dict, Dict[int, Dict], Dict]:
     """Phase 1: read every snapshot file and reassemble the LOGICAL replay.
 
@@ -137,6 +168,8 @@ def gather_logical(paths: List[str]) -> Tuple[Dict, Dict[int, Dict], Dict]:
             file_shards: Dict[int, Dict] = {}
             if kind in ("host", "device"):
                 file_shards[0] = _read_shard(d, "", "store_")
+                if kind == "host" and "disk_blocks" in d.files:
+                    _flatten_disk_tier(d, file_shards[0])
                 dp = 1
             elif kind == "sharded":
                 dp = (

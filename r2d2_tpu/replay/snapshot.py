@@ -149,6 +149,11 @@ def snapshot_topology(replay, tp: int = 1) -> Dict[str, np.ndarray]:
         "rng_streams": np.asarray(local_ids, np.int64),
         "rng_seed": np.asarray(seed, np.int64),
         "rng_epoch": np.asarray(epoch, np.int64),
+        # disk tier below the host slab (0 = no tier): reshard's
+        # gather_logical flattens these records into plain store rows
+        "disk_blocks": np.asarray(
+            getattr(getattr(replay, "disk", None), "disk_blocks", 0), np.int64
+        ),
     }
 
 
@@ -357,6 +362,20 @@ def save_replay(
                 # copy under the lock: np.savez runs after release, and the
                 # live stores keep mutating under collection threads
                 payload["store_" + k] = getattr(replay, k + "_store").copy()
+            disk = getattr(replay, "disk", None)
+            if disk is not None:
+                # disk tier manifest: occupied records ride VERBATIM as
+                # their encoded segment bytes (no decode/re-encode round
+                # trip), so --resume rewrites segments bit-exactly — and a
+                # torn segment left by a kill mid-demotion is healed by the
+                # rewrite rather than trusted
+                payload["disk_blocks"] = np.asarray(disk.disk_blocks, np.int64)
+                payload["disk_ptr"] = np.asarray(replay._disk_ptr, np.int64)
+                payload["slot_stamp"] = replay.slot_stamp.copy()
+                occ = np.nonzero(replay.occupied[replay.cfg.num_blocks:])[0]
+                payload["disk_occupied_slots"] = occ.astype(np.int64)
+                for i in occ:
+                    payload[f"disk_rec_{int(i)}"] = disk.record_bytes(int(i))
     else:
         raise TypeError(f"unknown replay type {type(replay).__name__}")
     for k, v in (extra or {}).items():
@@ -462,9 +481,26 @@ def restore_replay(replay, path: str) -> Dict[str, np.ndarray]:
                 vals = _validated_stores(d, current)
                 if len(d["tree_leaves"]) != replay.tree.capacity:
                     raise ValueError("tree size mismatch")
+                disk = getattr(replay, "disk", None)
+                saved_db = (
+                    int(d["disk_blocks"][()]) if "disk_blocks" in d.files else 0
+                )
+                live_db = disk.disk_blocks if disk is not None else 0
+                if saved_db != live_db:
+                    raise ValueError(
+                        f"disk tier mismatch: snapshot holds {saved_db} disk "
+                        f"blocks, replay configured for {live_db}"
+                    )
                 _restore_plane(replay, d)
                 for k in STORE_FIELDS:
                     current[k][:] = vals[k]
+                if disk is not None:
+                    replay._disk_ptr = int(d["disk_ptr"][()])
+                    replay.slot_stamp[:] = d["slot_stamp"]
+                    replay._disk_cache.clear()
+                    for i in d["disk_occupied_slots"]:
+                        disk.write_record_bytes(int(i), d[f"disk_rec_{int(i)}"])
+                    disk.flush()
         else:
             raise TypeError(f"unknown replay type {type(replay).__name__}")
     return extras

@@ -104,6 +104,22 @@ class SumTree:
     def priorities_of(self, idxes: np.ndarray) -> np.ndarray:
         return self.tree[np.asarray(idxes, dtype=np.int64) + self.leaf_offset]
 
+    def set_raw(self, idxes: np.ndarray, raw: np.ndarray) -> None:
+        """Set leaves to ALREADY-EXPONENTIATED priorities (as read back by
+        priorities_of/leaves) and resum ancestors. The disk tier uses this
+        to MOVE leaves between slots during demotion — going through
+        update() would re-apply ^alpha to values that already carry it.
+        Mutates self.tree in place, so it composes with the native core
+        (which shares the same array)."""
+        idxes = np.asarray(idxes, dtype=np.int64)
+        if len(idxes) == 0:
+            return
+        nodes = idxes + self.leaf_offset
+        self.tree[nodes] = np.asarray(raw, dtype=np.float64)
+        for _ in range(self.num_layers - 1):
+            nodes = np.unique((nodes - 1) // 2)
+            self.tree[nodes] = self.tree[2 * nodes + 1] + self.tree[2 * nodes + 2]
+
     # ------------------------------------------------------- snapshot support
 
     def leaves(self) -> np.ndarray:

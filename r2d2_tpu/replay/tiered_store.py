@@ -36,6 +36,7 @@ mis-applied.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import queue
@@ -45,8 +46,14 @@ from typing import Optional
 
 import numpy as np
 
-from r2d2_tpu.replay.replay_buffer import ReplayBuffer
+from r2d2_tpu.replay.disk_tier import DiskTier
+from r2d2_tpu.replay.replay_buffer import ReplayBuffer, SampledBatch
+from r2d2_tpu.replay.sum_tree import SumTree
 from r2d2_tpu.utils.faults import fault_point, with_retries
+
+# decoded disk records kept hot on the staging thread: repeated draws of a
+# high-priority demoted block skip the page-in + inflate after the first
+_DISK_CACHE_RECORDS = 64
 
 
 @dataclasses.dataclass
@@ -103,7 +110,237 @@ class TieredReplayBuffer(ReplayBuffer):
     The single-batch `sample_batch` path is inherited untouched — it is the
     executable spec `sample_window_stack` must match bit-for-bit (pinned by
     tests/test_tiered_store.py): same RNG stream consumption (K stratified
-    tree draws in order), same clamp semantics, same dtypes, same stamps."""
+    tree draws in order), same clamp semantics, same dtypes, same stamps.
+
+    Disk tier (cfg.replay_disk_capacity > 0, default OFF = everything above
+    byte-identical): a third storage level below the host slab
+    (replay/disk_tier.py). Logical block ids split in two: [0, num_blocks)
+    live in the host slab, [num_blocks, num_blocks + disk_blocks) in mmap
+    segment records. The control plane covers BOTH ranges — one sum tree,
+    extended occupancy/accounting arrays, and RAM-resident per-sequence
+    metadata (hidden carries, spans, task) for every logical block — so a
+    demoted block's leaves stay live and it samples like any other; only
+    its six per-step fields live on disk, decoded through an LRU cache on
+    the staging thread where the H2D double buffer hides the page-in.
+
+    Demotion is priority-aware, not oldest-first: when the ring pointer
+    lands on an occupied slab slot, the LOWEST-priority occupied host block
+    spills to the disk ring (its slab slot inherits the pointer occupant so
+    the incoming block can land at the pointer, preserving ring-write
+    semantics for every producer); true eviction happens only when the disk
+    ring itself wraps onto a live record. Slot moves void the pointer-window
+    staleness reasoning, so disk mode switches update_priorities to the
+    per-slot stamp clock (control_plane.slot_stamp)."""
+
+    def __init__(self, cfg, native=None):
+        super().__init__(cfg, native=native)
+        self.disk: Optional[DiskTier] = None
+        self._disk_ptr = 0
+        self._demotions = 0
+        self._evictions = 0
+        self._disk_cache: "collections.OrderedDict[int, dict]" = (
+            collections.OrderedDict()
+        )
+        if cfg.replay_disk_capacity <= 0:
+            return
+        self.disk = DiskTier(cfg)
+        nb, S = cfg.num_blocks, cfg.seqs_per_block
+        total = nb + self.disk.disk_blocks
+        # control plane grows to cover disk-resident sequences: leaves for
+        # demoted blocks stay LIVE in the tree (that is what keeps them
+        # sampleable), and the per-sequence metadata stores extend so
+        # sampling coordinates resolve without touching a segment. The
+        # extra leaves start at zero, so draws/IS-weights are bit-identical
+        # to the undecorated tree until something actually demotes.
+        self.tree = SumTree(
+            total * S, cfg.prio_exponent, cfg.is_exponent, native=self.native
+        )
+        self.learning_sum = np.zeros(total, np.int64)
+        self.occupied = np.zeros(total, bool)
+        self.num_seq_store = np.zeros(total, np.int32)
+        self.slot_stamp = np.zeros(total, np.int64)
+        self.hidden_store = np.zeros(
+            (total, S, 2, cfg.hidden_dim), dtype=cfg.state_dtype
+        )
+        self.burn_in_store = np.zeros((total, S), dtype=np.int32)
+        self.learning_store = np.zeros((total, S), dtype=np.int32)
+        self.forward_store = np.zeros((total, S), dtype=np.int32)
+        self.task_store = np.zeros((total,), dtype=np.int32)
+
+    # ------------------------------------------------------- disk-tier spill
+
+    def add_block(self, block, priorities, episode_reward) -> None:
+        if self.disk is None:
+            super().add_block(block, priorities, episode_reward)
+            return
+        with self.lock:
+            if self.occupied[self.block_ptr]:
+                self._spill_lowest(self.block_ptr)
+            self._write_block_locked(block, self.block_ptr)
+            self._account_add(
+                block.num_sequences, int(block.learning_steps.sum()),
+                priorities, episode_reward,
+            )
+
+    def add_blocks_batch(self, items) -> None:
+        if self.disk is None:
+            super().add_blocks_batch(items)
+            return
+        with self.lock:
+            for block, priorities, episode_reward in items:
+                if self.occupied[self.block_ptr]:
+                    self._spill_lowest(self.block_ptr)
+                self._write_block_locked(block, self.block_ptr)
+                self._account_add(
+                    block.num_sequences, int(block.learning_steps.sum()),
+                    priorities, episode_reward,
+                )
+
+    def _spill_lowest(self, ptr: int) -> None:
+        """Demote the lowest-priority occupied host block to the disk ring,
+        leaving slab slot `ptr` free for the incoming block. Caller holds
+        the lock. Crash ordering (chaos-tested at disk.write): retire the
+        disk slot's old occupant FIRST, write the segment record, only then
+        move accounting — a kill at any point leaves every referenced
+        record intact."""
+        cfg = self.cfg
+        nb, S = cfg.num_blocks, cfg.seqs_per_block
+        leaf = self.tree.priorities_of(
+            np.arange(nb * S, dtype=np.int64)
+        ).reshape(nb, S)
+        score = np.where(self.occupied[:nb], leaf.max(axis=1), np.inf)
+        victim = int(np.argmin(score))
+        dslot = self._disk_ptr
+        dl = nb + dslot
+        if self.occupied[dl]:
+            # true eviction: the disk ring wrapped onto a live record
+            self._retire_slots(np.array([dl]))
+            self._evictions += 1
+        self._disk_cache.pop(dslot, None)
+        # segment write (fault_point("disk.write") fires inside, BEFORE the
+        # bytes land): the victim is still fully accounted at its host slot
+        # if the process dies here
+        self.disk.write_block(dslot, {
+            "obs": self.obs_store[victim],
+            "last_action": self.last_action_store[victim],
+            "last_reward": self.last_reward_store[victim],
+            "action": self.action_store[victim],
+            "n_step_reward": self.n_step_reward_store[victim],
+            "gamma": self.gamma_store[victim],
+        })
+        # move the victim's control-plane state to the disk slot. Leaves
+        # move RAW (already ^alpha): tree.update would re-apply the
+        # exponent. No device mirror to sync — priority_plane="device" is
+        # rejected with the disk tier at validate().
+        vidx = np.arange(victim * S, (victim + 1) * S, dtype=np.int64)
+        self.tree.set_raw(
+            np.arange(dl * S, (dl + 1) * S, dtype=np.int64),
+            self.tree.priorities_of(vidx),
+        )
+        self.learning_sum[dl] = self.learning_sum[victim]
+        self.occupied[dl] = True
+        self.num_seq_store[dl] = self.num_seq_store[victim]
+        self.hidden_store[dl] = self.hidden_store[victim]
+        self.burn_in_store[dl] = self.burn_in_store[victim]
+        self.learning_store[dl] = self.learning_store[victim]
+        self.forward_store[dl] = self.forward_store[victim]
+        self.task_store[dl] = self.task_store[victim]
+        if victim != ptr:
+            # ring preservation: the pointer occupant moves into the
+            # victim's freed slab slot so the incoming block lands at the
+            # pointer like every writer assumes
+            for name in ("obs", "last_action", "last_reward", "action",
+                         "n_step_reward", "gamma", "hidden", "burn_in",
+                         "learning", "forward", "task"):
+                store = getattr(self, name + "_store")
+                store[victim] = store[ptr]
+            pidx = np.arange(ptr * S, (ptr + 1) * S, dtype=np.int64)
+            self.tree.set_raw(vidx, self.tree.priorities_of(pidx))
+            self.tree.set_raw(pidx, np.zeros(S))
+            self.learning_sum[victim] = self.learning_sum[ptr]
+            self.num_seq_store[victim] = self.num_seq_store[ptr]
+        else:
+            self.tree.set_raw(vidx, np.zeros(S))
+        self.learning_sum[ptr] = 0
+        self.num_seq_store[ptr] = 0
+        self.occupied[ptr] = False
+        # size is unchanged on purpose: the demoted block stays sampleable.
+        # Every touched slot stamps the mutation clock so in-flight
+        # priority write-backs aimed at the old occupants are dropped.
+        self.ptr_advances += 1
+        self.slot_stamp[[victim, dl, ptr]] = self.ptr_advances
+        self._disk_ptr = (dslot + 1) % self.disk.disk_blocks
+        self._demotions += 1
+
+    def _disk_record(self, dslot: int) -> dict:
+        """Decoded record for disk ring slot `dslot`, through the LRU
+        cache. Caller holds the lock (staging thread)."""
+        rec = self._disk_cache.get(dslot)
+        if rec is None:
+            rec = self.disk.read_block(dslot)
+            self._disk_cache[dslot] = rec
+            while len(self._disk_cache) > _DISK_CACHE_RECORDS:
+                self._disk_cache.popitem(last=False)
+        else:
+            self._disk_cache.move_to_end(dslot)
+        return rec
+
+    def _fill_disk_rows(self, b, win_start, lstart, obs, last_action,
+                        last_reward, action, n_step_reward, gamma) -> None:
+        """Overwrite the rows of a gathered window stack whose draws landed
+        on disk-resident blocks: page in + decode through the mmap on the
+        staging thread (the H2D double buffer hides it from the learner).
+        Clamp semantics mirror the slab gather exactly, so a window sampled
+        from a demoted block is bit-identical to the same window before
+        demotion."""
+        cfg = self.cfg
+        nb = cfg.num_blocks
+        t = np.arange(cfg.seq_len)
+        tl = np.arange(cfg.learning_steps)
+        for i in np.nonzero(b >= nb)[0]:
+            rec = self._disk_record(int(b[i]) - nb)
+            rows = np.clip(win_start[i] + t, 0, cfg.block_slot_len - 1)
+            obs[i] = rec["obs"][rows]
+            last_action[i] = rec["last_action"][rows]
+            last_reward[i] = rec["last_reward"][rows]
+            lrows = np.clip(lstart[i] + tl, 0, cfg.block_length - 1)
+            action[i] = rec["action"][lrows].astype(np.int32)
+            n_step_reward[i] = rec["n_step_reward"][lrows]
+            gamma[i] = rec["gamma"][lrows]
+
+    def sample_batch(self, rng: np.random.Generator) -> SampledBatch:
+        if self.disk is None:
+            return super().sample_batch(rng)
+        # one-chunk window stack: same RNG consumption, same clamps, same
+        # stamps as the inherited path, plus the disk-row fixup
+        sw = self.sample_window_stack(rng, 1)
+        task = None
+        if self.cfg.num_tasks > 1:
+            task = self.task_store[sw.idxes[0] // self.cfg.seqs_per_block]
+        return SampledBatch(
+            obs=sw.obs[0], last_action=sw.last_action[0],
+            last_reward=sw.last_reward[0], hidden=sw.hidden[0],
+            action=sw.action[0], n_step_reward=sw.n_step_reward[0],
+            gamma=sw.gamma[0], burn_in_steps=sw.burn_in_steps[0],
+            learning_steps=sw.learning_steps[0],
+            forward_steps=sw.forward_steps[0],
+            is_weights=sw.is_weights[0], idxes=sw.idxes[0],
+            old_ptr=sw.old_ptr, env_steps=sw.env_steps,
+            old_advances=sw.old_advances, task=task,
+        )
+
+    def disk_stats(self) -> dict:
+        """Disk-tier counters for the logging/bench plane ({} when off)."""
+        if self.disk is None:
+            return {}
+        with self.lock:
+            st = self.disk.stats()
+            st["disk_occupied"] = int(
+                self.occupied[self.cfg.num_blocks:].sum()
+            )
+            st["disk_demotions"] = self._demotions
+            st["disk_evictions"] = self._evictions
+        return st
 
     def sample_window_stack(self, rng: np.random.Generator, k: int) -> StagedWindows:
         cfg = self.cfg
@@ -123,21 +360,27 @@ class TieredReplayBuffer(ReplayBuffer):
             win_start = first_burn + s * L - burn
             lstart = s * L
 
+            # disk mode: per-step fields of disk-resident draws cannot come
+            # from the slab — remap those coordinates to row 0 for the bulk
+            # gather (cheap garbage) and overwrite them from the decoded
+            # records below. Per-sequence metadata above indexed the real
+            # (extended) stores already.
+            bg = b if self.disk is None else np.minimum(b, cfg.num_blocks - 1)
             if self.native is not None:
                 obs, last_action, last_reward = self.native.gather_windows_multi(
                     [self.obs_store, self.last_action_store, self.last_reward_store],
-                    b, win_start, T,
+                    bg, win_start, T,
                 )
                 action, n_step_reward, gamma = self.native.gather_windows_multi(
                     [self.action_store, self.n_step_reward_store, self.gamma_store],
-                    b, lstart, L,
+                    bg, lstart, L,
                 )
                 action = action.astype(np.int32)
             else:
                 t = np.arange(T)
                 rows = win_start[:, None] + t[None, :]
                 np.clip(rows, 0, cfg.block_slot_len - 1, out=rows)
-                bcol = b[:, None]
+                bcol = bg[:, None]
                 obs = self.obs_store[bcol, rows]
                 last_action = self.last_action_store[bcol, rows]
                 last_reward = self.last_reward_store[bcol, rows]
@@ -147,6 +390,12 @@ class TieredReplayBuffer(ReplayBuffer):
                 action = self.action_store[bcol, lrows].astype(np.int32)
                 n_step_reward = self.n_step_reward_store[bcol, lrows]
                 gamma = self.gamma_store[bcol, lrows]
+
+            if self.disk is not None:
+                self._fill_disk_rows(
+                    b, win_start, lstart, obs, last_action, last_reward,
+                    action, n_step_reward, gamma,
+                )
 
             hidden = self.hidden_store[b, s]
             old_ptr = self.block_ptr

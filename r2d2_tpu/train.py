@@ -329,7 +329,9 @@ class _TieredPlane:
         self._pending = (np.asarray(d["prios"]), chunk)
 
     def log_extras(self) -> dict:
-        return self.xfer.stats()
+        # disk_stats() is {} when the disk tier is off, so the default
+        # metrics stream is unchanged
+        return {**self.xfer.stats(), **self.replay.disk_stats()}
 
 
 class _DevicePlane:

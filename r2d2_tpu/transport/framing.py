@@ -32,6 +32,16 @@ Control payloads (HELLO/HELLO_ACK/ACK/HEARTBEAT) are canonical JSON;
 BLOCK and CKPT payloads are npz archives (numpy's own portable binary
 container, loaded with allow_pickle=False) — see encode_block /
 encode_ckpt below.
+
+Wire codec (PR 19): HELLO may carry `"codec": <name>` (replay/codec.py
+CODECS); the service answers HELLO_ACK with the codec it accepts —
+`"none"` when it does not recognize the request, and an old service
+simply omits the key (JSON ignores unknown keys both ways), which the
+publisher reads as `"none"`. Under a negotiated codec, BLOCK payloads
+swap the raw `obs` npz entry for `obs_enc` (a codec.encode_field byte
+vector); decode_block is self-describing either way, so a spool written
+under one negotiation can be transcoded at send time for a peer that
+negotiated another (transcode_raw).
 """
 
 from __future__ import annotations
@@ -39,11 +49,13 @@ from __future__ import annotations
 import io
 import json
 import struct
+import zipfile
 import zlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from r2d2_tpu.replay import codec as blockcodec
 from r2d2_tpu.replay.block import Block
 
 MAGIC = b"R2DB"
@@ -138,14 +150,31 @@ def encode_block(
     t_serve: float,
     eps_stamps: Optional[np.ndarray] = None,
     ver_stamps: Optional[np.ndarray] = None,
+    codec: str = "none",
+    stats_out: Optional[Dict] = None,
 ) -> bytes:
     """One finished Block + its replay-add arguments + stream metadata as
     an npz payload. `t_serve` (sender wall clock at spool time) is the
     ingest-lag measurement anchor; `eps_stamps`/`ver_stamps` are the
     block's per-transition off-policy audit stamps (the tap's audit-tail
     entry), shipped so the learner side can stamp (host, ε, version) skew
-    without trusting the sender's aggregation."""
+    without trusting the sender's aggregation.
+
+    `codec` (default "none" = byte-identical to the pre-codec wire): a
+    replay/codec.py name; under a compressing codec the uint8 obs plane —
+    the payload's dominant field — ships as an `obs_enc` encoded byte
+    vector instead of the raw `obs` entry. `stats_out`, when given, gets
+    `obs_raw_bytes`/`obs_enc_bytes` so callers can account the codec win
+    without re-measuring."""
     arrays = {k: np.asarray(getattr(block, k)) for k in _BLOCK_ARRAYS}
+    if stats_out is not None:
+        stats_out["obs_raw_bytes"] = int(arrays["obs"].nbytes)
+        stats_out["obs_enc_bytes"] = int(arrays["obs"].nbytes)
+    if codec != "none":
+        enc = blockcodec.encode_field(arrays.pop("obs"), codec)
+        arrays["obs_enc"] = np.frombuffer(enc, np.uint8)
+        if stats_out is not None:
+            stats_out["obs_enc_bytes"] = len(enc)
     arrays["num_sequences"] = np.asarray(block.num_sequences, np.int64)
     arrays["task"] = np.asarray(block.task, np.int64)
     arrays["priorities"] = np.asarray(priorities)
@@ -168,14 +197,34 @@ def encode_block(
     return buf.getvalue()
 
 
-def decode_block(payload: bytes) -> Dict:
+def decode_block(payload: bytes, stats_out: Optional[Dict] = None) -> Dict:
     """Inverse of encode_block. Returns {block, priorities,
-    episode_reward, seq, t_serve, eps_stamps, ver_stamps}."""
+    episode_reward, seq, t_serve, eps_stamps, ver_stamps}.
+
+    When `stats_out` is given it receives `obs_enc_bytes` (obs bytes as
+    carried by this payload) and `obs_raw_bytes` (after decode) so the
+    receiver can account wire savings without re-parsing the npz."""
     try:
         with np.load(io.BytesIO(payload), allow_pickle=False) as d:
             arrays = {k: np.asarray(d[k]) for k in d.files}
-    except (ValueError, OSError, KeyError, zlib.error) as e:
+    except (ValueError, OSError, KeyError, zlib.error,
+            zipfile.BadZipFile) as e:
         raise FrameError(f"malformed BLOCK payload: {e}") from e
+    if "obs_enc" in arrays:
+        # codec-negotiated payload: the obs plane rides encoded. Decode on
+        # THIS (ingest/staging) thread — codec damage is payload damage,
+        # classified like a CRC miss
+        enc = arrays.pop("obs_enc").tobytes()
+        try:
+            arrays["obs"], _ = blockcodec.decode_field(enc)
+        except blockcodec.CodecError as e:
+            raise FrameError(f"BLOCK obs codec damage: {e}") from e
+        if stats_out is not None:
+            stats_out["obs_enc_bytes"] = len(enc)
+            stats_out["obs_raw_bytes"] = int(arrays["obs"].nbytes)
+    elif stats_out is not None and "obs" in arrays:
+        stats_out["obs_enc_bytes"] = int(arrays["obs"].nbytes)
+        stats_out["obs_raw_bytes"] = int(arrays["obs"].nbytes)
     try:
         block = Block(
             **{k: arrays[k] for k in _BLOCK_ARRAYS},
@@ -196,6 +245,51 @@ def decode_block(payload: bytes) -> Dict:
         }
     except KeyError as e:
         raise FrameError(f"BLOCK payload missing field {e}") from e
+
+
+def obs_crc(payload: bytes) -> int:
+    """crc32 of the DECODED obs bytes of a BLOCK payload — the spool
+    header's integrity check. Computed over decoded bytes on purpose: it
+    pins the round trip (a spool written by a binary whose codec decodes
+    differently fails the check on load instead of misdecoding into
+    replay), which a CRC over the encoded bytes could never catch."""
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as d:
+            if "obs_enc" in d.files:
+                obs, _ = blockcodec.decode_field(
+                    np.asarray(d["obs_enc"]).tobytes()
+                )
+            else:
+                obs = np.asarray(d["obs"])
+    except (ValueError, OSError, KeyError, zlib.error,
+            zipfile.BadZipFile) as e:
+        raise FrameError(f"malformed BLOCK payload: {e}") from e
+    except blockcodec.CodecError as e:
+        raise FrameError(f"BLOCK obs codec damage: {e}") from e
+    return zlib.crc32(np.ascontiguousarray(obs).tobytes())
+
+
+def transcode_raw(payload: bytes) -> bytes:
+    """A BLOCK payload with any codec undone: `obs_enc` decoded back to a
+    raw `obs` npz entry. The publisher calls this at SEND time when its
+    spool was written under a codec but the connected peer negotiated
+    "none" (mixed old/new fleets) — the on-disk spool stays encoded; only
+    the wire copy is raw. Already-raw payloads pass through untouched."""
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as d:
+            if "obs_enc" not in d.files:
+                return payload
+            arrays = {k: np.asarray(d[k]) for k in d.files}
+    except (ValueError, OSError, KeyError, zlib.error,
+            zipfile.BadZipFile) as e:
+        raise FrameError(f"malformed BLOCK payload: {e}") from e
+    try:
+        arrays["obs"], _ = blockcodec.decode_field(arrays.pop("obs_enc").tobytes())
+    except blockcodec.CodecError as e:
+        raise FrameError(f"BLOCK obs codec damage: {e}") from e
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
 
 
 # -------------------------------------------------------- checkpoint codec

@@ -49,6 +49,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.replay import codec as blockcodec
 from r2d2_tpu.transport import framing
 from r2d2_tpu.utils.faults import TRANSIENT_ERRORS, fault_point
 from r2d2_tpu.utils.supervision import Supervisor
@@ -95,6 +96,8 @@ class IngestService:
         self.dead_peers = 0
         self.frame_errors = 0
         self.ckpts_broadcast = 0
+        self.bytes_on_wire = 0  # BLOCK frame bytes as received (post-codec)
+        self.bytes_decoded = 0  # same blocks re-encoded raw (pre-codec cost)
         self._pending_ckpt: Optional[bytes] = None
         self._lag_samples: deque = deque(maxlen=512)  # seconds
         self.audit_tail: deque = deque(maxlen=audit_tail_len)
@@ -171,18 +174,32 @@ class IngestService:
                     )
                 peer.host = str(hello.get("host"))
                 last = self._host_seq.get(peer.host, 0)
+                # Codec negotiation: echo the publisher's requested wire
+                # codec iff this binary knows it; an old publisher omits
+                # the key and an old learner omits it from the ACK, so
+                # both directions degrade to raw frames ("none").
+                req = str(hello.get("codec", "none"))
                 framing.send_frame(
                     peer.sock, framing.HELLO_ACK,
-                    framing.encode_json(
-                        {"proto": framing.PROTO_VERSION, "last_seq": last}
-                    ),
+                    framing.encode_json({
+                        "proto": framing.PROTO_VERSION,
+                        "last_seq": last,
+                        "codec": req if req in blockcodec.CODECS else "none",
+                    }),
                 )
             elif ftype == framing.BLOCK:
                 if peer.host is None:
                     raise framing.FrameError("BLOCK before HELLO")
-                decoded = framing.decode_block(payload)
+                cstats: Dict = {}
+                decoded = framing.decode_block(payload, stats_out=cstats)
                 fault_point("ingest.dedup")
                 with self._lock:
+                    self.bytes_on_wire += len(payload) + framing._HEADER.size
+                    self.bytes_decoded += (
+                        len(payload)
+                        + cstats.get("obs_raw_bytes", 0)
+                        - cstats.get("obs_enc_bytes", 0)
+                    )
                     if decoded["seq"] <= self._host_seq.get(peer.host, 0):
                         self.duplicate_blocks += 1
                         decoded = None
@@ -338,6 +355,11 @@ class IngestService:
                 ),
                 "ingest_dead_peers": self.dead_peers,
                 "ingest_ckpts_broadcast": self.ckpts_broadcast,
+                "ingest_bytes_on_wire": self.bytes_on_wire,
+                "ingest_bytes_decoded": self.bytes_decoded,
+                "ingest_codec_ratio": round(
+                    self.bytes_decoded / self.bytes_on_wire, 3
+                ) if self.bytes_on_wire else 0.0,
                 "ingest_host_seq": dict(self._host_seq),
             }
         out.update(self.lag_quantiles_ms())

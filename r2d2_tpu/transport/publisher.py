@@ -42,12 +42,17 @@ from __future__ import annotations
 import os
 import select
 import socket
+import struct
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Callable, Deque, List, Optional, Tuple
 
+import numpy as np
+
 from r2d2_tpu.config import R2D2Config
+from r2d2_tpu.replay import codec as blockcodec
 from r2d2_tpu.transport import framing
 from r2d2_tpu.utils.faults import (
     TRANSIENT_ERRORS,
@@ -60,6 +65,26 @@ from r2d2_tpu.utils.supervision import Supervisor
 # bound on blocks sent per worker iteration: keeps one body call's work
 # bounded (the supervision contract) while still draining bursts fast
 _SEND_BATCH = 64
+
+# Versioned on-disk spool entry (PR 19): header + BLOCK payload.
+#
+#     magic    4 bytes  b"R2SP"
+#     version  1 byte   spool format version (this is v1)
+#     codec    1 byte   index into replay/codec.CODECS the payload was
+#                       written under
+#     obs_crc  4 bytes  crc32 of the DECODED obs bytes — the
+#                       upgrade-then-SIGKILL-resume guard: a binary whose
+#                       codec would misdecode this payload fails the CRC
+#                       on load and DROPS the entry instead of feeding
+#                       garbage into replay
+#     length   4 bytes  payload byte count
+#
+# A file without the magic is either an old binary's spool (raw npz
+# starting b"PK" — still a valid payload, loaded and counted legacy) or
+# damage (dropped and counted).
+_SPOOL_MAGIC = b"R2SP"
+_SPOOL_VERSION = 1
+_SPOOL_HEADER = struct.Struct(">4sBBII")
 
 
 class BlockStreamPublisher:
@@ -93,6 +118,9 @@ class BlockStreamPublisher:
             base=0.05, factor=2.0, max_delay=2.0, jitter=0.5, seed=seed
         )
         self.supervisor: Optional[Supervisor] = None
+        # wire codec negotiated with the CURRENT peer (worker thread only;
+        # "none" until a HELLO_ACK accepts our cfg.block_codec)
+        self._wire_codec = "none"
         # counters, guarded by _lock
         self.spooled_blocks = 0
         self.sent_blocks = 0
@@ -101,6 +129,11 @@ class BlockStreamPublisher:
         self.reconnects = 0
         self.connect_failures = 0
         self.ckpts_applied = 0
+        self.bytes_pre_codec = 0   # what the spooled payloads would be raw
+        self.bytes_post_codec = 0  # spooled payload bytes as encoded
+        self.bytes_on_wire = 0     # frame bytes actually sent (post-transcode)
+        self.spool_legacy = 0          # pre-header spool files adopted
+        self.spool_corrupt_dropped = 0 # spool files failing header/CRC checks
         self._spool_path = None
         if cfg.transport_spool_dir:
             self._spool_path = os.path.join(cfg.transport_spool_dir, host_id)
@@ -109,23 +142,70 @@ class BlockStreamPublisher:
 
     # ------------------------------------------------------------ spool disk
 
+    def _parse_spool_entry(self, raw: bytes) -> Optional[bytes]:
+        """One on-disk spool file -> BLOCK payload, or None when the entry
+        must be dropped. Handles all three generations: v1 headered
+        (verified against the decoded-obs CRC), legacy headerless raw npz
+        (an old binary's spool adopted across an upgrade), damage."""
+        if raw[:4] == _SPOOL_MAGIC:
+            try:
+                _, version, codec_id, crc, length = _SPOOL_HEADER.unpack_from(raw)
+            except struct.error:
+                return None
+            payload = raw[_SPOOL_HEADER.size:]
+            if (
+                version != _SPOOL_VERSION
+                or codec_id >= len(blockcodec.CODECS)
+                or len(payload) != length
+            ):
+                return None
+            try:
+                if framing.obs_crc(payload) != crc:
+                    return None
+            except framing.FrameError:
+                return None
+            return payload
+        if raw[:2] == b"PK":  # headerless npz: an old binary wrote this
+            # r2d2: disable=lock-discipline — __init__-only (no worker yet)
+            self.spool_legacy += 1
+            return raw
+        return None
+
     def _load_spool(self) -> None:
         """Crash resume: reload the unacked tail and continue the sequence
-        numbering past everything ever spooled here."""
+        numbering past everything ever spooled here. Entries that fail the
+        v1 header checks (an upgrade-then-SIGKILL resume onto a spool this
+        binary would misdecode, or plain damage) are dropped and counted —
+        a dropped block is an at-least-once gap the ingest side already
+        tolerates; a misdecoded block would be silent replay corruption."""
         entries = []
+        max_seq = 0  # over EVERY file, dropped ones included: a dropped
+        # entry's number must never be reissued (the ingest high-water
+        # dedup would discard its reuse as a duplicate)
         for name in os.listdir(self._spool_path):
             if not name.endswith(".blk"):
                 continue
             seq = int(name[:-4])
-            with open(os.path.join(self._spool_path, name), "rb") as f:
-                entries.append((seq, f.read()))
+            max_seq = max(max_seq, seq)
+            path = os.path.join(self._spool_path, name)
+            with open(path, "rb") as f:
+                payload = self._parse_spool_entry(f.read())
+            if payload is None:
+                # r2d2: disable=lock-discipline — __init__-only
+                self.spool_corrupt_dropped += 1
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            entries.append((seq, payload))
         entries.sort()
         # __init__-only (no worker exists yet)
         # r2d2: disable=cross-thread-unguarded-write
         self._spool.extend(entries)
-        if entries:
+        if max_seq:
             # __init__-only (no worker exists yet)
-            self._next_seq = entries[-1][0] + 1  # r2d2: disable=lock-discipline
+            self._next_seq = max_seq + 1  # r2d2: disable=lock-discipline
 
     def _spool_file(self, seq: int) -> str:
         return os.path.join(self._spool_path, f"{seq:012d}.blk")
@@ -142,17 +222,28 @@ class BlockStreamPublisher:
         with self._lock:
             seq = self._next_seq
             self._next_seq += 1
+        cstats: dict = {}
         payload = framing.encode_block(
             block, priorities, episode_reward, seq=seq, t_serve=time.time(),
-            eps_stamps=eps, ver_stamps=ver,
+            eps_stamps=eps, ver_stamps=ver, codec=self.cfg.block_codec,
+            stats_out=cstats,
         )
         fault_point("transport.spool")
         if self._spool_path is not None:
             # persist-then-enqueue: a crash between the two re-sends a
-            # spooled block (at-least-once), never invents a seq gap
+            # spooled block (at-least-once), never invents a seq gap. The
+            # v1 header's decoded-obs CRC comes straight from the block —
+            # the load side recomputes it through the decode path, closing
+            # the round trip
+            crc = zlib.crc32(np.ascontiguousarray(block.obs).tobytes())
+            header = _SPOOL_HEADER.pack(
+                _SPOOL_MAGIC, _SPOOL_VERSION,
+                blockcodec.CODECS.index(self.cfg.block_codec),
+                crc, len(payload),
+            )
             tmp = self._spool_file(seq) + ".tmp"
             with open(tmp, "wb") as f:
-                f.write(payload)
+                f.write(header + payload)
             os.replace(tmp, self._spool_file(seq))
         with self._lock:
             if len(self._spool) >= self.cfg.transport_spool_depth:
@@ -161,6 +252,12 @@ class BlockStreamPublisher:
                 self._drop_spool_file(old_seq)
             self._spool.append((seq, payload))
             self.spooled_blocks += 1
+            # pre-codec = the payload as it would have spooled raw: only
+            # the obs entry differs between the two encodings
+            self.bytes_post_codec += len(payload)
+            self.bytes_pre_codec += (
+                len(payload) - cstats["obs_enc_bytes"] + cstats["obs_raw_bytes"]
+            )
 
     def add_blocks_batch(self, items) -> None:
         for block, priorities, episode_reward in items:
@@ -189,6 +286,7 @@ class BlockStreamPublisher:
                 "proto": framing.PROTO_VERSION,
                 "host": self.host_id,
                 "next_seq": next_seq,
+                "codec": self.cfg.block_codec,
             }))
             ftype, payload = framing.recv_frame(sock)
             if ftype != framing.HELLO_ACK:
@@ -202,6 +300,12 @@ class BlockStreamPublisher:
                     f"{hello.get('proto')}, we speak {framing.PROTO_VERSION}"
                 )
             last_seq = int(hello.get("last_seq", 0))
+            # codec negotiation: the service echoes what it accepts; an
+            # OLD service omits the key entirely (unknown JSON keys are
+            # ignored both directions), which reads as "none" — spooled
+            # payloads are then transcoded raw at send time, so mixed
+            # old/new fleets interop on the raw wire format
+            self._wire_codec = str(hello.get("codec", "none"))
         except BaseException:
             sock.close()
             raise
@@ -317,11 +421,16 @@ class BlockStreamPublisher:
             ][:_SEND_BATCH]
         for seq, payload in tail:
             fault_point("transport.send")
+            if self._wire_codec == "none" and self.cfg.block_codec != "none":
+                # the peer did not negotiate our codec: undo it for the
+                # wire copy only (the spool stays encoded on disk)
+                payload = framing.transcode_raw(payload)
             framing.send_frame(self._sock, framing.BLOCK, payload)
             with self._lock:
                 self._last_send = time.monotonic()
                 self._sent_up_to = max(self._sent_up_to, seq)
                 self.sent_blocks += 1
+                self.bytes_on_wire += len(payload) + framing._HEADER.size
 
     def _maybe_heartbeat(self) -> None:
         now = time.monotonic()
@@ -377,4 +486,14 @@ class BlockStreamPublisher:
                 "transport_acked_seq": self._acked,
                 "transport_next_seq": self._next_seq,
                 "transport_connected": self._sock is not None,
+                "transport_bytes_pre_codec": self.bytes_pre_codec,
+                "transport_bytes_post_codec": self.bytes_post_codec,
+                "transport_bytes_on_wire": self.bytes_on_wire,
+                "transport_codec_ratio": (
+                    self.bytes_pre_codec / self.bytes_post_codec
+                    if self.bytes_post_codec else 0.0
+                ),
+                "transport_spool_legacy": self.spool_legacy,
+                "transport_spool_corrupt_dropped": self.spool_corrupt_dropped,
+                "transport_wire_codec": self._wire_codec,
             }

@@ -81,6 +81,17 @@ Registered sites (KNOWN_SITES below):
 - ingest.dedup        — the per-host sequence-number admission check on
                         every received BLOCK frame — the exactly-once
                         delivery seam (transport/ingest.py)
+- disk.write          — one demoted block's segment-record write in the
+                        replay disk tier (data-first: fires BEFORE the
+                        mmap write, so a kill here leaves the control
+                        plane untouched) (replay/disk_tier.py)
+- disk.promote        — one disk-resident block's page-in + decode back
+                        to host arrays (the staging-thread read path and
+                        the snapshot/reshard promote path)
+                        (replay/disk_tier.py)
+- codec.decode        — one encoded field's decode (inflate + un-delta),
+                        shared by disk page-in, spool load, and BLOCK
+                        frame ingest (replay/codec.py)
 """
 
 from __future__ import annotations
@@ -125,6 +136,9 @@ KNOWN_SITES = (
     "transport.spool",
     "ingest.accept",
     "ingest.dedup",
+    "disk.write",
+    "disk.promote",
+    "codec.decode",
 )
 
 

@@ -1,0 +1,93 @@
+#!/bin/bash
+# Round-19 replay-at-scale chain: the measurement side of the disk-tier
+# + block-codec PR (replay/disk_tier|codec, the spool v1 header, the
+# HELLO-negotiated wire codec). Three rungs, the report written to
+# BENCH_r19.json:
+#
+#   1. storage gate  — the disk-tier/replay/chaos/transport test files
+#      plus the full static-analysis CLI (including the new
+#      codec-decode-in-hot-loop lint and the concurrency pass over the
+#      staging thread). A tier that misdecodes, a spool that adopts
+#      damage, or a decode on the learner hot loop makes every number
+#      below noise.
+#   2. parity anchor — one default-config (disk tier OFF, codec OFF)
+#      liveloop row, so the bit-identical default path is exercised
+#      the same day the tier ships.
+#   3. replay scale  — bench.py --mode replay-scale: fill a host-only
+#      and a 10×-capacity disk-tier buffer with identical streams,
+#      measure the three-tier capacity/bytes/latency table, the
+#      obs-plane codec cut, a kill-and-resume whose tree/occupancy/
+#      sample-stream fingerprint must match, and the PR 12 liveloop
+#      rerun at 10× retention with live demotions mid-training.
+#
+# PRE-REGISTERED read: capacity ratio >= 10 at flat slab bytes, codec
+# obs cut >= 3x on catch-shaped frames, resume fingerprint EQUAL (tree
+# total, occupancy, and four sample draws), liveloop-at-scale return
+# unchanged-or-better vs its own first half with disk_demotions > 0
+# (the tier actually ran) and sessions_lost == 0.
+cd /root/repo
+
+. runs/lib.sh
+
+OUT=BENCH_r19.json
+
+echo "=== RUNG 1: storage gate ==="
+python -m pytest tests/test_disk_tier.py tests/test_replay_buffer.py \
+  tests/test_tiered_store.py tests/test_chaos.py tests/test_transport.py \
+  -q -p no:cacheprovider
+RC=$?
+echo "=== STORAGE_PYTEST EXIT: $RC ==="
+python -m r2d2_tpu.analysis.cli --jaxpr --concurrency
+RCA=$?
+echo "=== ANALYSIS EXIT: $RCA ==="
+if [ $RC -ne 0 ] || [ $RCA -ne 0 ]; then
+  echo "=== ABORT: storage gate failed; scale economics would be noise ==="
+  exit 1
+fi
+
+echo "=== RUNG 2: parity anchor (disk tier off, codec off — the default) ==="
+python bench.py --mode liveloop --liveloop-seconds 10 --arrival-rate 60 \
+  | tee runs/bench_liveloop_r19_anchor.jsonl
+echo "=== LIVELOOP_ANCHOR EXIT: $? ==="
+
+echo "=== RUNG 3: replay scale (10x capacity, codec on, resume drill) ==="
+python bench.py --mode replay-scale --replay-scale 10 \
+  --replay-scale-out "$OUT"
+RC=$?
+echo "=== REPLAY_SCALE EXIT: $RC ==="
+if [ $RC -ne 0 ]; then
+  echo "=== ABORT: replay-scale bench failed ==="
+  exit 1
+fi
+
+python - "$OUT" <<'PY'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["value"] >= r["scale_target"] * 0.95, (r["value"], r["scale_target"])
+assert r["codec_ratio_obs"] >= 3.0, r["codec_ratio_obs"]
+tiers = {t["tier"]: t for t in r["tier_table"]}
+disk = tiers["disk_segments"]
+assert disk["bytes_per_transition"] <= disk["bytes_per_transition_raw"] + 4.5, disk
+assert disk["slab_mb"] <= tiers["host_slab"]["slab_mb"] * 1.01, \
+    (disk["slab_mb"], tiers["host_slab"]["slab_mb"])  # flat RSS: disk adds ~0 slab
+assert r["resume_from_disk"]["fingerprint_equal"], r["resume_from_disk"]
+live = r["liveloop_at_scale"]
+assert live["disk_demotions"] > 0, live   # the tier actually ran mid-training
+assert live["sessions_lost"] == 0, live["sessions_lost"]
+assert live["value"] >= live["first_half_mean_return"], \
+    (live["first_half_mean_return"], live["value"])
+print(f"replay-scale: capacity x{r['value']}, "
+      f"obs codec {r['codec_ratio_obs']}x, "
+      f"disk {disk['bytes_per_transition_raw']}->"
+      f"{disk['bytes_per_transition']} B/transition, "
+      f"sample p50 {tiers['host_slab']['sample_p50_ms']}ms host / "
+      f"{disk['sample_p50_ms']}ms mixed, "
+      f"resume fp equal, liveloop return "
+      f"{live['first_half_mean_return']}->{live['value']} "
+      f"({live['disk_demotions']} demotions, lost 0)")
+PY
+RC=$?
+echo "=== REPLAY_SCALE_ASSERT EXIT: $RC ==="
+[ $RC -ne 0 ] && exit 1
+
+echo R19_DISKTIER_ALL_DONE

@@ -474,6 +474,78 @@ def test_host_tree_rule_ignores_device_ops_and_pytrees():
     assert findings == []
 
 
+# ------------------------------------------------- codec-decode-in-hot-loop
+
+
+def test_codec_decode_in_hot_loop_fires():
+    """decode/mmap calls inside loop bodies of hot-path or serve modules:
+    the disk tier's contract is that decode happens on the staging thread,
+    never per-iteration on the learner or serve step."""
+    src = """
+    import mmap
+    import numpy as np
+    from r2d2_tpu.replay.codec import decode_field
+    def drain(self, blobs, paths):
+        out = []
+        for blob in blobs:
+            arr, _ = decode_field(blob)
+            out.append(arr)
+        while paths:
+            m = np.memmap(paths.pop(), dtype=np.uint8, mode="r")
+            out.append(m)
+        return out
+    """
+    findings, _ = lint(src)  # learner.py: hot path
+    hits = [f for f in findings if f.rule == "codec-decode-in-hot-loop"]
+    assert len(hits) == 2
+    assert all(f.severity == "warning" for f in hits)
+    # serve modules are equally latency-bound
+    findings, _ = lint(src, path="r2d2_tpu/serve/server.py")
+    assert [f.rule for f in findings
+            if f.rule == "codec-decode-in-hot-loop"] != []
+
+
+def test_codec_decode_quiet_outside_loops_cold_files_and_suppressed():
+    hoisted = """
+    from r2d2_tpu.replay.codec import decode_field
+    def load_one(blob):
+        arr, _ = decode_field(blob)  # one deliberate decode, no loop
+        return arr
+    """
+    findings, _ = lint(hoisted)
+    assert [f for f in findings if f.rule == "codec-decode-in-hot-loop"] == []
+    # the staging thread / disk tier itself decodes in loops BY DESIGN:
+    # cold modules never arm the rule
+    looped = """
+    from r2d2_tpu.replay.codec import decode_field
+    def gather(self, blobs):
+        return [decode_field(b)[0] for b in blobs] or [
+            decode_field(b)[0] for b in blobs]
+    """
+    in_loop = """
+    from r2d2_tpu.replay.codec import decode_field
+    def gather(self, blobs):
+        out = []
+        for b in blobs:
+            arr, _ = decode_field(b)
+            out.append(arr)
+        return out
+    """
+    findings, _ = lint(in_loop, path="r2d2_tpu/replay/disk_tier.py")
+    assert findings == []
+    del looped
+    # in-place suppression for the deliberate exception
+    sup = """
+    from r2d2_tpu.replay.codec import decode_field
+    def drain(self, blobs):
+        for b in blobs:
+            yield decode_field(b)  # r2d2: disable=codec-decode-in-hot-loop
+    """
+    findings, suppressed = lint(sup)
+    assert findings == []
+    assert [f.rule for f in suppressed] == ["codec-decode-in-hot-loop"]
+
+
 # ---------------------------------------------------------------- suppression
 
 

@@ -160,6 +160,41 @@ def test_sigterm_resume_is_bit_identical(tmp_path, plane, site, call):
     np.testing.assert_array_equal(_next_draw_idxes(clean), _next_draw_idxes(resumed))
 
 
+@pytest.mark.parametrize(
+    "site,call",
+    [
+        ("disk.write", 2),    # mid-demotion: record bytes not yet landed
+        ("disk.promote", 1),  # mid-gather off the mmap segments
+        ("codec.decode", 1),  # inside a disk-record field decode
+    ],
+)
+def test_disk_tier_sigterm_resume_is_bit_identical(tmp_path, site, call):
+    """Kill sweep over the disk-tier fault sites. A SIGTERM landing before
+    a demotion's bytes hit the segment file, mid-promote while a sample
+    gathers disk rows, or inside a codec field decode must still resume
+    bit-identically: the replay snapshot is the commit point, never the
+    segment files themselves (they are rebuilt from the snapshot on
+    restore)."""
+    over = dict(
+        buffer_capacity=64,        # 4 host blocks: demotions start early
+        replay_disk_capacity=320,  # a 20-block disk ring under them
+        block_codec="delta-zlib",
+    )
+    clean = _run_clean(
+        _cfg(tmp_path, "clean", "tiered",
+             replay_disk_dir=str(tmp_path / "clean" / "disk"), **over))
+    resumed, _ = _kill_and_resume(
+        _cfg(tmp_path, "killed", "tiered",
+             replay_disk_dir=str(tmp_path / "killed" / "disk"), **over),
+        site, call)
+    _assert_identical(
+        _fingerprint(clean, tmp_path, "clean"),
+        _fingerprint(resumed, tmp_path, "killed"),
+    )
+    np.testing.assert_array_equal(
+        _next_draw_idxes(clean), _next_draw_idxes(resumed))
+
+
 def test_double_preemption_resumes_twice(tmp_path):
     """Two successive preemptions (kill, resume, kill again, resume again)
     still land bit-identical — the carry round-trips through its own
