@@ -4,8 +4,10 @@ model and a CLI (`python -m r2d2_tpu.analysis`, console script
 catalog and suppression syntax.
 
 Import surface: `findings` and `ast_rules` are light (stdlib + the faults
-site registry); `jaxpr_rules` pulls in jax and the model stack and is
-imported lazily by the CLI's --jaxpr mode and the tests.
+site registry); the interprocedural passes (`concurrency`, `determinism`)
+are stdlib-only and loaded lazily by their CLI flags; `jaxpr_rules` pulls
+in jax and the model stack and is imported lazily by the CLI's --jaxpr
+mode and the tests.
 """
 
 from r2d2_tpu.analysis.findings import (  # noqa: F401

@@ -459,20 +459,40 @@ def _rule_host_tree_in_hot_loop(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+# several rules ask the same pure questions of the same module tree; the
+# one-entry memo (keyed on tree identity, holding a strong ref so ids are
+# never reused under it) makes each question one walk per module instead
+# of one per rule
+_TREE_MEMO: Dict[str, Tuple[ast.AST, object]] = {}
+
+
+def _memo_per_tree(name: str, tree: ast.AST, build):
+    ent = _TREE_MEMO.get(name)
+    if ent is not None and ent[0] is tree:
+        return ent[1]
+    res = build()
+    _TREE_MEMO[name] = (tree, res)
+    return res
+
+
 def _jit_calls(tree: ast.AST) -> List[ast.Call]:
     """Every `jax.jit(...)` call, including the `functools.partial(jax.jit,
     ...)` decorator form (the partial call itself is returned)."""
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        d = _dotted(node.func)
-        if d == "jax.jit":
-            out.append(node)
-        elif d in ("functools.partial", "partial") and node.args:
-            if _dotted(node.args[0]) == "jax.jit":
+
+    def build() -> List[ast.Call]:
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d == "jax.jit":
                 out.append(node)
-    return out
+            elif d in ("functools.partial", "partial") and node.args:
+                if _dotted(node.args[0]) == "jax.jit":
+                    out.append(node)
+        return out
+
+    return _memo_per_tree("jit_calls", tree, build)
 
 
 def _rule_jit_in_loop(tree: ast.AST, path: str) -> List[Finding]:
@@ -505,11 +525,14 @@ def _rule_jit_in_loop(tree: ast.AST, path: str) -> List[Finding]:
 
 
 def _function_defs(tree: ast.AST) -> Dict[str, ast.FunctionDef]:
-    defs: Dict[str, ast.FunctionDef] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            defs[node.name] = node
-    return defs
+    def build() -> Dict[str, ast.FunctionDef]:
+        defs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+        return defs
+
+    return _memo_per_tree("function_defs", tree, build)
 
 
 def _static_params(call: ast.Call, fn: ast.FunctionDef) -> List[ast.arg]:
@@ -559,8 +582,9 @@ def _jitted_defs(tree: ast.AST) -> List[Tuple[ast.Call, ast.FunctionDef]]:
     `jax.jit(name, ...)` over a same-module def, or a decorator (`@jax.jit`
     / `@functools.partial(jax.jit, ...)`)."""
     defs = _function_defs(tree)
+    calls = _jit_calls(tree)
     pairs: List[Tuple[ast.Call, ast.FunctionDef]] = []
-    for call in _jit_calls(tree):
+    for call in calls:
         target = None
         if _dotted(call.func) == "jax.jit" and call.args:
             if isinstance(call.args[0], ast.Name):
@@ -574,7 +598,7 @@ def _jitted_defs(tree: ast.AST) -> List[Tuple[ast.Call, ast.FunctionDef]]:
         for dec in fn.decorator_list:
             if _dotted(dec) == "jax.jit":
                 pairs.append((ast.Call(func=dec, args=[], keywords=[]), fn))
-            elif isinstance(dec, ast.Call) and dec in _jit_calls(tree):
+            elif isinstance(dec, ast.Call) and dec in calls:
                 pairs.append((dec, fn))
     return pairs
 

@@ -1,7 +1,8 @@
 """CLI for the analysis plane.
 
     python -m r2d2_tpu.analysis [--format text|json|sarif] [--changed-only]
-                                [--jaxpr] [--concurrency] [paths...]
+                                [--jaxpr] [--concurrency] [--determinism]
+                                [paths...]
 
 Default paths: the installed r2d2_tpu package tree. Exit status 1 when any
 unsuppressed finding remains (suppressed ones are counted in text mode but
@@ -12,8 +13,12 @@ precisions (slower: pulls in jax and the model stack); combined with
 `--changed-only` the jaxpr results are served from a cache keyed on a
 hash of the traced entry-point sources, so unchanged traces cost nothing.
 `--concurrency` runs the interprocedural thread/lock pass (concurrency.py)
-over the same paths. `--format sarif` emits SARIF 2.1.0 for CI annotation
-(runs/run_analyze_ci.sh).
+over the same paths. `--determinism` runs the resume-completeness /
+nondeterminism-taint / chaos-coverage pass (determinism.py) — like the
+concurrency pass it is interprocedural, so it always scans the full
+requested tree. `--format sarif` emits SARIF 2.1.0 for CI annotation
+(runs/run_analyze_ci.sh); rule indices are stable because the driver's
+rule table is the sorted set of rule ids present.
 """
 
 from __future__ import annotations
@@ -58,7 +63,7 @@ def main(argv=None) -> int:
         prog="r2d2-analyze",
         description="JAX-aware static analysis: dtype/recompile/host-sync/"
         "donation/fault-site lints, jaxpr gates, and the interprocedural "
-        "concurrency pass",
+        "concurrency and determinism passes",
     )
     parser.add_argument(
         "paths", nargs="*",
@@ -70,6 +75,13 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--changed-only", action="store_true",
         help="lint only git-changed/untracked .py files (fast local loop)",
+    )
+    parser.add_argument(
+        "--determinism", action="store_true",
+        help="also run the interprocedural determinism pass: resume-"
+        "completeness of carry/restore state, wall-clock/unsorted-scan/"
+        "unseeded-RNG taint into deterministic sinks, and chaos-site "
+        "coverage",
     )
     parser.add_argument(
         "--jaxpr", action="store_true",
@@ -105,6 +117,16 @@ def main(argv=None) -> int:
         cf, cs = concurrency.analyze_paths(conc_paths)
         findings = findings + cf
         suppressed = suppressed + cs
+    if args.determinism:
+        # interprocedural like the concurrency pass: a missing carry field
+        # or a tainted helper shows up at its callers, so the pass always
+        # covers the full requested tree
+        from r2d2_tpu.analysis import determinism
+
+        det_paths = args.paths if args.paths else [pkg_root]
+        df, ds = determinism.analyze_paths(det_paths)
+        findings = findings + df
+        suppressed = suppressed + ds
     if args.jaxpr:
         from r2d2_tpu.analysis import jaxpr_rules
 

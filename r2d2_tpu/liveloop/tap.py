@@ -128,17 +128,26 @@ class TransitionTap:
                  emit: Optional[Callable] = None):
         self.cfg = cfg
         self.depth = int(depth if depth is not None else cfg.liveloop_tap_depth)
+        # r2d2: ephemeral(process-local plumbing: the owner rewires the callback via set_emit on every (re)construction, it is never part of replayed state)
         self._emit = emit  # (block, priorities, episode_reward) -> None
         self._lock = threading.Lock()
         self._q: deque = deque()
         self._wake = threading.Event()
         self._sessions: Dict[str, _SessionStream] = {}
+        # r2d2: ephemeral(only guards seam accounting for batches still queued in _q; the tap thread drains _q before any snapshot cut, so it is empty whenever carry_state runs)
         self._broken: set = set()  # sids whose continuity a drop severed
+        # r2d2: ephemeral(pending disconnects are applied by the same process_pending cycle that would precede a snapshot cut; a resumed run re-evicts via live disconnects)
         self._evictions: List[str] = []  # disconnects queued for the tap thread
-        # counters (all guarded by _lock)
+        # counters (all guarded by _lock) — monitoring only: stats() feeds
+        # the metrics stream, never replay or the resume fingerprint, so a
+        # resumed process restarts them from zero by design
+        # r2d2: ephemeral(monitoring counter; stats-only, restarts at 0 on resume)
         self.captured_steps = 0
+        # r2d2: ephemeral(monitoring counter; stats-only, restarts at 0 on resume)
         self.emitted_blocks = 0
+        # r2d2: ephemeral(monitoring counter; stats-only, restarts at 0 on resume)
         self.dropped_batches = 0
+        # r2d2: ephemeral(monitoring counter; stats-only, restarts at 0 on resume)
         self.seam_breaks = 0
         # bounded off-policy audit trail: per emitted block, the aligned
         # (epsilon, params_version) stamps of its transitions

@@ -25,10 +25,10 @@ class LiveLoopTrainer:
     def __init__(self, cfg: R2D2Config, trainer: Optional[Trainer] = None):
         self.cfg = cfg
         self.trainer = trainer if trainer is not None else Trainer(cfg)
-        # _cadences stamps wall-minutes into checkpoints relative to
-        # _start_time, which only the run modes set; the live loop is its
-        # own run mode
-        self.trainer._start_time = time.time()
+        # _cadences stamps wall-minutes into checkpoints relative to the
+        # trainer's run clock, which only the run modes start; the live
+        # loop is its own run mode
+        self.trainer.reset_clock()
         self.updates_done = 0
 
     @property

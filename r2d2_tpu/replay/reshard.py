@@ -88,7 +88,9 @@ def snapshot_paths(ckpt_dir: str) -> List[str]:
     single = os.path.join(ckpt_dir, "replay_snapshot.npz")
     if os.path.exists(single):
         out.append(single)
-    per_proc = glob.glob(os.path.join(ckpt_dir, "replay_snapshot_p*.npz"))
+    # sorted: glob order is fs-dependent; the _pidx sort below is stable,
+    # so a deterministic input order makes the full ordering canonical
+    per_proc = sorted(glob.glob(os.path.join(ckpt_dir, "replay_snapshot_p*.npz")))
 
     def _pidx(p: str) -> int:
         m = re.search(r"replay_snapshot_p(\d+)\.npz$", p)

@@ -335,7 +335,10 @@ class ScenarioRunner:
             # sessions into the next cell — a later replica kill would
             # export the dead carries and count them against the
             # survivors' slab capacity as spurious sessions_lost
-            for sid in {ev.session for ev in self.trace}:
+            # sorted: eviction order drives the tap's block-emission order
+            # into replay — set order would make back-to-back runs of one
+            # seeded scenario diverge bit-wise
+            for sid in sorted({ev.session for ev in self.trace}):
                 self.server.evict(sid)
             if prev_plane is not None:
                 faults.install(prev_plane)

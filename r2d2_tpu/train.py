@@ -236,6 +236,7 @@ class _TieredPlane:
         self._pending = None  # deferred (priorities, chunk) readback
         self.xfer = TransferTimer()
         self.multi_fn = make_stacked_batch_train_step(tr.cfg, tr.net, self.K)
+        # r2d2: ephemeral(lazily rebuilt by _ensure_pipeline on the next sample; capture_pending stops it with an RNG rewind so the resumed pipeline re-draws identically)
         self._pipe: Optional[TieredPrefetchPipeline] = None
 
     def _ensure_pipeline(self) -> TieredPrefetchPipeline:
@@ -1433,10 +1434,17 @@ class Trainer:
                     "check max_episode_steps vs chunk/block length"
                 )
 
+    def reset_clock(self) -> None:
+        """(Re)start the wall-minutes clock that the checkpoint cadence
+        stamps (_cadences / _finalize_preempt). Run modes call this on
+        entry; external drivers that act as their own run mode (the live
+        loop) call it too instead of poking _start_time directly."""
+        self._start_time = time.time()
+
     def run_inline(self, env_steps_per_update: Optional[int] = None) -> None:
         """Strict alternation: k env steps, one update (SURVEY.md 7.2)."""
         cfg = self.cfg
-        self._start_time = time.time()
+        self.reset_clock()
         k = env_steps_per_update or max(cfg.num_actors, 1)
         # one dispatch is steps_per_update learner updates: scale collection
         # so the env-step : update ratio the caller asked for is preserved
@@ -1476,7 +1484,7 @@ class Trainer:
         actor/sampler iteration is restarted with the traceback recorded
         instead of silently starving the learner (SURVEY.md section 5.3)."""
         cfg = self.cfg
-        self._start_time = time.time()
+        self.reset_clock()
         batch_q: "queue.Queue" = queue.Queue(maxsize=8)
         sup = self._sup = self._make_supervisor()
         with self._sigterm_to_preempt(), sup.armed_watchdog():
@@ -1618,7 +1626,7 @@ class Trainer:
                 f"'device'/'sharded'/'multihost' (got {cfg.collector!r}, "
                 f"{cfg.replay_plane!r})"
             )
-        self._start_time = time.time()
+        self.reset_clock()
         # main-thread watchdog: this loop has no worker threads, so a
         # wedged device readback would hang it silently forever — the
         # watchdog hard-exits (utils/supervision.STALL_EXIT_CODE) instead.

@@ -182,7 +182,10 @@ class BlockStreamPublisher:
         max_seq = 0  # over EVERY file, dropped ones included: a dropped
         # entry's number must never be reissued (the ingest high-water
         # dedup would discard its reuse as a duplicate)
-        for name in os.listdir(self._spool_path):
+        # sorted: names are zero-padded seqs, so lexicographic IS replay
+        # order — the drop/unlink side effects and max_seq accounting run
+        # identically on every host and resume
+        for name in sorted(os.listdir(self._spool_path)):
             if not name.endswith(".blk"):
                 continue
             seq = int(name[:-4])

@@ -106,7 +106,9 @@ def list_checkpoint_steps(ckpt_dir: str) -> List[int]:
     if not os.path.isdir(ckpt_dir):
         return []
     steps = []
-    for name in os.listdir(ckpt_dir):
+    # sorted: fs enumeration order varies per host; the scan's order must
+    # not leak into anything downstream of a resume decision
+    for name in sorted(os.listdir(ckpt_dir)):
         if not name.startswith("step_"):
             continue
         try:

@@ -1,17 +1,17 @@
 #!/usr/bin/env bash
 # CI entry for the static-analysis gate: run every rule family (AST lints,
-# the interprocedural concurrency pass, and — unless SKIP_JAXPR=1 — the
-# jaxpr entry-point gate) repo-wide and emit SARIF so the CI system can
-# annotate findings inline on the diff. Exit status is the analyzer's:
-# nonzero iff any unsuppressed finding remains, so this doubles as the
-# blocking check. Usage:
+# the interprocedural concurrency and determinism passes, and — unless
+# SKIP_JAXPR=1 — the jaxpr entry-point gate) repo-wide and emit SARIF so
+# the CI system can annotate findings inline on the diff. Exit status is
+# the analyzer's: nonzero iff any unsuppressed finding remains, so this
+# doubles as the blocking check. Usage:
 #   runs/run_analyze_ci.sh [OUT.sarif]        # default: analysis.sarif
-#   SKIP_JAXPR=1 runs/run_analyze_ci.sh ...   # AST+concurrency only (fast)
+#   SKIP_JAXPR=1 runs/run_analyze_ci.sh ...   # AST-pass families only (fast)
 set -u
 cd "$(dirname "$0")/.."
 
 out=${1:-analysis.sarif}
-args=(--concurrency --format sarif)
+args=(--concurrency --determinism --format sarif)
 if [ "${SKIP_JAXPR:-0}" != "1" ]; then
   args+=(--jaxpr)
 fi

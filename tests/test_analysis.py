@@ -1411,3 +1411,367 @@ def test_seeded_mutation_trips_concurrency_gate(tmp_path):
     assert findings, "removing a lock acquisition must trip the gate"
     assert "cross-thread-unguarded-write" in rules_of(findings)
     assert any("cachemod.py" in f.path for f in findings)
+
+
+# -------------------------------------------------------- determinism pass
+
+
+def det(tmp_path, files):
+    """Run the interprocedural determinism pass over a fixture package."""
+    from r2d2_tpu.analysis import determinism
+
+    for name, src in files.items():
+        _write(tmp_path, name, src)
+    return determinism.analyze_paths([str(tmp_path)])
+
+
+def test_resume_complete_class_is_clean_and_uncaptured_fires(tmp_path):
+    complete = """
+    class Acc:
+        def __init__(self):
+            self.total = 0.0
+            self.n = 0
+        def add(self, x):
+            self.total += x
+            self.n += 1
+        def carry_state(self):
+            return {"total": self.total, "n": self.n}
+        def restore_carry(self, d):
+            self.total = d["total"]
+            self.n = d["n"]
+    """
+    findings, _ = det(tmp_path / "ok", {"mod.py": complete})
+    assert findings == [], render_text(findings)
+
+    # drop `n` from the carry dict: mutated state that no snapshot carries
+    uncaptured = complete.replace('"total": self.total, "n": self.n', '"total": self.total')
+    findings, _ = det(tmp_path / "pos", {"mod.py": uncaptured})
+    assert rules_of(findings) == ["resume-uncaptured-field"]
+    assert "Acc.n" in findings[0].message
+
+
+def test_unrestored_field_fires(tmp_path):
+    src = """
+    class Acc:
+        def __init__(self):
+            self.n = 0
+        def add(self):
+            self.n += 1
+        def carry_state(self):
+            return {"n": self.n}
+        def restore_carry(self, d):
+            pass
+    """
+    findings, _ = det(tmp_path, {"mod.py": src})
+    assert rules_of(findings) == ["resume-unrestored-field"]
+    assert "Acc.n" in findings[0].message
+
+
+def test_unpack_and_subscript_mutations_inventoried(tmp_path):
+    """Tuple-unpacking targets (the collector's `(..., self.env_state,
+    self.key) = ...` idiom) and subscript stores both count as mutations."""
+    src = """
+    class C:
+        def __init__(self):
+            self.a = 0
+            self.b = 0
+            self.d = {}
+        def step(self, f):
+            (self.a, self.b) = f()
+            self.d["k"] = self.a
+        def capture_pending(self):
+            return {"a": self.a}
+        def restore_pending(self, d):
+            self.a = d["a"]
+    """
+    findings, _ = det(tmp_path, {"mod.py": src})
+    assert rules_of(findings) == ["resume-uncaptured-field"]
+    flagged = {f.message.split(" ")[0] for f in findings}
+    assert flagged == {"C.b", "C.d"}
+
+
+def test_ephemeral_exempts_and_is_inventoried(tmp_path):
+    """An ephemeral-annotated attribute is exempt, but the would-be
+    finding lands in the suppressed list — the exemption inventory stays
+    visible to the gate instead of vanishing."""
+    src = """
+    class Tap:
+        def __init__(self):
+            self.blocks = []
+            # r2d2: ephemeral(monitoring counter; restarts at 0 on resume)
+            self.emitted = 0
+        def push(self, b):
+            self.blocks.append(b)
+            self.blocks = self.blocks[-4:]
+            self.emitted += 1
+        def carry_state(self):
+            return {"blocks": list(self.blocks)}
+        def restore_carry(self, d):
+            self.blocks = list(d["blocks"])
+    """
+    findings, suppressed = det(tmp_path, {"mod.py": src})
+    assert findings == [], render_text(findings)
+    assert [f.rule for f in suppressed] == ["resume-uncaptured-field"]
+    assert "Tap.emitted" in suppressed[0].message
+
+
+def test_bad_ephemeral_annotations_flagged(tmp_path):
+    empty = """
+    class S:
+        def __init__(self):
+            # r2d2: ephemeral()
+            self.n = 0
+        def bump(self):
+            self.n += 1
+        def carry_state(self):
+            return {}
+        def restore_carry(self, d):
+            pass
+    """
+    findings, _ = det(tmp_path / "empty", {"mod.py": empty})
+    assert rules_of(findings) == ["bad-ephemeral-annotation"]
+    assert "empty reason" in findings[0].message
+
+    stray = '''
+    """Docs may mention # r2d2: ephemeral(x) without it being an annotation."""
+    class P:
+        def carry_state(self):
+            return {}
+        def restore_carry(self, d):
+            pass
+        def go(self):
+            # r2d2: ephemeral(this line assigns no attribute)
+            y = 1
+            return y
+    '''
+    findings, _ = det(tmp_path / "stray", {"mod.py": stray})
+    assert rules_of(findings) == ["bad-ephemeral-annotation"]
+    assert len(findings) == 1  # the docstring mention is NOT an annotation
+    assert "attaches to no" in findings[0].message
+
+
+def test_wallclock_taint_direct_and_audit_allowlist(tmp_path):
+    hot = """
+    import time
+    from blocks import Block
+    def derive(key, sock):
+        t = time.time()
+        key = key.fold_in(t)
+        sock.send(seq=time.time())
+        return key, Block(obs=time.time())
+    """
+    findings, _ = det(tmp_path / "pos", {"mod.py": hot})
+    assert rules_of(findings) == ["nondet-taint"]
+    assert len(findings) == 3  # fold_in input, seq kwarg, Block field
+
+    # audit/metrics destinations are the EXPLICIT wall-clock allowlist
+    ok = """
+    import time
+    from blocks import Block
+    def stamp(sock):
+        return Block(t_serve=time.time(), lag_stamp=time.time())
+    """
+    findings, _ = det(tmp_path / "neg", {"mod.py": ok})
+    assert findings == [], render_text(findings)
+
+
+def test_wallclock_taint_interprocedural(tmp_path):
+    """Taint crosses the call graph both ways: a helper RETURNING a
+    wall-clock value taints its caller's sink, and a tainted argument to a
+    helper whose PARAM reaches a sink is flagged at the call site."""
+    ret = """
+    import time
+    def now():
+        return time.time()
+    def derive(key):
+        return key.fold_in(now())
+    """
+    findings, _ = det(tmp_path / "ret", {"mod.py": ret})
+    assert rules_of(findings) == ["nondet-taint"]
+
+    param = """
+    import time
+    class S:
+        def __init__(self):
+            self.mark = 0.0
+        def _set(self, v):
+            self.mark = v
+        def tick(self):
+            self._set(time.time())
+        def bump(self):
+            self._set(self.mark + 1.0)
+        def carry_state(self):
+            return {"mark": self.mark}
+        def restore_carry(self, d):
+            self.mark = d["mark"]
+    """
+    findings, _ = det(tmp_path / "param", {"mod.py": param})
+    assert rules_of(findings) == ["nondet-taint"]
+    assert len(findings) == 1  # at the tainted call site, not inside _set
+    assert "via _set" in findings[0].message
+
+
+def test_unsorted_scan_and_unseeded_random(tmp_path):
+    pos = """
+    import glob
+    import os
+    import numpy as np
+    def spool(d):
+        names = [n for n in os.listdir(d)]
+        files = glob.glob(d + "/*.npz")
+        return names, files, np.random.uniform()
+    """
+    findings, _ = det(tmp_path / "pos", {"mod.py": pos})
+    assert rules_of(findings) == ["unseeded-random", "unsorted-scan"]
+    assert len(findings) == 3
+
+    neg = """
+    import glob
+    import os
+    import numpy as np
+    def spool(d, rng):
+        names = sorted(os.listdir(d))
+        files = sorted(glob.glob(d + "/*.npz"))
+        gen = np.random.default_rng(0)
+        return names, files, gen.uniform(), rng.normal()
+    """
+    findings, _ = det(tmp_path / "neg", {"mod.py": neg})
+    assert findings == [], render_text(findings)
+
+
+def test_set_iteration_and_id_keys(tmp_path):
+    pos = """
+    def evict(server, trace, cache, obj):
+        for sid in {ev.session for ev in trace}:
+            server.evict(sid)
+        cache[id(obj)] = 1
+        return {id(obj): 2}
+    """
+    findings, _ = det(tmp_path / "pos", {"mod.py": pos})
+    assert rules_of(findings) == ["nondet-taint"]
+    assert len(findings) == 3
+
+    neg = """
+    def evict(server, trace):
+        for sid in sorted({ev.session for ev in trace}):
+            server.evict(sid)
+    """
+    findings, _ = det(tmp_path / "neg", {"mod.py": neg})
+    assert findings == [], render_text(findings)
+
+
+def test_chaos_coverage_fixture(tmp_path):
+    """A fixture registry drives all three chaos directions: registered-
+    but-unguarded, registered-but-undrilled (no literal in the sibling
+    test tree), and guarded-but-unregistered."""
+    _write(tmp_path, "pkg/pkgfaults.py", """
+    KNOWN_SITES = (
+        "alpha.load",
+        "beta.save",
+        "gamma.send",
+    )
+    def fault_point(site):
+        pass
+    """)
+    _write(tmp_path, "pkg/mod.py", """
+    from pkgfaults import fault_point
+    def load():
+        fault_point("alpha.load")
+    def send():
+        fault_point("gamma.send")
+        fault_point("delta.recv")
+    """)
+    _write(tmp_path, "tests/test_drill.py", """
+    def test_drill():
+        for site in ("alpha.load", "gamma.send"):
+            assert site
+    """)
+    from r2d2_tpu.analysis import determinism
+
+    findings, _ = determinism.analyze_paths([str(tmp_path / "pkg")])
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert sorted(by_rule) == [
+        "chaos-undrilled-site", "chaos-unguarded-site",
+        "chaos-unregistered-site",
+    ]
+    assert "beta.save" in by_rule["chaos-unguarded-site"][0].message
+    assert "beta.save" in by_rule["chaos-undrilled-site"][0].message
+    assert "delta.recv" in by_rule["chaos-unregistered-site"][0].message
+    # findings point at the registry entry / the guarding call site
+    assert by_rule["chaos-unguarded-site"][0].path.endswith("pkgfaults.py")
+    assert by_rule["chaos-unregistered-site"][0].path.endswith("mod.py")
+
+
+def test_determinism_repo_wide_gate_and_budget():
+    """The shipped tree has zero unsuppressed determinism findings: every
+    mutable attribute on the snapshot path is carried+restored or
+    ephemeral-annotated with its invariant, no wall-clock value reaches a
+    deterministic sink, every directory scan feeding recovery is sorted,
+    and every registered fault site is guarded AND drilled. This is the
+    tier-1 bit-exact-resume gate. The same run doubles as the analyzer's
+    wall-clock budget assert: the full interprocedural pass must stay a
+    negligible slice of the 870 s tier-1 gate."""
+    import time as _time
+
+    from r2d2_tpu.analysis import determinism
+
+    t0 = _time.perf_counter()
+    findings, suppressed = determinism.analyze_paths([PKG_DIR])
+    elapsed = _time.perf_counter() - t0
+    assert findings == [], render_text(findings)
+    # the audited ephemeral inventory stays visible (tap counters, the
+    # tiered plane's lazily rebuilt pipeline)
+    assert any(f.rule.startswith("resume-") for f in suppressed), suppressed
+    assert elapsed < 60.0, f"determinism pass took {elapsed:.1f}s"
+
+
+def test_cli_determinism_flag(capsys):
+    """Flag wiring end-to-end on a subtree (repo-wide zero is pinned by
+    test_determinism_repo_wide_gate_and_budget over the same
+    analyze_paths the flag dispatches to)."""
+    from r2d2_tpu.analysis.cli import main
+
+    assert main(["--determinism", os.path.join(PKG_DIR, "analysis")]) == 0
+    assert "no findings" in capsys.readouterr().out
+
+
+def test_determinism_sarif_rule_indices_stable():
+    """SARIF rule indices for the new family are stable: the driver rule
+    table is the sorted set of rule ids present, so adding a finding of an
+    existing rule never renumbers the table."""
+    from r2d2_tpu.analysis import determinism
+    from r2d2_tpu.analysis.findings import render_sarif
+
+    fs = [
+        Finding("unsorted-scan", "warning", "a.py", 1, 0, "m"),
+        Finding("nondet-taint", "error", "b.py", 1, 0, "m"),
+        Finding("chaos-undrilled-site", "error", "c.py", 1, 0, "m"),
+    ]
+    doc = json.loads(render_sarif(fs))
+    rules = [r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]]
+    assert rules == sorted(rules)
+    assert set(rules) <= set(determinism.ALL_RULES)
+
+
+def test_seeded_mutation_trips_determinism_gate(tmp_path):
+    """Delete ONE field ("sum_reward") from the real SequenceAccumulator
+    carry_state inside a fixture copy — the gate must trip with
+    resume-uncaptured-field. The unmutated copy of the same file is
+    clean, so the trip is attributable to exactly the removed capture."""
+    from r2d2_tpu.analysis import determinism
+
+    with open(os.path.join(PKG_DIR, "replay", "accumulator.py"),
+              encoding="utf-8") as fh:
+        real = fh.read()
+    _write(tmp_path / "intact", "acc.py", real)
+    findings, _ = determinism.analyze_paths([str(tmp_path / "intact")])
+    assert findings == [], render_text(findings)
+
+    dropped = '"sum_reward": np.asarray(self.sum_reward, np.float64),'
+    assert dropped in real
+    _write(tmp_path / "mutated", "acc.py", real.replace(dropped, ""))
+    findings, _ = determinism.analyze_paths([str(tmp_path / "mutated")])
+    assert "resume-uncaptured-field" in rules_of(findings)
+    assert any("sum_reward" in f.message for f in findings)

@@ -298,6 +298,24 @@ def test_serve_chaos_sites_fire_on_schedule():
         faults.fault_point("serve.slow_client")
 
 
+@pytest.mark.parametrize("site", [
+    "autoscale.evaluate",
+    "autoscale.scale_up",
+    "autoscale.scale_down",
+    "serve.client",
+])
+def test_control_loop_sites_drilled(site):
+    """Injection drill for the autoscaler/client sites: the determinism
+    pass's chaos-coverage rule (analysis/determinism.py) errors on any
+    KNOWN_SITES entry that no test ever injects, so every registered site
+    must fail on schedule AND recover on the next call."""
+    assert site in faults.KNOWN_SITES, site
+    faults.install(FaultPlane(schedule={site: {1: "error"}}))
+    with pytest.raises(InjectedFault):
+        faults.fault_point(site)
+    faults.fault_point(site)  # recovered: only the scheduled call fires
+
+
 # ------------------------------------------------------------------- wiring
 
 
